@@ -1,0 +1,56 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capability
+surface (imperative NDArray + Gluon + hybridize + KVStore data parallel),
+built on JAX/XLA/Pallas/pjit.  See SURVEY.md for the blueprint.
+
+Import style parity:  ``import mxnet_tpu as mx`` then ``mx.nd``, ``mx.gluon``,
+``mx.autograd``, ``mx.context`` work as in upstream MXNet.
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, Device, cpu, gpu, tpu, num_gpus, num_tpus, \
+    current_context
+from . import context
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+
+# Subpackages are imported lazily via __getattr__ to keep import time low.
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "metric": ".metric",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "lr_scheduler": ".lr_scheduler",
+    "parallel": ".parallel",
+    "models": ".models",
+    "amp": ".amp",
+    "profiler": ".profiler",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".recordio",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "np": ".numpy",
+    "npx": ".numpy_extension",
+    "sym": ".symbol",
+    "symbol": ".symbol",
+    "module": ".module",
+    "mod": ".module",
+    "callback": ".callback",
+    "util": ".util",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,168 @@
+"""Autograd: record/pause scopes, backward, grad.
+
+Parity target: ``python/mxnet/autograd.py`` over
+``src/imperative/imperative.cc`` (SURVEY.md §2.2/§2.6).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from .. import base as _base
+from . import tape
+from .tape import LeafNode, OpNode, OutRef
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "Function"]
+
+
+is_recording = _base.is_recording
+is_training = _base.is_training
+set_recording = _base.set_recording
+set_training = _base.set_training
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode_: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode_
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = _base.set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = _base.set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *a):
+        if self._prev_record is not None or self._enter_record is not None:
+            _base.set_recording(self._prev_record)
+        if self._prev_train is not None or self._enter_train is not None:
+            _base.set_training(self._prev_train)
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — enables tape recording (+train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Associate gradient buffers with variables (Trainer/low-level API)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._node = LeafNode(v, req)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse pass from `heads`; accumulates into leaves' ``.grad``."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    leaf_grads = tape.backward_on(heads, head_grads)
+    for _, (leaf, g) in leaf_grads.items():
+        owner = leaf.owner()
+        if owner is None or leaf.grad_req == "null":
+            continue
+        if owner._grad is None:
+            from ..ndarray import ndarray as _nd
+            owner._grad = _nd.NDArray(jnp.zeros_like(owner.jax),
+                                      ctx=owner.context)
+        if leaf.grad_req == "add":
+            owner._grad._rebind(owner._grad.jax + g)
+        else:  # write
+            owner._grad._rebind(jnp.asarray(g, dtype=owner.jax.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient: returns grads of heads w.r.t. variables without
+    touching ``.grad`` buffers (parity: mx.autograd.grad)."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order imperative grad) is not yet "
+            "supported; use hybridize + jax.grad composition instead")
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    leaf_grads = tape.backward_on(heads, head_grads)
+    from ..ndarray import ndarray as _nd
+    outs = []
+    for v in variables:
+        node = v._node
+        if isinstance(node, LeafNode) and id(node) in leaf_grads:
+            outs.append(_nd.NDArray(leaf_grads[id(node)][1], ctx=v.context))
+        else:
+            outs.append(_nd.NDArray(jnp.zeros_like(v.jax), ctx=v.context))
+    return outs
+
+
+class Function:
+    """Custom differentiable function (parity: mx.autograd.Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` using NDArray ops.  Saved tensors via
+    ``self.save_for_backward``.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from ..ndarray import ndarray as _nd
+        rec = _base.is_recording()
+        with pause():
+            outs = self.forward(*inputs)
+        single = not isinstance(outs, (list, tuple))
+        outs_list = [outs] if single else list(outs)
+        if rec:
+            in_nodes = [tape.node_of(x) for x in inputs]
+            fwd_vals = [x.jax for x in inputs]
+
+            def vjp_fn(cots):
+                cots_t = (cots,) if single else tuple(cots)
+                with pause():
+                    grads = self.backward(*[
+                        _nd.NDArray(c) for c in cots_t])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return tuple(g.jax if g is not None else None for g in grads)
+
+            node = OpNode(vjp_fn, in_nodes, len(outs_list),
+                          name=type(self).__name__,
+                          out_avals=[o.jax for o in outs_list])
+            for i, o in enumerate(outs_list):
+                o._node = OutRef(node, i)
+        return outs_list[0] if single else outs_list

@@ -1,0 +1,144 @@
+"""Autograd tape.
+
+Parity target: ``src/imperative/imperative.cc`` (``Imperative::RecordOp`` /
+``Imperative::Backward``; SURVEY.md §2.2, §3.2).  TPU-first realization: a
+node per recorded op holding the ``jax.vjp`` pullback captured *at forward
+time* (residuals are immutable jax arrays, so later in-place rebinds of the
+participating NDArrays cannot corrupt the backward — MXNet needs version
+counters for this; we get it from functional purity).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LeafNode", "OpNode", "OutRef", "node_of", "backward_on"]
+
+
+class LeafNode:
+    """A variable marked for gradient (``attach_grad``/``mark_variables``)."""
+
+    __slots__ = ("owner", "grad_req")
+
+    def __init__(self, owner, grad_req: str = "write"):
+        self.owner = weakref.ref(owner)
+        self.grad_req = grad_req
+
+
+class OpNode:
+    """One recorded op: pullback + links to producing nodes of each input."""
+
+    __slots__ = ("vjp_fn", "in_nodes", "n_out", "name", "out_avals")
+
+    def __init__(self, vjp_fn: Callable, in_nodes: List[Optional[Any]],
+                 n_out: int, name: str = "op", out_avals=None):
+        self.vjp_fn = vjp_fn
+        self.in_nodes = in_nodes
+        self.n_out = n_out
+        self.name = name
+        self.out_avals = out_avals  # list of ShapeDtypeStruct per output
+
+
+class OutRef:
+    """Pointer from an NDArray to (producing OpNode, output index)."""
+
+    __slots__ = ("node", "index")
+
+    def __init__(self, node: OpNode, index: int):
+        self.node = node
+        self.index = index
+
+
+def node_of(arr):
+    """The graph node feeding an NDArray, or None if constant w.r.t. grads."""
+    return getattr(arr, "_node", None)
+
+
+def _toposort(roots: Sequence[OpNode]) -> List[OpNode]:
+    order: List[OpNode] = []
+    state = {}
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if state.get(id(node)) is not None:
+            continue
+        state[id(node)] = True
+        stack.append((node, True))
+        for parent in node.in_nodes:
+            if isinstance(parent, OutRef):
+                parent = parent.node
+            if isinstance(parent, OpNode) and id(parent) not in state:
+                stack.append((parent, False))
+    return order  # already reverse-finished => topological (children last)
+
+
+def backward_on(heads, head_grads=None):
+    """Run reverse accumulation from `heads`; returns {LeafNode: jax grad}.
+
+    `heads` are NDArrays with `_node` set. `head_grads` are NDArrays/None.
+    """
+    roots = []
+    seeds = {}  # (id(OpNode), idx) -> cotangent jax array
+    leaf_grads = {}  # id(LeafNode) -> (LeafNode, grad)
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    for h, hg in zip(heads, head_grads):
+        n = node_of(h)
+        if n is None:
+            raise ValueError("backward on an array outside the recorded graph"
+                             " (was autograd.record() active?)")
+        g = (jnp.ones_like(h.jax) if hg is None
+             else jnp.asarray(hg.jax if hasattr(hg, "jax") else hg,
+                              dtype=h.jax.dtype))
+        if isinstance(n, LeafNode):
+            _acc(leaf_grads, n, g)
+            continue
+        key = (id(n.node), n.index)
+        seeds[key] = seeds.get(key, 0) + g
+        roots.append(n.node)
+
+    order = _toposort(roots)
+    cots = dict(seeds)  # (id(node), idx) -> cotangent
+
+    for node in reversed(order):
+        outs = []
+        missing = True
+        for i in range(node.n_out):
+            c = cots.pop((id(node), i), None)
+            if c is not None:
+                missing = False
+            outs.append(c)
+        if missing:
+            continue
+        if node.out_avals is not None:
+            outs = [jnp.zeros(a.shape, a.dtype) if c is None else c
+                    for c, a in zip(outs, node.out_avals)]
+        outs = tuple(outs)
+        cot_in = node.vjp_fn(outs if node.n_out > 1 else outs[0])
+        for parent, g in zip(node.in_nodes, cot_in):
+            if parent is None or g is None:
+                continue
+            if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                continue
+            if isinstance(parent, LeafNode):
+                _acc(leaf_grads, parent, g)
+            elif isinstance(parent, OutRef):
+                key = (id(parent.node), parent.index)
+                prev = cots.get(key)
+                cots[key] = g if prev is None else prev + g
+    return leaf_grads
+
+
+def _acc(store, leaf: LeafNode, g):
+    key = id(leaf)
+    if key in store:
+        store[key] = (leaf, store[key][1] + g)
+    else:
+        store[key] = (leaf, g)

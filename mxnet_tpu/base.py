@@ -1,0 +1,155 @@
+"""Foundational utilities: dtype handling, registries, global modes.
+
+Role parity: the dtype/registry plumbing that upstream MXNet implements in
+``python/mxnet/base.py`` + ``dmlc::Parameter`` (see SURVEY.md §5.6).  Here the
+"C ABI" disappears: ops are pure JAX functions registered in Python, and the
+parameter-struct metadata lives on the registered op wrapper itself.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "numeric_types",
+    "integer_types",
+    "string_types",
+    "dtype_np_to_jax",
+    "canonical_dtype",
+    "registry",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity with mxnet.base.MXNetError)."""
+
+
+numeric_types = (float, int, onp.generic, onp.ndarray)
+integer_types = (int, onp.integer)
+string_types = (str,)
+
+# dtype canonicalization -----------------------------------------------------
+
+_DTYPE_ALIASES: Dict[str, Any] = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+
+def canonical_dtype(dtype) -> onp.dtype:
+    """Return a numpy dtype object for any accepted dtype spec."""
+    if dtype is None:
+        return onp.dtype("float32")
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_ALIASES:
+            return onp.dtype(_DTYPE_ALIASES[dtype])
+        return onp.dtype(dtype)
+    return onp.dtype(dtype)
+
+
+def dtype_np_to_jax(dtype):
+    return jnp.dtype(canonical_dtype(dtype))
+
+
+# Simple name->object registry (parity: dmlc registry used for optimizers,
+# initializers, metrics, kvstore types).
+
+
+class _Registry:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, Any] = {}
+
+    def register(self, name: Optional[str] = None, obj: Any = None):
+        def _do(o, nm):
+            key = (nm or getattr(o, "__name__", None) or str(o)).lower()
+            self._entries[key] = o
+            return o
+
+        if obj is not None:
+            return _do(obj, name)
+
+        def deco(o):
+            return _do(o, name)
+
+        return deco
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._entries:
+            raise MXNetError(
+                f"Unknown {self.kind} '{name}'. Registered: {sorted(self._entries)}"
+            )
+        return self._entries[key]
+
+    def find(self, name: str):
+        return self._entries.get(name.lower())
+
+    def names(self):
+        return sorted(self._entries)
+
+
+_REGISTRIES: Dict[str, _Registry] = {}
+
+
+def registry(kind: str) -> _Registry:
+    if kind not in _REGISTRIES:
+        _REGISTRIES[kind] = _Registry(kind)
+    return _REGISTRIES[kind]
+
+
+# Global training/inference mode (parity: autograd train_mode/predict_mode).
+
+_STATE = threading.local()
+
+
+def is_training() -> bool:
+    return getattr(_STATE, "train_mode", False)
+
+
+def set_training(flag: bool) -> bool:
+    prev = is_training()
+    _STATE.train_mode = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def training_mode(flag: bool):
+    prev = set_training(flag)
+    try:
+        yield
+    finally:
+        set_training(prev)
+
+
+def is_recording() -> bool:
+    return getattr(_STATE, "recording", False)
+
+
+def set_recording(flag: bool) -> bool:
+    prev = is_recording()
+    _STATE.recording = bool(flag)
+    return prev
+
+
+# Numeric promotion helper shared by the nd namespace.
+
+def wrap_scalar(x, like_dtype=None):
+    if isinstance(x, (int, float, bool)):
+        return jnp.asarray(x, dtype=like_dtype)
+    return x

@@ -1,0 +1,11 @@
+"""mxnet_tpu.gluon — imperative/hybrid neural network API (parity: mx.gluon)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, ParameterDict, Constant
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load
+
+from . import rnn  # noqa: E402
+from . import data  # noqa: E402

@@ -1,0 +1,442 @@
+"""Block / HybridBlock (parity: python/mxnet/gluon/block.py).
+
+TPU-first: ``hybridize()`` swaps the per-op imperative path for a
+:class:`~mxnet_tpu.gluon.cached_op.CachedOp` that traces the block's forward
+into ONE jitted XLA computation (the CachedOp role, SURVEY.md §2.2/§7.1) —
+`static_alloc` maps to XLA buffer donation semantics and `static_shape` to a
+strict no-retrace policy, both of which XLA largely subsumes.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+from .. import base as _base
+from .. import ndarray as nd
+from ..context import current_context
+from ..ndarray import NDArray
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
+
+_block_counters: Dict[str, int] = {}
+
+
+def _gen_prefix(cls_name: str) -> str:
+    n = _block_counters.get(cls_name, 0)
+    _block_counters[cls_name] = n + 1
+    return f"{cls_name.lower()}{n}_"
+
+
+class _BlockScope:
+    def __init__(self, block):
+        self._block = block
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+class Block:
+    """Base class for all layers/models."""
+
+    def __init__(self, prefix: Optional[str] = None,
+                 params: Optional[ParameterDict] = None):
+        self._prefix = prefix if prefix is not None \
+            else _gen_prefix(type(self).__name__)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # ------------------------------------------------------------- registry
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                self._children[name] = value
+        elif isinstance(value, Parameter):
+            reg = getattr(self, "_reg_params", None)
+            if reg is not None:
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # --------------------------------------------------------------- naming
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_")
+
+    def name_scope(self):
+        return _BlockScope(self)
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        out = ParameterDict(self._prefix)
+        pattern = re.compile(select) if select else None
+        for name, p in self._iter_params():
+            if pattern is None or pattern.match(name):
+                out.update({name: p})
+        return out
+
+    def _iter_params(self, prefix=""):
+        for attr, p in self._reg_params.items():
+            yield p.name, p
+        for cname, child in self._children.items():
+            yield from child._iter_params(prefix + cname + ".")
+
+    def _collect_params_with_prefix(self, prefix="") -> Dict[str, Parameter]:
+        """Structural names ('0.weight') used for save/load — robust across
+        prefix schemes (MXNet 2.x behavior)."""
+        out: Dict[str, Parameter] = {}
+        for attr, p in self._reg_params.items():
+            out[prefix + attr] = p
+        for cname, child in self._children.items():
+            out.update(child._collect_params_with_prefix(
+                prefix + cname + "."))
+        return out
+
+    # ----------------------------------------------------------------- init
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx, verbose=verbose,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for _, p in self._iter_params():
+            p.cast(dtype)
+        self.apply(lambda b: b._cast_hook(dtype))
+
+    def _cast_hook(self, dtype):
+        pass
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------ save/load
+    def save_parameters(self, filename, deduplicate=False):
+        from ..utils.serialization import save
+        params = self._collect_params_with_prefix()
+        save(filename, {k: p._reduce() for k, p in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..utils.serialization import load
+        loaded = load(filename)
+        params = self._collect_params_with_prefix()
+        for name, p in params.items():
+            if name in loaded:
+                v = loaded[name]
+                if cast_dtype and dtype_source == "current":
+                    v = v.astype(p.dtype)
+                p.set_data(v)
+            elif not allow_missing:
+                raise _base.MXNetError(
+                    f"Parameter '{name}' is missing in file {filename}. "
+                    f"Available: {sorted(loaded)[:8]}...")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise _base.MXNetError(
+                    f"File {filename} has extra parameters: {sorted(extra)}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -------------------------------------------------------------- forward
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        for _ in range(2):
+            try:
+                out = self.forward(*args, **kwargs)
+                break
+            except DeferredInitializationError:
+                self._deferred_infer_shape(*args, **kwargs)
+                for _, p in self._iter_params():
+                    p._finish_deferred_init()
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def _deferred_infer_shape(self, *args, **kwargs):
+        self.infer_shape(*args, **kwargs)
+
+    def infer_shape(self, *args, **kwargs):
+        """Layers with deferred-init params override this."""
+        raise _base.MXNetError(
+            f"{type(self).__name__} has uninitialized parameters with "
+            "unknown shape and no infer_shape — initialize with explicit "
+            "shapes or run a forward pass layer by layer")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        lines = [f"{'Layer':<40}{'Output':<24}{'Params':<12}"]
+        total = [0]
+
+        def walk(b, depth):
+            n_params = sum(
+                p.data().size for _, p in b._reg_params.items()
+                if p._data is not None)
+            total[0] += n_params
+            lines.append(f"{'  ' * depth + type(b).__name__:<40}"
+                         f"{'':<24}{n_params:<12}")
+            for c in b._children.values():
+                walk(c, depth + 1)
+
+        walk(self, 0)
+        lines.append(f"Total params: {total[0]}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"  ({name}): {child_repr}\n"
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """A Block that can be hybridized into a single XLA computation."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._cached_op_args: dict = {}
+        self._flags = {}
+        self._export_args = None
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None,
+                  backward_bulk_size=None, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_op = None
+        super().hybridize(active=False)  # children run inside our trace
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True, **kwargs)
+        return self(x, *args)
+
+    # forward dispatch ------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        from ..ndarray import NDArray
+        if args and isinstance(args[0], (NDArray, list, tuple)):
+            self._export_args = args  # input signature for export()
+        if self._active:
+            for _ in range(2):
+                try:
+                    return self._call_cached_op(*args, **kwargs)
+                except DeferredInitializationError:
+                    self._finish_deferred(*args, **kwargs)
+            return self._call_cached_op(*args, **kwargs)
+        return super().__call__(*args, **kwargs)
+
+    def _finish_deferred(self, *args, **kwargs):
+        # One imperative warm-up pass settles all deferred shapes (each layer
+        # infers from its actual input, matching MXNet's first dynamic run).
+        with _base.training_mode(_base.is_training()):
+            super().__call__(*args, **kwargs)
+
+    def _call_cached_op(self, *args, **kwargs):
+        from .cached_op import CachedOp
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self, self._flags)
+        return self._cached_op(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        if type(self).hybrid_forward is not HybridBlock.hybrid_forward:
+            params = {}
+            for attr, p in self._reg_params.items():
+                params[attr] = p.data()
+            return self.hybrid_forward(nd, *args, **kwargs, **params)
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward() or "
+            "hybrid_forward()")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # export --------------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize the model graph + weights (parity: HybridBlock.export →
+        ``prefix-symbol.json`` + ``prefix-0000.params``).
+
+        TPU-native: the "symbol" is the traced computation serialized as
+        **StableHLO** via ``jax.export`` with a symbolic batch dimension —
+        reloadable without the Python model code (the CachedOp/NNVM-JSON
+        role of src/imperative/cached_op.cc + SaveJSON).
+        """
+        import json
+
+        import jax
+        from jax import export as jexport
+
+        from .. import random as _random
+        from .cached_op import CachedOp, _flatten_in
+
+        if getattr(self, "_export_args", None) is None:
+            raise _base.MXNetError(
+                "export requires a prior forward call (to fix the input "
+                "signature) — run net(x) once first")
+        flat_inputs, _ = _flatten_in(self._export_args)
+        in_avals = [jax.ShapeDtypeStruct(x.shape, x.jax.dtype)
+                    for x in flat_inputs]
+        cop = CachedOp(self, self._flags)
+        param_items = cop._collect_param_items()
+        param_vals = [p.data().jax for _, p in param_items]
+        _, unflatten = _flatten_in(self._export_args)
+        pure = cop._make_pure(unflatten, False, len(param_vals),
+                              len(in_avals), param_items, None)
+        key = _random.next_key()
+
+        def infer_fn(*flat):
+            return pure(flat, key)
+
+        # prime to learn the output tree
+        jax.eval_shape(infer_fn, *(param_vals + list(in_avals)))
+        out_tree = pure._out_tree
+
+        def _try_export(avals):
+            return jexport.export(jax.jit(infer_fn))(
+                *([jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for v in param_vals] + avals))
+
+        try:  # symbolic batch dim so any batch size reloads
+            scope = jexport.SymbolicScope()
+            sym_avals = []
+            for a in in_avals:
+                if len(a.shape) >= 1:
+                    dims = (jexport.symbolic_shape("b", scope=scope)
+                            + a.shape[1:]) if a.shape[0] > 0 else a.shape
+                    sym_avals.append(jax.ShapeDtypeStruct(tuple(dims),
+                                                          a.dtype))
+                else:
+                    sym_avals.append(a)
+            exported = _try_export(sym_avals)
+            symbolic = True
+        except Exception:
+            exported = _try_export(list(in_avals))
+            symbolic = False
+
+        params_file = f"{path}-{epoch:04d}.params"
+        self.save_parameters(params_file)
+        bin_file = f"{path}-symbol.bin"
+        with open(bin_file, "wb") as f:
+            f.write(exported.serialize())
+        name_map = {}
+        structural = self._collect_params_with_prefix()
+        by_id = {id(p): k for k, p in structural.items()}
+        ordered = [by_id[id(p)] for _, p in param_items]
+        meta = {
+            "framework": "mxnet_tpu",
+            "format": "stablehlo",
+            "graph_file": bin_file,
+            "params_file": params_file,
+            "param_order": ordered,
+            "n_inputs": len(in_avals),
+            "out_tree": out_tree,
+            "symbolic_batch": symbolic,
+        }
+        sym_file = f"{path}-symbol.json"
+        with open(sym_file, "w") as f:
+            json.dump(meta, f)
+        return sym_file, params_file
+
+
+class SymbolBlock(HybridBlock):
+    """Runs an exported StableHLO graph (parity: gluon.SymbolBlock.imports —
+    load ``-symbol.json`` + ``-.params`` without the original Python code)."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__()
+        self._exported = None
+        self._meta = None
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        import json
+
+        from jax import export as jexport
+
+        from ..utils.serialization import load as _load
+        from .parameter import Parameter
+
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        block = SymbolBlock()
+        block._meta = meta
+        with open(meta["graph_file"], "rb") as f:
+            block._exported = jexport.deserialize(bytearray(f.read()))
+        loaded = _load(param_file or meta["params_file"])
+        block._param_order = []
+        for name in meta["param_order"]:
+            arr = loaded[name]
+            p = Parameter(name=name, shape=arr.shape, dtype=arr.dtype,
+                          grad_req="null")
+            p.set_data(arr)
+            attr = name.replace(".", "_")
+            block._reg_params[attr] = p
+            block._param_order.append(p)
+        return block
+
+    def forward(self, *args):
+        from ..ndarray import NDArray
+        from .cached_op import _flatten_in, _unflatten_out
+        flat_inputs, _ = _flatten_in(args)
+        vals = [p.data().jax for p in self._param_order] + \
+               [x.jax for x in flat_inputs]
+        outs = self._exported.call(*vals)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        n_out = len(outs)
+        nds = [NDArray(o) for o in outs]
+        tree = self._meta["out_tree"]
+        return _unflatten_out(nds, _json_tree(tree))
+
+
+def _json_tree(t):
+    """JSON round-trip turns the out_tree tuples into lists; normalize."""
+    kind, meta = t
+    if kind == "nd":
+        return ("nd", None)
+    name, subtrees = meta
+    return ("seq", (name, [(n, _json_tree(sub)) for n, sub in subtrees]))

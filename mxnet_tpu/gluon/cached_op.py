@@ -1,0 +1,237 @@
+"""CachedOp: hybridized execution as ONE jitted XLA computation.
+
+Parity target: ``src/imperative/cached_op.cc`` (SURVEY.md §2.2) — the
+executor behind ``HybridBlock.hybridize()``.  TPU-first realization:
+
+- The block's imperative forward is traced once per (shapes, dtypes,
+  train-mode) signature into a pure function of (param values, inputs, rng
+  key) and compiled with ``jax.jit`` — MXNet's graph caching/bulking/static
+  alloc machinery collapses into XLA's compilation cache + buffer donation.
+- Stochastic ops (Dropout) draw keys from a traced key *argument* (see
+  mxnet_tpu.random), so randomness is fresh per call with zero retraces.
+- Mutable aux state (BatchNorm moving stats) is functionalized: any parameter
+  whose payload was rebound during the trace becomes an extra output, written
+  back after execution — the imperative mutation API survives unchanged.
+- Under ``autograd.record()`` the whole cached computation registers as a
+  single tape node via ``jax.vjp`` over the jitted function, so backward is
+  one more compiled XLA computation (MXNet: CachedOp::Backward).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from .. import random as _random
+from ..autograd.tape import OpNode, OutRef, node_of
+from ..ndarray import NDArray
+
+
+class CachedOp:
+    def __init__(self, block, flags=None):
+        self.block = block
+        self.flags = flags or {}
+        self._jit_cache: Dict = {}
+        # stable parameter ordering for the life of this cached op
+        self._param_items: List[Tuple[str, object]] = None
+
+    # ------------------------------------------------------------------
+    def _collect_param_items(self):
+        items = []
+        seen = set()
+        for name, p in self.block._iter_params():
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            items.append((name, p))
+        return items
+
+    def _make_pure(self, structure, train: bool, n_params: int, n_inputs: int,
+                   param_objs, mutated_slots):
+        """Build the pure traced function.  `mutated_slots` is discovered on
+        the first trace (param indices rebound during forward)."""
+        block = self.block
+        unflatten = structure
+
+        def pure(flat_args, key):
+            param_vals = flat_args[:n_params]
+            input_vals = flat_args[n_params:]
+            provider = _random.push_trace_key(key)
+            saved = []
+            try:
+                # swap traced values into the parameter payloads
+                for (name, p), v in zip(param_objs, param_vals):
+                    d = p._data
+                    saved.append((d, d._data, d._node))
+                    d._data = v
+                    d._node = None
+                args = unflatten(input_vals)
+                with _base.training_mode(train):
+                    rec = _base.set_recording(False)
+                    try:
+                        out = block.forward(*args)
+                    finally:
+                        _base.set_recording(rec)
+                outs, out_tree = _flatten_out(out)
+                out_vals = [o.jax for o in outs]
+                # functionalized aux-state updates: a param whose payload no
+                # longer is the tracer we swapped in was mutated in forward
+                aux_vals = []
+                aux_idx = []
+                for i, (((name, p), v), (d, old, _)) in enumerate(
+                        zip(zip(param_objs, param_vals), saved)):
+                    if d._data is not v:
+                        aux_vals.append(d._data)
+                        aux_idx.append(i)
+                pure._out_tree = out_tree
+                pure._aux_idx = aux_idx
+                return tuple(out_vals) + tuple(aux_vals)
+            finally:
+                for d, old, nodev in saved:
+                    d._data = old
+                    d._node = nodev
+                _random.pop_trace_key()
+
+        return pure
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args):
+        block = self.block
+        if self._param_items is None:
+            self._param_items = self._collect_param_items()
+        param_objs = self._param_items
+        # ensure initialized (raises DeferredInitializationError for retry)
+        param_vals = [p.data().jax for _, p in param_objs]
+
+        flat_inputs, unflatten, static_sig = _flatten_in(args,
+                                                         with_static=True)
+        input_vals = [x.jax for x in flat_inputs]
+        train = _base.is_training()
+        sig = (train, static_sig,
+               tuple((tuple(v.shape), str(v.dtype)) for v in param_vals),
+               tuple((tuple(v.shape), str(v.dtype)) for v in input_vals))
+
+        entry = self._jit_cache.get(sig)
+        if entry is None:
+            pure = self._make_pure(unflatten, train, len(param_vals),
+                                   len(input_vals), param_objs, None)
+            jitted = jax.jit(pure)
+            # prime: trace once to discover out_tree/aux_idx
+            key = _random.next_key()
+            _ = jax.eval_shape(pure, tuple(param_vals + input_vals), key)
+            entry = (jitted, pure._out_tree, pure._aux_idx, pure)
+            self._jit_cache[sig] = entry
+        jitted, out_tree, aux_idx, pure = entry
+
+        key = _random.next_key()
+        flat_args = tuple(param_vals + input_vals)
+
+        recording = _base.is_recording()
+        diff_nodes = [node_of(p.data()) for _, p in param_objs] + \
+                     [node_of(x) for x in flat_inputs]
+        needs_grad = recording and any(n is not None for n in diff_nodes)
+
+        if needs_grad:
+            f = lambda *fa: jitted(fa, key)
+            out_all, vjp_fn = jax.vjp(f, *flat_args)
+        else:
+            out_all = jitted(flat_args, key)
+
+        n_out = len(out_all) - len(aux_idx)
+        out_vals = out_all[:n_out]
+        aux_vals = out_all[n_out:]
+
+        ctx = (flat_inputs[0].context if flat_inputs
+               else param_objs[0][1].data().context)
+        outs = [NDArray(v, ctx=ctx) for v in out_vals]
+
+        if needs_grad:
+            def _vjp_wrapper(cots, _vjp=vjp_fn, _aux=aux_vals, _n=n_out):
+                if _n == 1 and not isinstance(cots, (tuple, list)):
+                    cots = (cots,)
+                return _vjp(tuple(cots) + tuple(
+                    jnp.zeros(v.shape, v.dtype) for v in _aux))
+            node = OpNode(
+                _vjp_wrapper,
+                diff_nodes, n_out, name=f"CachedOp({type(block).__name__})",
+                out_avals=[jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for v in out_vals])
+            for i, o in enumerate(outs):
+                o._node = OutRef(node, i)
+
+        # write back functionalized aux updates (moving stats)
+        for i, v in zip(aux_idx, aux_vals):
+            param_objs[i][1].data()._rebind(v)
+
+        return _unflatten_out(outs, out_tree)
+
+
+# ---------------------------------------------------------------- flattening
+
+def _flatten_in(args, with_static=False):
+    """Flatten (NDArray | list/tuple of NDArray) args; non-array args are
+    closed over statically and contribute to the jit-cache signature."""
+    flat: List[NDArray] = []
+    spec = []
+    static_parts = []
+    for a in args:
+        if isinstance(a, NDArray):
+            spec.append(("nd", None))
+            flat.append(a)
+        elif isinstance(a, (list, tuple)) and all(
+                isinstance(x, NDArray) for x in a):
+            spec.append(("seq", (type(a), len(a))))
+            flat.extend(a)
+        else:
+            spec.append(("static", a))
+            try:
+                hash(a)
+                static_parts.append(a)
+            except TypeError:
+                static_parts.append(repr(a))
+
+    def unflatten(vals):
+        out = []
+        it = iter(vals)
+        for kind, meta in spec:
+            if kind == "nd":
+                out.append(NDArray(next(it)))
+            elif kind == "seq":
+                typ, n = meta
+                seq = [NDArray(next(it)) for _ in range(n)]
+                out.append(list(seq) if typ is list else tuple(seq))
+            else:
+                out.append(meta)
+        return tuple(out)
+
+    if with_static:
+        return flat, unflatten, tuple(static_parts)
+    return flat, unflatten
+
+
+def _flatten_out(out):
+    if isinstance(out, NDArray):
+        return [out], ("nd", None)
+    if isinstance(out, (list, tuple)):
+        flats, trees = [], []
+        for o in out:
+            f, t = _flatten_out(o)
+            flats.extend(f)
+            trees.append((len(f), t))
+        return flats, ("seq", (type(out).__name__, trees))
+    raise _base.MXNetError(f"unsupported hybrid_forward output {type(out)}")
+
+
+def _unflatten_out(flat, tree):
+    kind, meta = tree
+    if kind == "nd":
+        return flat[0]
+    name, subtrees = meta
+    out, i = [], 0
+    for n, t in subtrees:
+        out.append(_unflatten_out(flat[i:i + n], t))
+        i += n
+    return tuple(out) if name == "tuple" else out

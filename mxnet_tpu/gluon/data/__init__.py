@@ -1,0 +1,7 @@
+"""gluon.data — datasets, samplers, dataloaders."""
+from .dataset import (Dataset, SimpleDataset, ArrayDataset,
+                      RecordFileDataset)
+from .sampler import (Sampler, SequentialSampler, RandomSampler,
+                      BatchSampler, FilterSampler, IntervalSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision

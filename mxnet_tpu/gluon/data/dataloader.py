@@ -1,0 +1,93 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+TPU-first: workers are THREADS, not forked processes — JAX's runtime is
+fork-hostile (MXNet needed engine fork-handlers for its process workers;
+we sidestep the whole hazard).  Decode/augment is numpy (releases the GIL in
+hot loops); batches upload to device as one contiguous array, giving the
+prefetch-overlap the C++ iterators provided (SURVEY.md §2.5).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as onp
+
+from ...ndarray import NDArray, array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return array(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        transposed = zip(*data)
+        return tuple(default_batchify_fn(list(x)) for x in transposed)
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler: Optional[Sampler] = None, last_batch=None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                        last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded prefetch pipeline
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = queue.Queue()
+            batches = iter(self._batch_sampler)
+            done = object()
+
+            def submit_next():
+                try:
+                    idx = next(batches)
+                except StopIteration:
+                    return False
+                futures.put(pool.submit(self._load_batch, idx))
+                return True
+
+            for _ in range(self._prefetch or self._num_workers * 2):
+                if not submit_next():
+                    break
+            while not futures.empty():
+                fut = futures.get()
+                submit_next()
+                yield fut.result()

@@ -1,0 +1,213 @@
+"""Vision transforms (parity: gluon/data/vision/transforms.py).
+
+Numpy-based host-side transforms (the decode/augment stage runs on CPU
+before the single batched device upload).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ....ndarray import NDArray, array
+from ...block import Block
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+def _to_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class _NpTransform(Block):
+    def forward(self, x):
+        return self._apply(_to_numpy(x))
+
+    def _apply(self, x: onp.ndarray):
+        raise NotImplementedError
+
+
+class Cast(_NpTransform):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def _apply(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(_NpTransform):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def _apply(self, x):
+        x = x.astype(onp.float32) / 255.0
+        if x.ndim == 3:
+            return onp.transpose(x, (2, 0, 1))
+        if x.ndim == 2:
+            return x[None, :, :]
+        return onp.transpose(x, (0, 3, 1, 2))
+
+
+class Normalize(_NpTransform):
+    """(x - mean) / std on CHW float input."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32)
+        self._std = onp.asarray(std, dtype=onp.float32)
+
+    def _apply(self, x):
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return (x - mean) / std
+
+
+def _resize_hwc(x, size):
+    """Nearest-neighbor resize without external deps (OpenCV replacement for
+    the pure-python path; the C++ pipeline handles JPEG decode+bilinear)."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size
+    src_h, src_w = x.shape[:2]
+    rows = (onp.arange(h) * (src_h / h)).astype(onp.int64).clip(0, src_h - 1)
+    cols = (onp.arange(w) * (src_w / w)).astype(onp.int64).clip(0, src_w - 1)
+    return x[rows][:, cols]
+
+
+class Resize(_NpTransform):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+
+    def _apply(self, x):
+        return _resize_hwc(x, self._size)
+
+
+class CenterCrop(_NpTransform):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def _apply(self, x):
+        w, h = self._size
+        src_h, src_w = x.shape[:2]
+        y0 = max(0, (src_h - h) // 2)
+        x0 = max(0, (src_w - w) // 2)
+        out = x[y0:y0 + h, x0:x0 + w]
+        if out.shape[0] != h or out.shape[1] != w:
+            out = _resize_hwc(out, (w, h))
+        return out
+
+
+class RandomResizedCrop(_NpTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def _apply(self, x):
+        src_h, src_w = x.shape[:2]
+        area = src_h * src_w
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            ar = onp.exp(onp.random.uniform(onp.log(self._ratio[0]),
+                                            onp.log(self._ratio[1])))
+            w = int(round(onp.sqrt(target_area * ar)))
+            h = int(round(onp.sqrt(target_area / ar)))
+            if w <= src_w and h <= src_h:
+                x0 = onp.random.randint(0, src_w - w + 1)
+                y0 = onp.random.randint(0, src_h - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w]
+                return _resize_hwc(crop, self._size)
+        return _resize_hwc(x, self._size)
+
+
+class RandomFlipLeftRight(_NpTransform):
+    def _apply(self, x):
+        if onp.random.rand() < 0.5:
+            return x[:, ::-1].copy()
+        return x
+
+
+class RandomFlipTopBottom(_NpTransform):
+    def _apply(self, x):
+        if onp.random.rand() < 0.5:
+            return x[::-1].copy()
+        return x
+
+
+class RandomBrightness(_NpTransform):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def _apply(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return (x * alpha).clip(0, 255 if x.dtype == onp.uint8 else None) \
+            .astype(x.dtype)
+
+
+class RandomContrast(_NpTransform):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def _apply(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        gray = x.mean()
+        return ((x - gray) * alpha + gray).clip(
+            0, 255 if x.dtype == onp.uint8 else None).astype(x.dtype)
+
+
+class RandomSaturation(_NpTransform):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def _apply(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._s, self._s)
+        gray = x.mean(axis=-1, keepdims=True)
+        return ((x - gray) * alpha + gray).clip(
+            0, 255 if x.dtype == onp.uint8 else None).astype(x.dtype)
+
+
+class RandomLighting(_NpTransform):
+    _eigval = onp.array([55.46, 4.794, 1.148])
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])
+
+    def __init__(self, alpha_std):
+        super().__init__()
+        self._std = alpha_std
+
+    def _apply(self, x):
+        alpha = onp.random.normal(0, self._std, 3)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return (x + rgb).clip(0, 255 if x.dtype == onp.uint8 else None) \
+            .astype(x.dtype)
+
+
+class RandomColorJitter(Compose):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        ts = []
+        if brightness:
+            ts.append(RandomBrightness(brightness))
+        if contrast:
+            ts.append(RandomContrast(contrast))
+        if saturation:
+            ts.append(RandomSaturation(saturation))
+        super().__init__(ts)

@@ -1,0 +1,164 @@
+"""Fused RNN compute (parity: src/operator/rnn.cc / cudnn_rnn).
+
+TPU-first: the input projection for ALL timesteps is one big MXU matmul
+(T·B, G·H); the recurrence is a ``lax.scan`` whose body is a single (B, H)
+× (H, G·H) matmul — the same decomposition cuDNN uses, expressed for XLA.
+Gate orders follow MXNet: LSTM [i, f, g, o]; GRU [r, z, n].
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ndarray import NDArray
+from ...ndarray.ops import invoke, _as_nd
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_scan(mode: str, x_proj, h0, c0, w_hh, b_hh, reverse=False):
+    """Run one layer/direction.  x_proj: (T, B, G*H) input projections."""
+    H = h0.shape[-1]
+
+    if mode == "lstm":
+        def step(carry, xp):
+            h, c = carry
+            gates = xp + jnp.matmul(h, w_hh.T) + b_hh
+            i = jax.nn.sigmoid(gates[..., 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[..., 1 * H:2 * H])
+            g = jnp.tanh(gates[..., 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[..., 3 * H:4 * H])
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        carry = (h0, c0)
+    elif mode == "gru":
+        def step(carry, xp):
+            h = carry
+            hp = jnp.matmul(h, w_hh.T) + b_hh
+            r = jax.nn.sigmoid(xp[..., 0 * H:1 * H] + hp[..., 0 * H:1 * H])
+            z = jax.nn.sigmoid(xp[..., 1 * H:2 * H] + hp[..., 1 * H:2 * H])
+            n = jnp.tanh(xp[..., 2 * H:3 * H] + r * hp[..., 2 * H:3 * H])
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+        carry = h0
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(carry, xp):
+            h = carry
+            h_new = act(xp + jnp.matmul(h, w_hh.T) + b_hh)
+            return h_new, h_new
+        carry = h0
+
+    carry, ys = lax.scan(step, carry, x_proj, reverse=reverse)
+    if mode == "lstm":
+        h_last, c_last = carry
+    else:
+        h_last, c_last = carry, None
+    return ys, h_last, c_last
+
+
+def rnn_layer_forward(x, params_per_dir, h0s, c0s, mode, p_dropout=0.0,
+                      dropout_keys=None):
+    """x: (T, B, C).  params_per_dir: list over layers of list over dirs of
+    (w_ih, w_hh, b_ih, b_hh).  h0s/c0s: (L*D, B, H)."""
+    num_layers = len(params_per_dir)
+    bidirectional = len(params_per_dir[0]) == 2
+    D = 2 if bidirectional else 1
+    h_lasts, c_lasts = [], []
+    out = x
+    for l in range(num_layers):
+        dir_outs = []
+        for d, (w_ih, w_hh, b_ih, b_hh) in enumerate(params_per_dir[l]):
+            idx = l * D + d
+            xp = jnp.einsum("tbc,gc->tbg", out, w_ih) + b_ih
+            ys, h_last, c_last = _cell_scan(
+                mode, xp, h0s[idx], c0s[idx] if c0s is not None else None,
+                w_hh, b_hh, reverse=(d == 1))
+            dir_outs.append(ys)
+            h_lasts.append(h_last)
+            if c_last is not None:
+                c_lasts.append(c_last)
+        out = dir_outs[0] if D == 1 else jnp.concatenate(dir_outs, axis=-1)
+        if p_dropout > 0 and l < num_layers - 1 and dropout_keys is not None:
+            keep = jax.random.bernoulli(dropout_keys[l], 1 - p_dropout,
+                                        out.shape)
+            out = jnp.where(keep, out / (1 - p_dropout), 0.0)
+    h_stack = jnp.stack(h_lasts, axis=0)
+    c_stack = jnp.stack(c_lasts, axis=0) if c_lasts else None
+    return out, h_stack, c_stack
+
+
+def _unpack_params(flat, input_size, state_size, num_layers, D, mode):
+    """Unpack MXNet/cuDNN flat parameter layout: all weights (layer-major,
+    i2h then h2h per layer/dir), then all biases."""
+    G = _GATES[mode]
+    H = state_size
+    shapes = []
+    for l in range(num_layers):
+        in_sz = input_size if l == 0 else H * D
+        for d in range(D):
+            shapes.append((G * H, in_sz))
+            shapes.append((G * H, H))
+    pos = 0
+    weights = []
+    for shp in shapes:
+        n = shp[0] * shp[1]
+        weights.append(flat[pos:pos + n].reshape(shp))
+        pos += n
+    biases = []
+    for l in range(num_layers):
+        for d in range(D):
+            biases.append(flat[pos:pos + G * H]); pos += G * H
+            biases.append(flat[pos:pos + G * H]); pos += G * H
+    params = []
+    wi = 0
+    bi = 0
+    for l in range(num_layers):
+        dirs = []
+        for d in range(D):
+            w_ih, w_hh = weights[wi], weights[wi + 1]
+            b_ih, b_hh = biases[bi], biases[bi + 1]
+            wi += 2
+            bi += 2
+            dirs.append((w_ih, w_hh, b_ih, b_hh))
+        params.append(dirs)
+    return params
+
+
+def rnn_forward(data, parameters, state, state_cell, state_size, num_layers,
+                mode, bidirectional, p, state_outputs, **kw):
+    """Backs nd.RNN (packed-parameter fused op)."""
+    data = _as_nd(data)
+    parameters = _as_nd(parameters)
+    state = _as_nd(state)
+    nds = [data, parameters, state]
+    has_cell = mode == "lstm" and state_cell is not None
+    if has_cell:
+        nds.append(_as_nd(state_cell))
+    D = 2 if bidirectional else 1
+
+    from ... import base as _b
+    from ... import random as _rand
+    p_drop = p if (_b.is_training() and num_layers > 1) else 0.0
+    dkeys = [_rand.next_key(data.context) for _ in range(num_layers - 1)] \
+        if p_drop > 0 else None
+
+    def f(x, flat, h0, *rest):
+        c0 = rest[0] if rest else None
+        params = _unpack_params(flat, x.shape[-1], state_size, num_layers,
+                                D, mode)
+        out, h_last, c_last = rnn_layer_forward(
+            x, params, h0, c0, mode, p_dropout=p_drop, dropout_keys=dkeys)
+        if mode == "lstm":
+            return out, h_last, c_last
+        return out, h_last
+
+    res = invoke("RNN", f, nds)
+    if not state_outputs:
+        return res[0]
+    return res

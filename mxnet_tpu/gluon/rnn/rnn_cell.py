@@ -1,0 +1,307 @@
+"""Per-step RNN cells (parity: gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ...ndarray import ndarray as _ndmod
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(_ndmod.zeros(shape, ctx=ctx))
+        return states
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[0 if axis == 1 else 1]
+            seq = [x for x in
+                   nd.split(inputs, num_outputs=length, axis=axis,
+                            squeeze_axis=True)] if length > 1 else \
+                  [inputs.squeeze(axis=axis)]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch, ctx=seq[0].context)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(hidden_size, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(hidden_size, hidden_size),
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(hidden_size,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(hidden_size,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((self._hidden_size, x.shape[-1]))
+
+    def forward(self, inputs, states):
+        F = _get_F()
+        i2h = F.FullyConnected(inputs, self.i2h_weight.data(),
+                               self.i2h_bias.data(),
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], self.h2h_weight.data(),
+                               self.h2h_bias.data(),
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+def _get_F():
+    from ... import ndarray as nd
+    return nd
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        H = hidden_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * H, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * H, H), init=h2h_weight_initializer,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * H,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * H,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((4 * self._hidden_size, x.shape[-1]))
+
+    def forward(self, inputs, states):
+        F = _get_F()
+        H = self._hidden_size
+        gates = F.FullyConnected(inputs, self.i2h_weight.data(),
+                                 self.i2h_bias.data(), num_hidden=4 * H) + \
+            F.FullyConnected(states[0], self.h2h_weight.data(),
+                             self.h2h_bias.data(), num_hidden=4 * H)
+        sl = F.split(gates, num_outputs=4, axis=-1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = F.tanh(sl[2])
+        o = F.sigmoid(sl[3])
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        H = hidden_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(3 * H, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(3 * H, H), init=h2h_weight_initializer,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(3 * H,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(3 * H,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((3 * self._hidden_size, x.shape[-1]))
+
+    def forward(self, inputs, states):
+        F = _get_F()
+        H = self._hidden_size
+        i2h = F.FullyConnected(inputs, self.i2h_weight.data(),
+                               self.i2h_bias.data(), num_hidden=3 * H)
+        h2h = F.FullyConnected(states[0], self.h2h_weight.data(),
+                               self.h2h_bias.data(), num_hidden=3 * H)
+        i2h_sl = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_sl = F.split(h2h, num_outputs=3, axis=-1)
+        r = F.sigmoid(i2h_sl[0] + h2h_sl[0])
+        z = F.sigmoid(i2h_sl[1] + h2h_sl[1])
+        n = F.tanh(i2h_sl[2] + r * h2h_sl[2])
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[pos:pos + n])
+            next_states.extend(st)
+            pos += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        F = _get_F()
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate)
+        return inputs, states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        from ... import base as _b, random as _r
+        import jax, jax.numpy as jnp
+        out, new_states = self.base_cell(inputs, states)
+        if _b.is_training():
+            F = _get_F()
+            if self._zo > 0:
+                mask = F.random_bernoulli(1 - self._zo, out.shape,
+                                          ctx=out.context)
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(out)
+                out = mask * out + (1 - mask) * prev
+            if self._zs > 0:
+                zs = []
+                for ns, s in zip(new_states, states):
+                    mask = F.random_bernoulli(1 - self._zs, ns.shape,
+                                              ctx=ns.context)
+                    zs.append(mask * ns + (1 - mask) * s)
+                new_states = zs
+        self._prev_output = out
+        return out, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [x for x in nd.split(inputs, num_outputs=length,
+                                          axis=axis, squeeze_axis=True)]
+        batch = inputs[0].shape[0]
+        nl = len(self.l_cell.state_info())
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch, ctx=inputs[0].context)
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, states[:nl], layout="NTC", merge_outputs=False)
+        r_out, r_states = self.r_cell.unroll(
+            length, list(reversed(inputs)), states[nl:], layout="NTC",
+            merge_outputs=False)
+        outs = [nd.concat(lo, ro, dim=-1)
+                for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states

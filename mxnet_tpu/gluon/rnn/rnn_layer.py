@@ -1,0 +1,156 @@
+"""Fused multi-layer RNN/LSTM/GRU layers (parity: gluon/rnn/rnn_layer.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import base as _base
+from ... import random as _random
+from ...ndarray import NDArray, ndarray as _ndmod
+from ...ndarray.ops import invoke
+from ..block import HybridBlock
+from ._rnn_impl import _GATES, rnn_layer_forward
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        G = _GATES[mode]
+        H = hidden_size
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else H * self._dir
+            for d in range(self._dir):
+                pfx = ("l" if d == 0 else "r") + str(l)
+                for nm, shape, init in [
+                        (f"{pfx}_i2h_weight", (G * H, in_sz),
+                         i2h_weight_initializer),
+                        (f"{pfx}_h2h_weight", (G * H, H),
+                         h2h_weight_initializer),
+                        (f"{pfx}_i2h_bias", (G * H,), i2h_bias_initializer),
+                        (f"{pfx}_h2h_bias", (G * H,), h2h_bias_initializer)]:
+                    p = self.params.get(nm, shape=shape, init=init,
+                                        dtype=dtype,
+                                        allow_deferred_init=True)
+                    self._reg_params[nm] = p
+                    setattr(self, nm, p)
+
+    def infer_shape(self, x, *args):
+        in_sz = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        G = _GATES[self._mode]
+        H = self._hidden_size
+        for l in range(self._num_layers):
+            sz = in_sz if l == 0 else H * self._dir
+            for d in range(self._dir):
+                pfx = ("l" if d == 0 else "r") + str(l)
+                getattr(self, f"{pfx}_i2h_weight")._set_shape((G * H, sz))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        states = []
+        L = self._num_layers * self._dir
+        H = self._hidden_size
+        n_states = 2 if self._mode == "lstm" else 1
+        for _ in range(n_states):
+            states.append(_ndmod.zeros((L, batch_size, H), ctx=ctx,
+                                       dtype=self._dtype))
+        return states
+
+    def forward(self, x, states=None):
+        layout_t = self._layout == "TNC"
+        batch = x.shape[1] if layout_t else x.shape[0]
+        return_states = states is not None
+        if states is None:
+            states = self.begin_state(batch, ctx=x.context)
+        if isinstance(states, NDArray):
+            states = [states]
+        nds = [x] + list(states)
+        param_nds = []
+        param_struct = []
+        for l in range(self._num_layers):
+            dirs = []
+            for d in range(self._dir):
+                pfx = ("l" if d == 0 else "r") + str(l)
+                idx0 = len(nds) + len(param_nds)
+                for nm in ("i2h_weight", "h2h_weight", "i2h_bias",
+                           "h2h_bias"):
+                    param_nds.append(getattr(self, f"{pfx}_{nm}").data())
+                dirs.append(idx0)
+            param_struct.append(dirs)
+        all_nds = nds + param_nds
+        mode = self._mode
+        dropout = self._dropout if _base.is_training() else 0.0
+        dkeys = None
+        if dropout > 0 and self._num_layers > 1:
+            dkeys = [_random.next_key(x.context)
+                     for _ in range(self._num_layers - 1)]
+        n_state_in = len(states)
+
+        def f(*vals):
+            xv = vals[0]
+            if not layout_t:
+                xv = jnp.swapaxes(xv, 0, 1)
+            h0 = vals[1]
+            c0 = vals[2] if n_state_in == 2 else None
+            params = []
+            for dirs in param_struct:
+                row = []
+                for idx0 in dirs:
+                    row.append(tuple(vals[idx0:idx0 + 4]))
+                params.append(row)
+            out, h_last, c_last = rnn_layer_forward(
+                xv, params, h0, c0, mode, p_dropout=dropout,
+                dropout_keys=dkeys)
+            if not layout_t:
+                out = jnp.swapaxes(out, 0, 1)
+            if mode == "lstm":
+                return out, h_last, c_last
+            return out, h_last
+
+        res = invoke("rnn_layer", f, all_nds)
+        out = res[0]
+        if return_states:
+            return out, list(res[1:])
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__("rnn_" + activation, hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

@@ -1,0 +1,152 @@
+"""Trainer: binds params ↔ optimizer ↔ kvstore (parity:
+python/mxnet/gluon/trainer.py; SURVEY.md §3.2/§3.3)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import base as _base
+from .. import kvstore as kvs_mod
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = []
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be list/dict/ParameterDict")
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contains_sparse_grad = False
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_str = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._params_to_init: List[Parameter] = []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise ValueError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    # ------------------------------------------------------------------
+    def _init_kvstore(self):
+        if self._kvstore_str is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = self._kvstore_str if isinstance(self._kvstore_str,
+                                                 kvs_mod.KVStoreBase) \
+                else kvs_mod.create(self._kvstore_str)
+            self._kvstore = kv
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._compression_params is not None:
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    kv.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce_grads + update, scaled by 1/batch_size."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        # Single sharded array per param: cross-device reduction is done by
+        # XLA collectives inside the jitted step (parallel module) or is a
+        # no-op single-device; dist kvstore pushes grads for PS parity.
+        if self._kvstore is None or not self._update_on_kvstore:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, p.data())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            return  # kvstore already applied the optimizer in push
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if not ignore_stale_grad:
+                    raise _base.MXNetError(
+                        f"Parameter {p.name} not initialized")
+                continue
+            updater(i, p.grad(), p.data())
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updaters[0].set_states(f.read())

@@ -1,0 +1,62 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from ..context import Context
+from ..ndarray import NDArray, ndarray as _ndmod
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0,
+               even_split=True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data size {size} not divisible by {num_slice} slices; set "
+            "even_split=False")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (parity: gluon.utils.split_and_load).
+
+    On TPU meshes the idiomatic path is a single sharded array; this eager
+    version keeps GluonCV-style multi-ctx loops working.
+    """
+    if not isinstance(data, NDArray):
+        data = _ndmod.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm ≤ max_norm."""
+    total = jnp.zeros((), dtype=jnp.float32)
+    for a in arrays:
+        total = total + jnp.sum(jnp.square(a.jax.astype(jnp.float32)))
+    norm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    for a in arrays:
+        a._rebind(a.jax * scale.astype(a.jax.dtype))
+    norm_val = float(norm) if check_isfinite else norm
+    if check_isfinite and not math.isfinite(norm_val):
+        import warnings
+        warnings.warn("nan or inf found in clip_global_norm")
+    return norm_val

@@ -1,0 +1,204 @@
+"""Weight initializers (parity: python/mxnet/initializer.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from . import base as _base
+from . import random as _random
+from .ndarray import NDArray
+
+_registry = _base.registry("initializer")
+register = _registry.register
+
+
+class Initializer:
+    """Base initializer. Subclasses implement _init_weight(name, shape, key)
+    returning a jax array."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray, explicit=False):
+        self.init_array(name if isinstance(name, str) else str(name), arr,
+                        explicit=explicit)
+
+    def init_array(self, name: str, arr: NDArray, explicit=False):
+        """`explicit=True` means the user attached THIS initializer to THIS
+        parameter (e.g. bias_initializer='ones') — it wins over the
+        name-suffix defaults, matching upstream Parameter init semantics."""
+        key = _random.next_key(arr.context)
+        if explicit:
+            arr._rebind(jnp.asarray(
+                self._init_weight(name, arr.shape, key, arr.dtype),
+                dtype=arr.dtype))
+            return
+        name_l = name.lower()
+        if name_l.endswith("gamma"):
+            val = self._init_gamma(name, arr.shape, key, arr.dtype)
+        elif name_l.endswith("beta") or name_l.endswith("bias"):
+            val = self._init_zero(name, arr.shape, key, arr.dtype)
+        elif "running_mean" in name_l or "moving_mean" in name_l:
+            val = self._init_zero(name, arr.shape, key, arr.dtype)
+        elif "running_var" in name_l or "moving_var" in name_l:
+            val = self._init_one(name, arr.shape, key, arr.dtype)
+        else:
+            val = self._init_weight(name, arr.shape, key, arr.dtype)
+        arr._rebind(jnp.asarray(val, dtype=arr.dtype))
+
+    # default aux inits
+    def _init_gamma(self, name, shape, key, dtype):
+        return jnp.ones(shape, dtype)
+
+    def _init_zero(self, name, shape, key, dtype):
+        return jnp.zeros(shape, dtype)
+
+    def _init_one(self, name, shape, key, dtype):
+        return jnp.ones(shape, dtype)
+
+    def _init_weight(self, name, shape, key, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register("zeros")
+class Zero(Initializer):
+    def _init_weight(self, name, shape, key, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+@register("ones")
+class One(Initializer):
+    def _init_weight(self, name, shape, key, dtype):
+        return jnp.ones(shape, dtype)
+
+
+@register()
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, key, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register()
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, key, dtype):
+        return jax.random.uniform(key, shape, minval=-self.scale,
+                                  maxval=self.scale,
+                                  dtype=jnp.float32).astype(dtype)
+
+
+@register()
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, key, dtype):
+        return (self.sigma * jax.random.normal(key, shape, jnp.float32)) \
+            .astype(dtype)
+
+
+@register()
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, key, dtype):
+        nout = shape[0]
+        nin = int(onp.prod(shape[1:]))
+        a = jax.random.normal(key, (nout, nin), jnp.float32)
+        q, r = jnp.linalg.qr(a if nout >= nin else a.T)
+        q = q * jnp.sign(jnp.diag(r))
+        if nout < nin:
+            q = q.T
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+@register()
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, shape, key, dtype):
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            v = jax.random.uniform(key, shape, minval=-scale, maxval=scale,
+                                   dtype=jnp.float32)
+        else:
+            v = scale * jax.random.normal(key, shape, jnp.float32)
+        return v.astype(dtype)
+
+
+@register()
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register()
+class Bilinear(Initializer):
+    def _init_weight(self, name, shape, key, dtype):
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = int(onp.ceil(shape[3] / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype)
+
+
+@register()
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, key, dtype):
+        b = onp.zeros(shape, dtype="float32")
+        num_hidden = shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+
+def create(init, **kwargs) -> Initializer:
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform()
+    if isinstance(init, str):
+        return _registry.get(init)(**kwargs)
+    raise ValueError(f"cannot create initializer from {init!r}")

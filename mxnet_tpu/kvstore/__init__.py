@@ -1,0 +1,209 @@
+"""KVStore: key-value parameter/gradient store (parity: src/kvstore/* +
+python/mxnet/kvstore/, SURVEY.md §2.4).
+
+TPU-first mapping: MXNet's comm backends (CommCPU/CommDevice/NCCL/ps-lite)
+all collapse into XLA collectives over the device mesh:
+
+- ``local``/``device``/``nccl`` → in-process aggregation; when values are
+  sharded jax.Arrays the reduction IS a psum over the ICI mesh axis
+  (performed by XLA inside the jitted step — see mxnet_tpu.parallel).
+- ``dist_sync``/``dist_async``/``dist_sync_device`` → multi-host: same
+  collective API over the global mesh after ``jax.distributed.initialize``
+  (ps-lite's scheduler/server roles are replaced by the JAX coordination
+  service; there is no server-side optimizer process — ``update_on_kvstore``
+  maps to running the optimizer on the aggregated gradient inside the store).
+- The ``KVStoreBase`` plugin registry is preserved (MXNet 2.x
+  ``python/mxnet/kvstore/base.py``) so ``kvstore='horovod'``-style plugins
+  can register a custom backend.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "KVStoreBase", "create"]
+
+_registry = _base.registry("kvstore")
+
+
+class KVStoreBase:
+    """Plugin base (parity: python/mxnet/kvstore/base.py)."""
+
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def register(klass):
+        _registry.register(klass.__name__)(klass)
+        return klass
+
+    def broadcast(self, key, value, out):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        return type(self).__name__
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def is_capable(self, capability):
+        return True
+
+
+class KVStore(KVStoreBase):
+    """In-process store covering MXNet types local/device/nccl.
+
+    Values that are sharded jax.Arrays reduce via XLA collectives; replicated
+    lists (one NDArray per device) reduce by summation with XLA handling the
+    cross-device transfers.
+    """
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        try:
+            return jax.process_index()
+        except RuntimeError:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            return jax.process_count()
+        except RuntimeError:
+            return 1
+
+    # -- core ops ---------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v[0].copy() if isinstance(v, list) else v.copy()
+
+    def _reduce(self, vals: List[NDArray]) -> jax.Array:
+        acc = vals[0].jax
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v.jax, _device_of(acc))
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, list) else [v]
+            agg = self._reduce(vals)
+            if k not in self._store:
+                raise _base.MXNetError(f"key {k} not initialized")
+            if self._updater is not None:
+                # update_on_kvstore: run optimizer on aggregated grad
+                self._updater(k, NDArray(agg), self._store[k])
+            else:
+                self._store[k]._rebind(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, o in zip(keys, outs):
+            targets = o if isinstance(o, list) else [o]
+            src = self._store[k]
+            for t in targets:
+                t._rebind(jax.device_put(src.jax, t.context.jax_device))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            vals = v if isinstance(v, list) else [v]
+            agg = self._reduce(vals)
+            if out is None:
+                self._store[k]._rebind(agg)
+        if out is not None:
+            _, outs = _normalize(key, out)
+            for (k, v), o in zip(zip(keys, values), outs):
+                vals = v if isinstance(v, list) else [v]
+                agg = self._reduce(vals)
+                targets = o if isinstance(o, list) else [o]
+                for t in targets:
+                    t._rebind(jax.device_put(agg, t.context.jax_device))
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # TPU build keeps embeddings dense (gather/scatter-add shard well);
+        # honor the API by pulling the full value.
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer --------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    @property
+    def updater(self):
+        return self._updater
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit PS compression has no profitable TPU analogue (ICI allreduce
+        # is not the bottleneck it was for ZMQ PS); accept & ignore.
+        self._compression = compression_params
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise _base.MXNetError("kvstore has no optimizer")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise _base.MXNetError("kvstore has no optimizer")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _device_of(arr):
+    devs = getattr(arr, "devices", None)
+    if devs is not None:
+        ds = arr.devices()
+        return next(iter(ds))
+    return None
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+for _t in ("local", "device", "nccl", "tpu", "dist_sync", "dist_async",
+           "dist_sync_device", "dist_async_device", "dist"):
+    _registry.register(_t)(KVStore)
+
+
+def create(name="local") -> KVStore:
+    """Parity: mx.kv.create('device'|'nccl'|'dist_sync'|...)."""
+    cls = _registry.get(name)
+    if cls is KVStore:
+        return KVStore(name)
+    return cls()

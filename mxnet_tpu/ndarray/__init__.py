@@ -1,0 +1,25 @@
+"""mxnet_tpu.nd — imperative NDArray API (parity: mx.nd)."""
+from .ndarray import (NDArray, array, from_jax, zeros, ones, full, empty,
+                      arange, eye, linspace, concatenate)
+from .ops import *  # noqa: F401,F403
+from . import ops
+from .ops import invoke
+
+# convenience: mx.nd.waitall parity
+import jax as _jax
+
+
+def waitall():
+    """Block until all async work completes (parity: mx.nd.waitall)."""
+    (_jax.effects_barrier if hasattr(_jax, "effects_barrier") else
+     (lambda: None))()
+
+
+def save(fname, data):
+    from ..utils.serialization import save as _save
+    _save(fname, data)
+
+
+def load(fname):
+    from ..utils.serialization import load as _load
+    return _load(fname)

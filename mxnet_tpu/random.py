@@ -1,0 +1,115 @@
+"""Stateful RNG facade over JAX's functional PRNG.
+
+Parity target: ``python/mxnet/random.py`` + per-device parallel RNG resources
+(``src/resource.cc`` kParallelRandom).  MXNet exposes a *stateful* per-context
+RNG (``mx.random.seed(n)``); JAX is functional (explicit keys).  Design:
+
+- A process-global :class:`RandomState` holds one root key per Context plus a
+  monotonically increasing counter; every stochastic op calls
+  :func:`next_key` which folds the counter in — stateful semantics, functional
+  core.
+- Under ``hybridize``/``jit`` tracing, a concrete key baked into the trace
+  would freeze randomness across calls (wrong dropout).  The CachedOp
+  machinery installs a *trace key provider* (`push_trace_key`): while tracing,
+  ``next_key()`` derives keys from a key that is an *argument* of the jitted
+  function, so each invocation gets fresh randomness with zero retraces.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import numpy as onp
+
+from .context import Context, current_context
+
+__all__ = ["seed", "next_key", "RandomState", "push_trace_key",
+           "pop_trace_key", "get_state"]
+
+_tls = threading.local()
+
+
+class RandomState:
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.seed(seed_)
+
+    def seed(self, seed_: int, ctx: Optional[Context] = None):
+        with getattr(self, "_lock", threading.Lock()):
+            if ctx is None or not hasattr(self, "_keys"):
+                self._keys: Dict[Context, jax.Array] = {}
+                self._counters: Dict[Context, int] = {}
+                self._base_seed = int(seed_)
+            target = [ctx] if ctx is not None else [None]
+            for c in target:
+                if c is None:
+                    continue
+                self._keys[c] = jax.random.PRNGKey(int(seed_) + hash(c) % 2**16)
+                self._counters[c] = 0
+
+    def _root(self, ctx: Context) -> jax.Array:
+        if ctx not in self._keys:
+            self._keys[ctx] = jax.random.PRNGKey(
+                self._base_seed + (Context.devtype2id[ctx.device_type] << 8)
+                + ctx.device_id)
+            self._counters[ctx] = 0
+        return self._keys[ctx]
+
+    def next_key(self, ctx: Optional[Context] = None) -> jax.Array:
+        ctx = ctx or current_context()
+        provider = _trace_providers()
+        if provider:
+            return provider[-1].next()
+        with self._lock:
+            root = self._root(ctx)
+            c = self._counters[ctx]
+            self._counters[ctx] = c + 1
+        return jax.random.fold_in(root, c)
+
+
+class _TraceKeyProvider:
+    """Derives per-op keys from a traced key argument during jit tracing."""
+
+    def __init__(self, key):
+        self.key = key
+        self.count = 0
+        self.used = False
+
+    def next(self):
+        self.used = True
+        k = jax.random.fold_in(self.key, self.count)
+        self.count += 1
+        return k
+
+
+def _trace_providers() -> List[_TraceKeyProvider]:
+    if not hasattr(_tls, "providers"):
+        _tls.providers = []
+    return _tls.providers
+
+
+def push_trace_key(key) -> _TraceKeyProvider:
+    p = _TraceKeyProvider(key)
+    _trace_providers().append(p)
+    return p
+
+
+def pop_trace_key() -> _TraceKeyProvider:
+    return _trace_providers().pop()
+
+
+_STATE = RandomState(onp.random.randint(0, 2**31 - 1))
+
+
+def get_state() -> RandomState:
+    return _STATE
+
+
+def seed(seed_state: int, ctx: Optional[Context] = None):
+    """mx.random.seed parity: reseed all contexts, or one."""
+    _STATE.seed(seed_state, ctx=ctx)
+
+
+def next_key(ctx: Optional[Context] = None) -> jax.Array:
+    return _STATE.next_key(ctx)

@@ -1,0 +1,109 @@
+"""Test helpers (parity: python/mxnet/test_utils.py — assert_almost_equal,
+check_numeric_gradient, rand_ndarray, with_seed)."""
+from __future__ import annotations
+
+import functools
+import random as pyrandom
+
+import numpy as onp
+
+from . import random as _random
+from .ndarray import NDArray, array
+
+__all__ = ["default_rtol", "default_atol", "assert_almost_equal",
+           "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
+           "with_seed", "same"]
+
+
+def default_rtol(dtype=onp.float32):
+    return {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
+            onp.dtype(onp.float64): 1e-6}.get(onp.dtype(dtype), 1e-4)
+
+
+def default_atol(dtype=onp.float32):
+    return {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-5,
+            onp.dtype(onp.float64): 1e-7}.get(onp.dtype(dtype), 1e-5)
+
+
+def _np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def same(a, b):
+    return onp.array_equal(_np(a), _np(b))
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a, b = _np(a), _np(b)
+    rtol = rtol if rtol is not None else default_rtol(a.dtype)
+    atol = atol if atol is not None else default_atol(a.dtype)
+    onp.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                                err_msg=f"{names[0]} vs {names[1]}")
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, scale=1.0):
+    return array(onp.random.uniform(-scale, scale, size=shape)
+                 .astype(dtype), ctx=ctx)
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference gradient check of `fn` (NDArray-in, scalar
+    NDArray-out) against the autograd tape."""
+    from . import autograd
+    inputs = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for k, x in enumerate(inputs):
+        base = x.asnumpy().astype(onp.float64)
+        num_grad = onp.zeros_like(base)
+        flat = base.reshape(-1)
+        ng = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            with autograd.pause():
+                fp = float(fn(*[array(base.astype(onp.float32))
+                                if j == k else inputs[j]
+                                for j in range(len(inputs))]).asscalar())
+            flat[i] = orig - eps
+            with autograd.pause():
+                fm = float(fn(*[array(base.astype(onp.float32))
+                                if j == k else inputs[j]
+                                for j in range(len(inputs))]).asscalar())
+            flat[i] = orig
+            ng[i] = (fp - fm) / (2 * eps)
+        onp.testing.assert_allclose(analytic[k], num_grad, rtol=rtol,
+                                    atol=atol,
+                                    err_msg=f"gradient of input {k}")
+
+
+def with_seed(seed=None):
+    """Decorator seeding python/numpy/framework RNGs per test (parity:
+    tests/python/unittest/common.py)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            s = seed if seed is not None else onp.random.randint(0, 2**31)
+            pyrandom.seed(s)
+            onp.random.seed(s)
+            _random.seed(s)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"Test failed with seed {s}")
+                raise
+        return wrapper
+
+    return deco

@@ -1,0 +1,90 @@
+"""NDArray container (de)serialization.
+
+Parity target: ``NDArray::Save/Load`` dmlc::Stream format
+(src/ndarray/ndarray.cc) and ``mx.nd.save/load``.  Format here is a
+self-describing binary container (magic ``MXTPU1\\n``) with a JSON header —
+same role (named dict / list of arrays, context-stripped), TPU-simple
+implementation.  Orbax handles pod-scale sharded checkpoints
+(mxnet_tpu.utils.checkpoint); this format covers the
+save_parameters/export/Trainer.save_states surface.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from ..ndarray import NDArray, array
+
+MAGIC = b"MXTPU1\n"
+
+
+def _to_numpy(v: NDArray) -> onp.ndarray:
+    a = v.asnumpy()
+    if a.dtype == onp.dtype("bfloat16") if hasattr(onp, "bfloat16") else False:
+        return a
+    return a
+
+
+def save(fname: str, data: Union[Dict[str, NDArray], List[NDArray],
+                                 NDArray]):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        names = [f"arr_{i}" for i in range(len(data))]
+        arrays = list(data)
+        keyed = False
+    else:
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+        keyed = True
+    metas = []
+    blobs = []
+    for name, arr in zip(names, arrays):
+        a = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
+        dtype_name = str(a.dtype)
+        if dtype_name == "bfloat16":
+            payload = a.view(onp.uint16).tobytes()
+        else:
+            payload = a.tobytes()
+        metas.append({"name": name, "shape": list(a.shape),
+                      "dtype": dtype_name, "nbytes": len(payload)})
+        blobs.append(payload)
+    header = json.dumps({"keyed": keyed, "arrays": metas}).encode()
+    with open(fname, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for b in blobs:
+            f.write(b)
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise IOError(f"{fname}: not an mxnet_tpu NDArray file")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        out = {}
+        order = []
+        for meta in header["arrays"]:
+            raw = f.read(meta["nbytes"])
+            dtype_name = meta["dtype"]
+            if dtype_name == "bfloat16":
+                import jax.numpy as jnp
+                a = onp.frombuffer(raw, dtype=onp.uint16) \
+                    .reshape(meta["shape"])
+                nd = array(a.view(jnp.bfloat16) if hasattr(a, "view")
+                           else a, dtype="bfloat16")
+            else:
+                a = onp.frombuffer(raw, dtype=onp.dtype(dtype_name)) \
+                    .reshape(meta["shape"])
+                nd = array(a)
+            out[meta["name"]] = nd
+            order.append(nd)
+    if header["keyed"]:
+        return out
+    return order

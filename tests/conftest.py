@@ -1,0 +1,17 @@
+"""Test env: force CPU XLA with 8 virtual devices so multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4: CPU-XLA is the reference
+backend sharing the compiler with TPU).
+
+Note: the axon TPU plugin ignores the JAX_PLATFORMS env var, so the platform
+is forced through jax.config before any device is touched.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

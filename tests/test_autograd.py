@@ -1,0 +1,164 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_branches():
+    x = nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        a = nd.exp(x)
+        b = nd.sin(x)
+        y = (a * b + a).sum()
+    y.backward()
+    xe = x.asnumpy()
+    ref = onp.exp(xe) * onp.sin(xe) + onp.exp(xe) * onp.cos(xe) + onp.exp(xe)
+    assert_almost_equal(x.grad, ref, rtol=1e-5)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 300.0]))
+
+
+def test_grad_req_add_and_null():
+    x = nd.array([1.0, 1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0, 6.0]))
+
+    z = nd.array([1.0])
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        y = (z * 2).sum()
+    y.backward()
+    assert_almost_equal(z.grad, onp.zeros(1))
+
+
+def test_pause_and_is_recording():
+    x = nd.array([2.0])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        y = x * x
+        with autograd.pause():
+            assert not autograd.is_recording()
+            z = x * 10  # not recorded
+        w = (y + z.detach()).sum()
+    w.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))
+
+
+def test_train_predict_mode():
+    with autograd.record(train_mode=False):
+        assert autograd.is_recording()
+        assert not autograd.is_training()
+        with autograd.train_mode():
+            assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 3 * x.asnumpy() ** 2)
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+        grads = autograd.grad(y, [x])
+    assert_almost_equal(grads[0], 2 * x.asnumpy())
+    # .grad buffer untouched by autograd.grad
+    assert_almost_equal(x.grad, onp.zeros(2))
+
+
+def test_multiple_heads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = (x * 2).sum()
+        y2 = (x * x).sum()
+    autograd.backward([y1, y2])
+    assert_almost_equal(x.grad, 2 + 2 * x.asnumpy())
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    fn = Sigmoid()
+    with autograd.record():
+        y = fn(x).sum()
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_views_in_autograd():
+    x = nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x[0] * 2  # getitem dispatched as op while recording
+        z = y.sum()
+    z.backward()
+    expected = onp.zeros((2, 3), dtype=onp.float32)
+    expected[0] = 2
+    assert_almost_equal(x.grad, expected)
+
+
+def test_backward_non_scalar_default_head():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()  # implicit ones head
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_backward_scalar_head_direct():
+    """Regression: autograd.backward accepts a bare NDArray head."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        loss = (x * x).sum()
+    autograd.backward(loss)
+    assert_almost_equal(x.grad, 2 * x.asnumpy())

@@ -1,0 +1,256 @@
+"""Gluon block tests (parity model: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def _new_mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    return net
+
+
+def test_dense_shapes_and_deferred_init():
+    net = nn.Dense(16)
+    net.initialize()
+    x = nd.random_normal(shape=(4, 7))
+    y = net(x)
+    assert y.shape == (4, 16)
+    assert net.weight.shape == (16, 7)
+    # flatten=False keeps trailing dims
+    net2 = nn.Dense(8, flatten=False)
+    net2.initialize()
+    y2 = net2(nd.zeros((2, 5, 3)))
+    assert y2.shape == (2, 5, 8)
+
+
+def test_explicit_in_units_no_deferred():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    assert net.weight.data().shape == (4, 3)
+
+
+@with_seed(7)
+def test_hybridize_equivalence():
+    net = _new_mlp()
+    net.initialize(mx.init.Xavier())
+    x = nd.random_normal(shape=(5, 20))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    assert_almost_equal(imp, hyb, rtol=1e-5, atol=1e-6)
+    # second call uses the jit cache
+    hyb2 = net(x).asnumpy()
+    assert_almost_equal(hyb, hyb2)
+
+
+@with_seed(8)
+def test_hybridize_training_gradients_match():
+    x = nd.random_normal(shape=(6, 12))
+    y = nd.array(onp.random.randint(0, 10, (6,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    grads = []
+    for hybridize in (False, True):
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(10))
+        net.initialize(mx.init.Xavier(), force_reinit=True)
+        _ = net(x)
+        if hybridize:
+            net.hybridize()
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        grads.append([p.grad().asnumpy() for _, p in
+                      sorted(net.collect_params().items())])
+    for ga, gb in zip(*grads):
+        assert_almost_equal(ga, gb, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(pool_size=2),
+            nn.Conv2D(16, kernel_size=3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    x = nd.random_normal(shape=(2, 3, 16, 16))
+    y = net(x)
+    assert y.shape == (2, 10)
+    net.hybridize()
+    y2 = net(x)
+    assert_almost_equal(y, y2, rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.random_normal(shape=(8, 4, 3, 3), scale=2.0)
+    with autograd.record():
+        y_train = bn(x)
+    # training: normalized by batch stats → near zero mean/unit var
+    ytn = y_train.asnumpy()
+    assert abs(ytn.mean(axis=(0, 2, 3))).max() < 1e-5
+    assert abs(ytn.var(axis=(0, 2, 3)) - 1).max() < 1e-3
+    # eval mode uses moving stats (≠ batch stats after 1 update)
+    y_eval = bn(x)
+    assert not onp.allclose(y_eval.asnumpy(), ytn)
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((100, 100))
+    with autograd.record():
+        y = do(x)
+    zeros = float((y.asnumpy() == 0).mean())
+    assert 0.3 < zeros < 0.7
+    y_eval = do(x)
+    assert_almost_equal(y_eval, x.asnumpy())
+
+
+@with_seed(3)
+def test_dropout_fresh_randomness_under_hybridize():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    do.hybridize()
+    x = nd.ones((64, 64))
+    with autograd.record():
+        m1 = do(x).asnumpy()
+        m2 = do(x).asnumpy()
+    assert not onp.array_equal(m1, m2), \
+        "dropout mask must differ between calls under hybridize"
+
+
+def test_save_load_parameters(tmp_path):
+    net = _new_mlp()
+    net.initialize()
+    x = nd.random_normal(shape=(2, 6))
+    y1 = net(x)
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = _new_mlp()
+    net2.load_parameters(f)
+    y2 = net2(x)
+    assert_almost_equal(y1, y2)
+
+
+def test_load_parameters_errors(tmp_path):
+    net = _new_mlp()
+    net.initialize()
+    _ = net(nd.zeros((1, 4)))
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    other = nn.Dense(3)
+    with pytest.raises(mx.MXNetError):
+        other.load_parameters(f)
+    other.load_parameters(f, allow_missing=True, ignore_extra=True)
+
+
+def test_collect_params_select():
+    net = _new_mlp()
+    net.initialize()
+    _ = net(nd.zeros((1, 4)))
+    all_params = net.collect_params()
+    assert len(all_params) == 4
+    only_w = net.collect_params(".*weight")
+    assert len(only_w) == 2
+
+
+def test_parameter_api():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert_almost_equal(p.data(), onp.ones((3, 4)))
+    p.set_data(nd.zeros((3, 4)))
+    assert_almost_equal(p.data(), onp.zeros((3, 4)))
+    assert p.list_ctx()[0] == p.data().context
+    p.zero_grad()
+    p.cast("float16")
+    assert p.data().dtype == onp.float16
+
+
+def test_constant_parameter():
+    c = gluon.Constant("c", [[1.0, 2.0]])
+    assert c.grad_req == "null"
+    assert_almost_equal(c.data(), onp.array([[1.0, 2.0]], dtype=onp.float32))
+
+
+def test_sequential_container_api():
+    net = nn.Sequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    sliced = net[0:1]
+    assert len(sliced) == 1
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(20, 8)
+    emb.initialize()
+    idx = nd.array([[1, 2], [3, 4]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 8)
+
+
+def test_prelu_elu_selu_gelu():
+    x = nd.random_normal(shape=(3, 5))
+    for blk in (nn.LeakyReLU(0.1), nn.ELU(), nn.SELU(), nn.GELU(),
+                nn.Swish(), nn.Activation("softrelu")):
+        blk.initialize()
+        y = blk(x)
+        assert y.shape == x.shape
+    pr = nn.PReLU()
+    pr.initialize()
+    assert pr(x).shape == x.shape
+
+
+def test_block_apply_and_repr():
+    net = _new_mlp()
+    net.initialize()
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert "Dense" in seen and "HybridSequential" in seen
+    assert "Dense" in repr(net)
+
+
+def test_lambda_blocks():
+    lam = nn.HybridLambda(lambda F, x: F.relu(x))
+    y = lam(nd.array([-1.0, 1.0]))
+    assert_almost_equal(y, onp.array([0.0, 1.0]))
+    lam2 = nn.Lambda("tanh")
+    assert_almost_equal(lam2(nd.array([0.0])), onp.array([0.0]))
+
+
+def test_static_arg_changes_recompile():
+    """Regression: jit-cache key must include non-NDArray args."""
+
+    class Scaler(nn.HybridBlock):
+        def forward(self, x, flag):
+            return x + 1 if flag else x + 2
+
+    net = Scaler()
+    net.initialize()
+    net.hybridize()
+    x = nd.array([1.0])
+    assert net(x, True).asscalar() == 2.0
+    assert net(x, False).asscalar() == 3.0
+
+
+def test_explicit_initializer_honored():
+    """Regression: bias_initializer must not be overridden by name-suffix."""
+    net = nn.Dense(3, in_units=2, bias_initializer="ones")
+    net.initialize()
+    assert_almost_equal(net.bias.data(), onp.ones(3))
+    p = gluon.Parameter("h2h_bias", shape=(8,),
+                        init=mx.init.LSTMBias(forget_bias=1.0))
+    p.initialize()
+    ref = onp.zeros(8, dtype=onp.float32)
+    ref[2:4] = 1.0
+    assert_almost_equal(p.data(), ref)

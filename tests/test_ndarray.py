@@ -1,0 +1,201 @@
+"""NDArray facade tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32  # f64 input downcasts like MXNet
+    b = nd.zeros((3, 4))
+    assert_almost_equal(b, onp.zeros((3, 4)))
+    c = nd.ones((2,), dtype="int32")
+    assert c.dtype == onp.int32
+    d = nd.full((2, 2), 7.5)
+    assert_almost_equal(d, onp.full((2, 2), 7.5))
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e, onp.arange(0, 10, 2, dtype=onp.float32))
+    f = nd.eye(3)
+    assert_almost_equal(f, onp.eye(3))
+
+
+def test_arithmetic_broadcast():
+    a = nd.array(onp.arange(6).reshape(2, 3))
+    b = nd.array([[1.0], [2.0]])
+    assert_almost_equal(a + b, a.asnumpy() + b.asnumpy())
+    assert_almost_equal(a - b, a.asnumpy() - b.asnumpy())
+    assert_almost_equal(a * b, a.asnumpy() * b.asnumpy())
+    assert_almost_equal(a / (b + 1), a.asnumpy() / (b.asnumpy() + 1))
+    assert_almost_equal(2.0 ** a, 2.0 ** a.asnumpy())
+    assert_almost_equal(10.0 - a, 10.0 - a.asnumpy())
+    assert_almost_equal(-a, -a.asnumpy())
+
+
+def test_inplace_ops():
+    a = nd.ones((2, 3))
+    a += 2
+    assert_almost_equal(a, onp.full((2, 3), 3.0))
+    a *= 2
+    assert_almost_equal(a, onp.full((2, 3), 6.0))
+    a /= 3
+    assert_almost_equal(a, onp.full((2, 3), 2.0))
+    a -= 1
+    assert_almost_equal(a, onp.ones((2, 3)))
+
+
+def test_views_alias_writeback():
+    x = nd.arange(0, 12).reshape(3, 4)
+    y = x[1]
+    y += 100
+    assert_almost_equal(x[1], onp.arange(4, 8, dtype=onp.float32) + 100)
+    z = x[0:2]
+    z *= 0
+    assert float(x.asnumpy()[:2].sum()) == 0
+    # setitem forms
+    x[2, 3] = -1
+    assert x.asnumpy()[2, 3] == -1
+    x[:, 0] = 5
+    assert (x.asnumpy()[:, 0] == 5).all()
+    x[:] = 9
+    assert (x.asnumpy() == 9).all()
+
+
+def test_advanced_indexing():
+    x = nd.array(onp.arange(12).reshape(3, 4))
+    idx = nd.array([0, 2], dtype="int32")
+    got = x[idx]
+    assert_almost_equal(got, x.asnumpy()[[0, 2]])
+    mask = x > 5
+    assert mask.shape == (3, 4)
+
+
+def test_reshape_special_codes():
+    x = nd.zeros((2, 3, 4))
+    assert x.reshape((-1,)).shape == (24,)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert nd.reshape(x, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(-3, 4)).shape == (6, 4)
+    assert nd.reshape(x, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert x.reshape((2, -1)).shape == (2, 12)
+
+
+def test_reductions_and_methods():
+    a = nd.array(onp.random.rand(3, 4, 5).astype(onp.float32))
+    npa = a.asnumpy()
+    assert_almost_equal(a.sum(), npa.sum(), rtol=1e-4)
+    assert_almost_equal(a.sum(axis=1), npa.sum(axis=1), rtol=1e-4)
+    assert_almost_equal(a.mean(axis=(0, 2)), npa.mean(axis=(0, 2)))
+    assert_almost_equal(a.max(axis=1), npa.max(axis=1))
+    assert_almost_equal(a.min(), npa.min())
+    assert int(a.argmax().asscalar()) == npa.argmax()
+    assert_almost_equal(a.transpose((2, 0, 1)), npa.transpose(2, 0, 1))
+    assert_almost_equal(a.flatten(), npa.reshape(3, -1))
+    assert a.expand_dims(0).shape == (1, 3, 4, 5)
+    assert a.T.shape == (5, 4, 3)
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert a.asscalar() == pytest.approx(3.5)
+    assert float(a) == pytest.approx(3.5)
+    assert int(nd.array([7])) == 7
+    assert bool(nd.array([1]))
+    with pytest.raises(ValueError):
+        bool(nd.zeros((2,)))
+    assert len(nd.zeros((5, 2))) == 5
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == onp.float16
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, onp.ones((2, 2)))
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    assert_almost_equal(parts[0], onp.ones((2, 3)))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.mxtpu")
+    data = {"w": nd.random_normal(shape=(3, 4)),
+            "b": nd.arange(0, 5)}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], data["w"])
+    # list form
+    nd.save(fname, [data["w"]])
+    arr_list = nd.load(fname)
+    assert isinstance(arr_list, list)
+    assert_almost_equal(arr_list[0], data["w"])
+
+
+@with_seed(0)
+def test_random_ops():
+    u = nd.random_uniform(low=0, high=1, shape=(1000,))
+    assert 0.4 < float(u.mean().asscalar()) < 0.6
+    n = nd.random_normal(loc=2.0, scale=0.5, shape=(2000,))
+    assert 1.8 < float(n.mean().asscalar()) < 2.2
+    mx.random.seed(7)
+    a = nd.random_uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random_uniform(shape=(5,)).asnumpy()
+    assert (a == b).all()
+
+
+def test_take_pick_gather():
+    x = nd.array(onp.arange(12).reshape(3, 4))
+    t = nd.take(x, nd.array([0, 2], dtype="int32"), axis=0)
+    assert_almost_equal(t, x.asnumpy()[[0, 2]])
+    p = nd.pick(x, nd.array([0, 1, 2]), axis=1)
+    assert_almost_equal(p, onp.array([0., 5., 10.]))
+    oh = nd.one_hot(nd.array([0, 2]), 4)
+    assert_almost_equal(oh, onp.eye(4, dtype=onp.float32)[[0, 2]])
+
+
+def test_ndarray_index_dtype_coercion():
+    """Regression: float32 NDArray indexers (the MXNet default) must work."""
+    x = nd.array(onp.arange(12).reshape(3, 4))
+    got = x[nd.array([0, 2])]  # float32 index array
+    assert_almost_equal(got, x.asnumpy()[[0, 2]])
+    mask = x > 5
+    x[mask] = 0.0
+    assert x.asnumpy().max() == 5
+
+
+def test_grouped_deconvolution():
+    """Regression: Deconvolution with num_group > 1."""
+    x = nd.random_normal(shape=(1, 4, 5, 5))
+    w = nd.random_normal(shape=(4, 2, 3, 3))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=4, num_group=2)
+    assert out.shape == (1, 4, 7, 7)
+
+
+def test_sample_multinomial_shapes():
+    p = nd.array([0.1, 0.2, 0.3, 0.4])
+    s = nd.sample_multinomial(p, shape=(2, 3))
+    assert s.shape == (2, 3)
+    s2, logp = nd.sample_multinomial(p, shape=5, get_prob=True)
+    assert s2.shape == (5,) and logp.shape == (5,)
+    batch = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    sb = nd.sample_multinomial(batch)
+    assert sb.shape == (2,)

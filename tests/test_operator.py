@@ -1,0 +1,216 @@
+"""Operator numerics vs numpy + finite-difference gradients (parity model:
+tests/python/unittest/test_operator.py; SURVEY.md §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, with_seed)
+
+
+@with_seed(42)
+def test_unary_numerics():
+    x = onp.random.uniform(0.1, 2.0, (3, 4)).astype(onp.float32)
+    a = nd.array(x)
+    for name, ref in [("exp", onp.exp), ("log", onp.log),
+                      ("sqrt", onp.sqrt), ("square", onp.square),
+                      ("abs", onp.abs), ("sign", onp.sign),
+                      ("sin", onp.sin), ("cos", onp.cos),
+                      ("tanh", onp.tanh), ("floor", onp.floor),
+                      ("ceil", onp.ceil), ("log1p", onp.log1p),
+                      ("expm1", onp.expm1), ("cbrt", onp.cbrt),
+                      ("reciprocal", lambda v: 1 / v)]:
+        assert_almost_equal(getattr(nd, name)(a), ref(x), rtol=1e-5,
+                            atol=1e-5, names=(name, "np"))
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + onp.exp(-x)))
+    assert_almost_equal(nd.relu(nd.array(x - 1)), onp.maximum(x - 1, 0))
+    assert_almost_equal(nd.rsqrt(a), 1 / onp.sqrt(x), rtol=1e-5)
+
+
+@with_seed(1)
+def test_binary_broadcast_numerics():
+    a = onp.random.randn(2, 3, 4).astype(onp.float32)
+    b = onp.random.randn(3, 1).astype(onp.float32)
+    na, nb = nd.array(a), nd.array(b)
+    assert_almost_equal(nd.broadcast_add(na, nb), a + b)
+    assert_almost_equal(nd.broadcast_mul(na, nb), a * b)
+    assert_almost_equal(nd.broadcast_maximum(na, nb), onp.maximum(a, b))
+    assert_almost_equal(nd.broadcast_power(nd.abs(na) + 1, nb),
+                        (onp.abs(a) + 1) ** b, rtol=1e-4)
+    assert_almost_equal(nd.maximum(na, 0.0), onp.maximum(a, 0))
+
+
+def test_dot_variants():
+    a = onp.random.randn(4, 5).astype(onp.float32)
+    b = onp.random.randn(5, 3).astype(onp.float32)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True), a @ b,
+        rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True), a @ b,
+        rtol=1e-4)
+    # batch_dot
+    x = onp.random.randn(6, 4, 5).astype(onp.float32)
+    y = onp.random.randn(6, 5, 2).astype(onp.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)), x @ y,
+                        rtol=1e-4)
+    # 3D·2D MXNet dot contracts last axis of lhs with first of rhs
+    z = onp.random.randn(2, 3, 5).astype(onp.float32)
+    assert_almost_equal(nd.dot(nd.array(z), nd.array(b)),
+                        onp.tensordot(z, b, axes=([2], [0])), rtol=1e-4)
+
+
+def test_softmax_family():
+    x = onp.random.randn(4, 7).astype(onp.float32)
+    sm = nd.softmax(nd.array(x), axis=-1).asnumpy()
+    ex = onp.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(sm, ex / ex.sum(-1, keepdims=True))
+    lsm = nd.log_softmax(nd.array(x), axis=-1).asnumpy()
+    assert_almost_equal(lsm, onp.log(ex / ex.sum(-1, keepdims=True)),
+                        atol=1e-5)
+    # length-masked softmax
+    length = nd.array([3, 7, 1, 5], dtype="int32")
+    sm_len = nd.softmax(nd.array(x), axis=-1, length=length).asnumpy()
+    assert sm_len[0, 3:].sum() == pytest.approx(0.0, abs=1e-6)
+    assert sm_len[0, :3].sum() == pytest.approx(1.0, rel=1e-5)
+    assert sm_len[1].sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def test_fully_connected_and_conv_numerics():
+    x = onp.random.randn(2, 6).astype(onp.float32)
+    w = onp.random.randn(4, 6).astype(onp.float32)
+    b = onp.random.randn(4).astype(onp.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=4)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+
+    # conv vs scipy-style direct computation
+    img = onp.random.randn(1, 1, 5, 5).astype(onp.float32)
+    ker = onp.random.randn(1, 1, 3, 3).astype(onp.float32)
+    out = nd.Convolution(nd.array(img), nd.array(ker), kernel=(3, 3),
+                         num_filter=1, no_bias=True).asnumpy()
+    ref = onp.zeros((3, 3), dtype=onp.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[i, j] = (img[0, 0, i:i + 3, j:j + 3] * ker[0, 0]).sum()
+    assert_almost_equal(out[0, 0], ref, rtol=1e-4)
+
+
+def test_pooling_numerics():
+    x = onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="max").asnumpy()
+    assert_almost_equal(mp[0, 0], onp.array([[5, 7], [13, 15]],
+                                            dtype=onp.float32))
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                    pool_type="avg").asnumpy()
+    assert_almost_equal(ap[0, 0], onp.array([[2.5, 4.5], [10.5, 12.5]],
+                                            dtype=onp.float32))
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert gp.shape == (1, 1, 1, 1) and gp.flatten()[0] == 15
+
+
+def test_norm_layers_numerics():
+    x = onp.random.randn(2, 3, 4).astype(onp.float32)
+    g = onp.random.rand(4).astype(onp.float32)
+    b = onp.random.randn(4).astype(onp.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), axis=-1,
+                       eps=1e-5).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / onp.sqrt(var + 1e-5) * g + b
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_take():
+    w = onp.random.randn(10, 4).astype(onp.float32)
+    idx = onp.array([1, 3, 1, 9])
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10,
+                       output_dim=4)
+    assert_almost_equal(out, w[idx])
+
+
+@with_seed(3)
+def test_gradients_elemwise():
+    check_numeric_gradient(lambda x: (nd.exp(x) * x).sum(),
+                           [onp.random.rand(3, 2).astype(onp.float32)])
+    check_numeric_gradient(lambda x: nd.tanh(x).sum(),
+                           [onp.random.randn(4).astype(onp.float32)])
+    check_numeric_gradient(
+        lambda x, y: (x * y + nd.sigmoid(x)).sum(),
+        [onp.random.rand(3).astype(onp.float32),
+         onp.random.rand(3).astype(onp.float32)])
+
+
+@with_seed(4)
+def test_gradients_matmul_softmax():
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [onp.random.rand(3, 4).astype(onp.float32) * 0.5,
+         onp.random.rand(4, 2).astype(onp.float32) * 0.5])
+    check_numeric_gradient(
+        lambda x: (nd.softmax(x, axis=-1) *
+                   nd.array(onp.arange(4, dtype=onp.float32))).sum(),
+        [onp.random.randn(2, 4).astype(onp.float32)], rtol=2e-2)
+
+
+def test_gradient_conv():
+    check_numeric_gradient(
+        lambda img, ker: nd.Convolution(
+            img, ker, kernel=(3, 3), num_filter=2, pad=(1, 1),
+            no_bias=True).sum(),
+        [onp.random.randn(1, 1, 4, 4).astype(onp.float32) * 0.3,
+         onp.random.randn(2, 1, 3, 3).astype(onp.float32) * 0.3],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_topk_sort_argsort():
+    x = onp.random.randn(3, 6).astype(onp.float32)
+    k = nd.topk(nd.array(x), k=2, ret_typ="indices").asnumpy()
+    ref = onp.argsort(-x, axis=-1)[:, :2]
+    assert (k.astype(onp.int64) == ref).all()
+    s = nd.sort(nd.array(x), axis=-1).asnumpy()
+    assert_almost_equal(s, onp.sort(x, axis=-1))
+
+
+def test_where_clip_misc():
+    x = onp.random.randn(3, 4).astype(onp.float32)
+    c = x > 0
+    out = nd.where(nd.array(c.astype(onp.float32)), nd.array(x),
+                   nd.array(-x))
+    assert_almost_equal(out, onp.abs(x))
+    assert_almost_equal(nd.clip(nd.array(x), -0.5, 0.5),
+                        x.clip(-0.5, 0.5))
+    assert_almost_equal(nd.smooth_l1(nd.array(x), scalar=1.0),
+                        onp.where(onp.abs(x) < 1, 0.5 * x * x,
+                                  onp.abs(x) - 0.5))
+
+
+def test_sequence_ops():
+    x = onp.random.randn(5, 3, 2).astype(onp.float32)  # (T, B, C)
+    ln = nd.array([2, 5, 3], dtype="int32")
+    masked = nd.SequenceMask(nd.array(x), ln, use_sequence_length=True,
+                             value=0).asnumpy()
+    assert masked[2:, 0].sum() == 0
+    assert_almost_equal(masked[:2, 0], x[:2, 0])
+    last = nd.SequenceLast(nd.array(x), ln,
+                           use_sequence_length=True).asnumpy()
+    assert_almost_equal(last[0], x[1, 0])
+    assert_almost_equal(last[1], x[4, 1])
+    rev = nd.SequenceReverse(nd.array(x), ln,
+                             use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[1, 0], x[0, 0])
+    assert_almost_equal(rev[2, 0], x[2, 0])  # beyond length: unchanged
+
+
+def test_transformer_contrib_ops():
+    T, B, H, E = 4, 2, 2, 8
+    qkv = onp.random.randn(T, B, 3 * E).astype(onp.float32)
+    scores = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert scores.shape == (B * H, T, T)
+    att = nd.softmax(scores, axis=-1)
+    out = nd.interleaved_matmul_selfatt_valatt(nd.array(qkv), att, heads=H)
+    assert out.shape == (T, B, E)
