@@ -1,0 +1,85 @@
+"""Benchmark: GPT-2 124M training throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 0.45 (the BASELINE.json north-star target of
+≥45% MFU; the reference tree shipped no published numbers — see BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as onp
+
+
+def peak_flops_per_device() -> float:
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    # bf16 peak per chip
+    table = {
+        "tpu v5 lite": 197e12, "tpu v5e": 197e12, "tpu v5": 459e12,
+        "tpu v4": 275e12, "tpu v6 lite": 918e12, "tpu v6e": 918e12,
+    }
+    for k, v in table.items():
+        if kind.startswith(k):
+            return v
+    return 50e12 if d.platform == "cpu" else 200e12
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        batch, seq = 8, 1024
+        net = get_gpt2("gpt2_124m", max_length=seq, dropout=0.0)
+        n_params = 124e6
+        steps = 20
+    else:  # CPU sanity mode
+        batch, seq = 4, 128
+        net = get_gpt2("gpt2_124m", vocab_size=1024, units=256,
+                       num_layers=4, num_heads=8, max_length=seq,
+                       dropout=0.0)
+        n_params = 4 * 12 * 256 * 256 + 1024 * 256
+        steps = 5
+    net.initialize()
+    mesh = par.make_mesh()
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "adam", loss=gpt2_lm_loss,
+            optimizer_params={"learning_rate": 1e-4}, mesh=mesh)
+        toks = mx.nd.array(
+            onp.random.randint(0, net.vocab_size, (batch, seq)),
+            dtype="int32")
+        labels = mx.nd.array(
+            onp.random.randint(0, net.vocab_size, (batch, seq)),
+            dtype="int32")
+        for _ in range(3):  # compile + warmup
+            trainer.step(toks, labels)
+        mx.nd.waitall()
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = trainer.step(toks, labels)
+        float(loss.asnumpy())
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = 6.0 * n_params  # fwd+bwd dense training flops
+    mfu = tokens_per_sec * flops_per_token / (
+        peak_flops_per_device() * len(mesh.devices.flat))
+    print(json.dumps({
+        "metric": "gpt2_124m_train_throughput",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

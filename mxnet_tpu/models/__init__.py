@@ -1,0 +1,16 @@
+"""mxnet_tpu.models — in-tree model families.
+
+Parity: python/mxnet/gluon/model_zoo (vision) plus the GluonNLP-era
+transformer models the BASELINE configs require (BERT, GPT-2, Sockeye-style
+transformer) — all built on TP/SP-aware blocks (see models.transformer).
+"""
+from . import vision
+from .bert import BERTForPretrain, BERTModel, get_bert
+from .gpt2 import GPT2Model, get_gpt2, gpt2_lm_loss
+from .transformer import (MultiHeadAttention, PositionwiseFFN,
+                          TransformerBlock, TransformerEncoderLayer)
+from .vision import get_model
+
+__all__ = ["vision", "get_model", "BERTModel", "BERTForPretrain", "get_bert",
+           "GPT2Model", "get_gpt2", "gpt2_lm_loss", "MultiHeadAttention",
+           "PositionwiseFFN", "TransformerBlock", "TransformerEncoderLayer"]

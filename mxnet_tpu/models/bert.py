@@ -1,0 +1,110 @@
+"""BERT encoder + pretraining heads (parity target: BASELINE config 3 /
+GluonNLP bert; MXNet kept BERT in GluonNLP, out of tree, over the contrib
+fused attention ops — here it is in-tree on the TP/SP transformer blocks).
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Dropout, Embedding, LayerNorm
+from ..ndarray import ops as F
+from ..parallel.sharding import annotate
+from .transformer import TransformerEncoderLayer
+
+_CONFIGS = {
+    "bert_base": (12, 768, 12),
+    "bert_large": (24, 1024, 16),
+}
+
+
+class BERTModel(HybridBlock):
+    """tokens (B,T), token_types (B,T) → sequence output (B,T,units),
+    pooled output (B,units)."""
+
+    def __init__(self, vocab_size=30522, units=768, num_layers=12,
+                 num_heads=12, max_length=512, type_vocab_size=2,
+                 dropout=0.1, layer_norm_eps=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.vocab_size = vocab_size
+        self.word_embed = Embedding(vocab_size, units)
+        annotate(self.word_embed.weight, "vocab", "embed")
+        self.token_type_embed = Embedding(type_vocab_size, units)
+        self.position_embed = Embedding(max_length, units)
+        annotate(self.position_embed.weight, "seq", "embed")
+        self.embed_ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.embed_drop = Dropout(dropout) if dropout else None
+        self.layers = []
+        for i in range(num_layers):
+            layer = TransformerEncoderLayer(
+                units, 4 * units, num_heads, dropout=dropout,
+                layer_norm_eps=layer_norm_eps)
+            self.register_child(layer, f"layer{i}")
+            self.layers.append(layer)
+        self.pooler = Dense(units, activation="tanh", flatten=False,
+                            in_units=units)
+
+    def forward(self, tokens, token_types=None, valid_length=None):
+        b, t = tokens.shape
+        pos = F.arange_like(tokens, axis=1).astype("int32")
+        x = self.word_embed(tokens) + self.position_embed(pos)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_ln(x)
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        mask = None
+        if valid_length is not None:
+            # (B, 1, 1, T) key-side padding mask
+            steps = F.arange_like(tokens, axis=1)
+            mask = (steps.reshape((1, 1, 1, t)) <
+                    valid_length.reshape((b, 1, 1, 1)))
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler(F.slice_axis(x, axis=1, begin=0, end=1)
+                             .reshape((b, self._units)))
+        return x, pooled
+
+
+class BERTForPretrain(HybridBlock):
+    """MLM + NSP heads (GluonNLP BERTForPretrain parity)."""
+
+    def __init__(self, backbone: BERTModel, **kwargs):
+        super().__init__(**kwargs)
+        self.backbone = backbone
+        units = backbone._units
+        self.mlm_dense = Dense(units, activation="gelu", flatten=False,
+                               in_units=units)
+        self.mlm_ln = LayerNorm(in_channels=units)
+        self.nsp = Dense(2, flatten=False, in_units=units)
+
+    def forward(self, tokens, token_types=None, valid_length=None,
+                masked_positions=None):
+        seq, pooled = self.backbone(tokens, token_types, valid_length)
+        if masked_positions is not None:
+            seq = _gather_positions(seq, masked_positions)
+        h = self.mlm_ln(self.mlm_dense(seq))
+        mlm_logits = F.FullyConnected(
+            h, self.backbone.word_embed.weight.data(), None,
+            num_hidden=self.backbone.vocab_size, no_bias=True, flatten=False)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def _gather_positions(seq, positions):
+    """(B, T, U) gathered at (B, M) per-row positions → (B, M, U)."""
+    import jax.numpy as jnp
+
+    from ..ndarray.ops import _as_nd, invoke
+
+    def f(x, pos):
+        return jnp.take_along_axis(x, pos[:, :, None].astype(jnp.int32),
+                                   axis=1)
+
+    return invoke("gather_positions", f, [seq, _as_nd(positions)])
+
+
+def get_bert(name="bert_base", **kwargs):
+    layers, units, heads = _CONFIGS[name]
+    cfg = dict(units=units, num_layers=layers, num_heads=heads)
+    cfg.update(kwargs)
+    return BERTModel(**cfg)

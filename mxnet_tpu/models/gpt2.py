@@ -1,0 +1,78 @@
+"""GPT-2 language model — the flagship model of the framework.
+
+Capability add over the reference (SURVEY.md §5.7 / BASELINE config 5:
+long-sequence GPT-2): MXNet had no in-tree GPT; this one is built from the
+TP/SP-aware transformer blocks, with tied embeddings (vocab-parallel logits)
+and flash attention on TPU.
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dropout, Embedding, LayerNorm
+from ..ndarray import ops as F
+from ..parallel.sharding import annotate
+from .. import parallel as _par
+from .transformer import TransformerBlock
+
+_CONFIGS = {
+    # name: (layers, units, heads)
+    "gpt2_124m": (12, 768, 12),
+    "gpt2_355m": (24, 1024, 16),
+    "gpt2_774m": (36, 1280, 20),
+    "gpt2_1558m": (48, 1600, 25),
+}
+
+
+class GPT2Model(HybridBlock):
+    """Decoder-only LM: tokens (B, T) int32 → logits (B, T, vocab)."""
+
+    def __init__(self, vocab_size=50257, units=768, num_layers=12,
+                 num_heads=12, max_length=1024, dropout=0.1,
+                 layer_norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.wte = Embedding(vocab_size, units)
+        annotate(self.wte.weight, "vocab", "embed")
+        self.wpe = Embedding(max_length, units)
+        annotate(self.wpe.weight, "seq", "embed")
+        self.drop = Dropout(dropout) if dropout else None
+        self.blocks = []
+        for i in range(num_layers):
+            blk = TransformerBlock(units, 4 * units, num_heads,
+                                   dropout=dropout, causal=True,
+                                   layer_norm_eps=layer_norm_eps)
+            self.register_child(blk, f"h{i}")
+            self.blocks.append(blk)
+        self.ln_f = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+
+    def forward(self, tokens):
+        b, t = tokens.shape
+        pos = F.arange_like(tokens, axis=1).astype("int32")
+        x = self.wte(tokens) + self.wpe(pos)
+        x = _par.with_sharding_constraint(x, "batch", "seq", None)
+        if self.drop is not None:
+            x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        # tied lm head: logits = x · wteᵀ (vocab-parallel over tp)
+        logits = F.FullyConnected(x, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return _par.with_sharding_constraint(logits, "batch", "seq", "vocab")
+
+
+def gpt2_lm_loss(logits, labels):
+    """Next-token cross entropy; labels (B, T) already shifted."""
+    logp = F.log_softmax(logits, axis=-1)
+    nll = -F.pick(logp, labels, axis=-1)
+    return nll.mean()
+
+
+def get_gpt2(name="gpt2_124m", **kwargs):
+    layers, units, heads = _CONFIGS[name]
+    cfg = dict(units=units, num_layers=layers, num_heads=heads)
+    cfg.update(kwargs)
+    return GPT2Model(**cfg)

@@ -1,0 +1,129 @@
+"""Transformer building blocks with first-class tensor/sequence parallelism.
+
+Capability parity: MXNet's transformer support was GluonNLP-side Python over
+the fused contrib matmuls (src/operator/contrib/transformer.cc); there was
+no TP/SP (SURVEY.md §2.4 row "Parallelism strategies").  Here every layer
+carries logical sharding axes (Megatron-style: attention heads and FFN hidden
+over ``tp``, sequence over ``sp``) so the same Block runs single-chip or
+SPMD over a mesh without code changes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Dropout, GELU, LayerNorm
+from ..ops import dot_product_attention
+from ..parallel.sharding import annotate
+from .. import parallel as _par
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention with per-head tensor parallelism.
+
+    q/k/v/out projections are separate Dense layers so the ``tp`` sharding
+    of the ``units`` dim splits along head boundaries (Megatron column/row
+    parallel); attention math runs through ops.dot_product_attention
+    (Pallas flash kernel on TPU for long sequences).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, attention_dropout=0.0,
+                 use_bias=True, causal=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        self._causal = causal
+        self._att_dropout = attention_dropout
+        for name in ("q_proj", "k_proj", "v_proj"):
+            d = Dense(units, use_bias=use_bias, flatten=False,
+                      in_units=units)
+            annotate(d.weight, "heads", "embed")
+            if d.bias is not None:
+                annotate(d.bias, "heads")
+            setattr(self, name, d)
+        self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                              in_units=units)
+        annotate(self.out_proj.weight, "embed", "heads")
+        if self.out_proj.bias is not None:
+            annotate(self.out_proj.bias, "norm")
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        b, t = x.shape[0], x.shape[1]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((b, t, h, d))
+        k = self.k_proj(x).reshape((b, t, h, d))
+        v = self.v_proj(x).reshape((b, t, h, d))
+        out = dot_product_attention(
+            q, k, v, causal=self._causal, mask=mask,
+            dropout=self._att_dropout)
+        out = _par.with_sharding_constraint(out, "batch", "seq", "heads",
+                                            None)
+        out = self.out_proj(out.reshape((b, t, h * d)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """Transformer FFN: Dense(hidden) → GELU → Dense(units), hidden sharded
+    over ``tp`` (Megatron column then row parallel)."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 use_bias=True, **kwargs):
+        super().__init__(**kwargs)
+        self.fc1 = Dense(hidden_size, use_bias=use_bias, flatten=False,
+                         in_units=units)
+        annotate(self.fc1.weight, "mlp", "embed")
+        if self.fc1.bias is not None:
+            annotate(self.fc1.bias, "mlp")
+        self.act = GELU() if activation == "gelu" else None
+        self._activation = activation
+        self.fc2 = Dense(units, use_bias=use_bias, flatten=False,
+                         in_units=hidden_size)
+        annotate(self.fc2.weight, "embed", "mlp")
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        from ..ndarray import ops as F
+        h = self.fc1(x)
+        h = self.act(h) if self.act is not None else \
+            F.Activation(h, act_type=self._activation)
+        h = self.fc2(h)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return h
+
+
+class TransformerBlock(HybridBlock):
+    """Pre-LN transformer layer (GPT-2 style)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, causal=True, layer_norm_eps=1e-5,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.attn = MultiHeadAttention(
+            units, num_heads, dropout=dropout,
+            attention_dropout=attention_dropout, causal=causal)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout)
+
+    def forward(self, x, mask=None):
+        x = x + self.attn(self.ln1(x), mask)
+        x = _par.with_sharding_constraint(x, "batch", "seq", None)
+        x = x + self.ffn(self.ln2(x))
+        return _par.with_sharding_constraint(x, "batch", "seq", None)
+
+
+class TransformerEncoderLayer(TransformerBlock):
+    """Bidirectional (BERT-style) layer: post-LN off, no causal mask."""
+
+    def __init__(self, units, hidden_size, num_heads, **kwargs):
+        super().__init__(units, hidden_size, num_heads, causal=False,
+                         **kwargs)

@@ -1,0 +1,21 @@
+"""Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision)."""
+from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
+                     ResNetV1, ResNetV2, get_resnet, resnet18_v1,
+                     resnet18_v2, resnet34_v1, resnet34_v2, resnet50_v1,
+                     resnet50_v2, resnet101_v1, resnet101_v2, resnet152_v1,
+                     resnet152_v2)
+from .mlp import MLP
+
+_models = {name: globals()[name] for name in (
+    "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+    "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+    "resnet101_v2", "resnet152_v2")}
+
+
+def get_model(name, **kwargs):
+    """Parity: gluon.model_zoo.vision.get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"model {name} not found; available: {sorted(_models)}")
+    return _models[name](**kwargs)

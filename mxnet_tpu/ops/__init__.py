@@ -1,0 +1,17 @@
+"""mxnet_tpu.ops — kernel-level operations (Pallas TPU kernels + jax
+reference paths).
+
+This is the TPU analogue of MXNet's hand-written kernel layer
+(src/operator/contrib/transformer.cc, fused CUDA ops): most of the op
+surface lives in mxnet_tpu.ndarray.ops as straight jax/lax code that XLA
+compiles optimally; this package holds the few ops where a hand-written
+Pallas kernel beats the compiler (flash attention) plus their pure-XLA
+reference implementations used for testing and CPU execution.
+"""
+from .attention import (dot_product_attention, flash_attention,
+                        interleaved_matmul_selfatt_qk,
+                        interleaved_matmul_selfatt_valatt)
+
+__all__ = ["dot_product_attention", "flash_attention",
+           "interleaved_matmul_selfatt_qk",
+           "interleaved_matmul_selfatt_valatt"]

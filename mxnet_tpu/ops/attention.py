@@ -1,0 +1,110 @@
+"""Attention ops: flash attention (Pallas TPU) + XLA reference path.
+
+Capability add over the reference (SURVEY.md §5.7: MXNet has NO flash/ring
+attention — its closest machinery is the fused BERT matmuls in
+src/operator/contrib/transformer.cc, whose API is kept below for GluonNLP
+parity).  The public entry is :func:`dot_product_attention` on NDArrays;
+``impl='auto'`` picks the Pallas kernel on TPU for long sequences and the
+XLA reference elsewhere.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from .. import random as _random
+
+_NEG_INF = -1e30
+
+
+# ----------------------------------------------------------------- reference
+
+def _attention_ref(q, k, v, *, causal=False, mask=None, scale=None,
+                   dropout=0.0, dropout_key=None):
+    """Pure-jax attention; q/k/v are (B, T, H, D).  XLA fuses this well for
+    moderate T; the Pallas kernel takes over for long sequences."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        idx_q = jnp.arange(tq)[:, None] + (tk - tq)
+        idx_k = jnp.arange(tk)[None, :]
+        logits = jnp.where(idx_k <= idx_q, logits, _NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout),
+                          jnp.zeros_like(probs))
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ------------------------------------------------------------------ dispatch
+
+def _use_flash(q_shape, causal, mask, dropout) -> bool:
+    """Flash kernel handles: no explicit mask, no attention dropout, long
+    128-aligned sequences, head dims the MXU tiles well (64/128/256)."""
+    if mask is not None or dropout > 0.0:
+        return False
+    b, t, h, d = q_shape
+    if t < 256 or t % 128 or d not in (64, 128, 256):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from . import flash  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None):
+    """Jax-level flash attention entry (Pallas on TPU, reference on CPU)."""
+    if _use_flash(q.shape, causal, None, 0.0):
+        from .flash import flash_attention as _pallas
+        return _pallas(q, k, v, causal=causal, scale=scale)
+    return _attention_ref(q, k, v, causal=causal, scale=scale)
+
+
+def dot_product_attention(query, key, value, *, causal=False, mask=None,
+                          dropout=0.0, scale=None, impl="auto"):
+    """NDArray multi-head attention: inputs (B, T, H, D) → (B, T, H, D).
+
+    impl: 'auto' | 'flash' | 'ref'.
+    """
+    from ..ndarray.ops import _as_nd, invoke
+    query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)
+    nd_in = [query, key, value]
+    dkey = None
+    if dropout > 0.0 and _base.is_training():
+        dkey = _random.next_key(query.context)
+    mask_val = mask.jax if hasattr(mask, "jax") else mask
+
+    if impl == "flash" and (mask is not None or dropout > 0.0):
+        raise _base.MXNetError(
+            "impl='flash' does not support an explicit mask or attention "
+            "dropout — use impl='auto'/'ref'")
+
+    def f(q, k, v):
+        if impl != "ref" and _use_flash(q.shape, causal, mask_val, dropout):
+            from .flash import flash_attention as _pallas
+            return _pallas(q, k, v, causal=causal, scale=scale)
+        return _attention_ref(q, k, v, causal=causal, mask=mask_val,
+                              scale=scale, dropout=dropout, dropout_key=dkey)
+
+    return invoke("dot_product_attention", f, nd_in)
+
+
+# GluonNLP-compat fused attention ops live in mxnet_tpu.ndarray.ops
+# (parity: src/operator/contrib/transformer.cc); re-exported here so kernel
+# users find the whole attention surface in one namespace.
+from ..ndarray.ops import (interleaved_matmul_selfatt_qk,  # noqa: E402,F401
+                           interleaved_matmul_selfatt_valatt)

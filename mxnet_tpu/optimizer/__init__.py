@@ -9,7 +9,6 @@ clip_gradient) for Trainer & KVStore compatibility.
 """
 from __future__ import annotations
 
-import math
 from typing import Any, Dict, Optional
 
 import jax
@@ -192,7 +191,7 @@ class Adam(Optimizer):
         self._update_count(index)
         t = self._index_update_count[index]
         lr, wd = self._get_lr(index), self._get_wd(index)
-        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        lr *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         mean, var = state
         g = self._preprocess_grad(grad.jax) + wd * weight.jax
         m = self.beta1 * mean.jax + (1 - self.beta1) * g
@@ -210,7 +209,7 @@ class AdamW(Adam):
         self._update_count(index)
         t = self._index_update_count[index]
         lr, wd = self._get_lr(index), self._get_wd(index)
-        coef = math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        coef = (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
         mean, var = state
         g = self._preprocess_grad(grad.jax)
         m = self.beta1 * mean.jax + (1 - self.beta1) * g

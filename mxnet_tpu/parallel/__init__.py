@@ -1,0 +1,43 @@
+"""mxnet_tpu.parallel — SPMD parallelism over the TPU device mesh.
+
+TPU-native replacement for MXNet's distributed stack (SURVEY.md §2.4):
+context lists → named :class:`jax.sharding.Mesh`; KVStore comm backends →
+XLA collectives inserted by GSPMD; plus the strategies MXNet never had
+(tensor/sequence/pipeline/expert parallel) as first-class axes.
+"""
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import AXES, axis_size, current_mesh, make_mesh, use_mesh
+from .sharding import (DEFAULT_RULES, ShardingRules, annotate, batch_spec,
+                       logical_axes_of, param_sharding, shard_params)
+from .trainer import ShardedTrainer
+
+__all__ = [
+    "AXES", "Mesh", "NamedSharding", "PartitionSpec", "ShardingRules",
+    "ShardedTrainer", "annotate", "axis_size", "batch_spec", "current_mesh",
+    "logical_axes_of", "make_mesh", "param_sharding", "shard_params",
+    "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",
+]
+
+
+def with_sharding_constraint(x, *logical_axes, mesh=None, rules=None):
+    """Pin an activation's layout inside a traced computation.
+
+    Models call this to mark e.g. ``(batch, seq, embed)`` activations as
+    ``("dp", "sp", None)`` so GSPMD keeps sequence parallelism instead of
+    gathering.  Accepts NDArray or jax.Array; no-op when no mesh is active.
+    """
+    import jax as _jax
+
+    from ..ndarray import NDArray as _ND
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        return x
+    val = x.jax if isinstance(x, _ND) else x
+    if not isinstance(val, _jax.core.Tracer):
+        return x  # eager: layout hints only matter under GSPMD tracing
+    rules = rules or ShardingRules()
+    spec = rules.spec(logical_axes)
+    out = _jax.lax.with_sharding_constraint(
+        val, NamedSharding(mesh, spec))
+    return _ND(out) if isinstance(x, _ND) else out

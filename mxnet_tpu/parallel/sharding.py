@@ -1,0 +1,91 @@
+"""Logical-axis sharding rules (TPU-native parameter placement).
+
+MXNet has no parameter sharding (params are replicated per context by
+``Trainer``/KVStore broadcast — src/kvstore/comm.h Broadcast).  On TPU,
+placement is the performance model, so parameters carry *logical* axis names
+("embed", "mlp", "heads", "vocab", …) and a rules table maps logical axes →
+mesh axes (the flax/t5x partitioning idiom).  Replication is just the empty
+mapping, so data-parallel MXNet semantics fall out as the default.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import base as _base
+
+# Default logical→mesh mapping (Megatron-style TP + sequence axis).
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "batch": "dp",
+    "layers": "pp",
+    "vocab": "tp",
+    "embed": None,
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "expert": "ep",
+    "seq": "sp",
+    "norm": None,
+}
+
+
+class ShardingRules(dict):
+    """dict logical-axis-name → mesh-axis-name (or None = replicate)."""
+
+    def __init__(self, rules: Optional[Dict[str, Optional[str]]] = None,
+                 **overrides):
+        super().__init__(DEFAULT_RULES)
+        if rules:
+            self.update(rules)
+        self.update(overrides)
+
+    def spec(self, logical_axes: Optional[Sequence[Optional[str]]]) -> P:
+        """PartitionSpec for a parameter annotated with logical axes."""
+        if not logical_axes:
+            return P()
+        return P(*[self.get(a) if a is not None else None
+                   for a in logical_axes])
+
+
+def annotate(param, *logical_axes):
+    """Attach logical axis names to a Parameter (one per dimension)."""
+    param._logical_axes = tuple(logical_axes)
+    return param
+
+
+def logical_axes_of(param) -> Optional[Tuple[Optional[str], ...]]:
+    return getattr(param, "_logical_axes", None)
+
+
+def param_sharding(param, mesh: Mesh,
+                   rules: Optional[ShardingRules] = None) -> NamedSharding:
+    rules = rules or ShardingRules()
+    return NamedSharding(mesh, rules.spec(logical_axes_of(param)))
+
+
+def shard_params(block, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place every initialized parameter of ``block`` onto the mesh per the
+    rules (replacing KVStore broadcast: parity src/kvstore/comm.h
+    Comm::Broadcast — replication is now a NamedSharding, sharding is free).
+    """
+    rules = rules or ShardingRules()
+    for _, p in block.collect_params().items():
+        if p._data is None:
+            continue
+        sh = NamedSharding(mesh, rules.spec(logical_axes_of(p)))
+        p._sharding = sh
+        p._data._rebind(jax.device_put(p._data.jax, sh))
+    return block
+
+
+def batch_spec(ndim: int, batch_axis: int = 0, seq_axis: Optional[int] = None
+               ) -> P:
+    """PartitionSpec for an input batch: batch dim over dp, optional
+    sequence dim over sp, rest replicated."""
+    axes: list = [None] * ndim
+    axes[batch_axis] = "dp"
+    if seq_axis is not None:
+        axes[seq_axis] = "sp"
+    return P(*axes)

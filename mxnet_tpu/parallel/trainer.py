@@ -1,0 +1,357 @@
+"""ShardedTrainer: the whole training step as ONE jitted, sharded XLA
+computation over the device mesh.
+
+Parity note: this subsumes three MXNet mechanisms at once (SURVEY.md §3.2/3.3)
+— CachedOp forward/backward (src/imperative/cached_op.cc), KVStore gradient
+allreduce (src/kvstore/comm.h: Comm::Reduce → here a psum XLA inserts from
+the dp-sharded batch), and the fused optimizer update ops
+(src/operator/optimizer_op.cc: here the *same* mxnet_tpu.optimizer.Optimizer
+instance runs inside the trace, so every MXNet optimizer works sharded,
+unmodified).  Gluon's ``Trainer`` keeps the imperative API for single-device
+flows; ShardedTrainer is the pjit path that scales it to a pod.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import base as _base
+from .. import optimizer as opt_mod
+from .. import random as _random
+from ..ndarray import NDArray
+from .mesh import current_mesh, use_mesh
+from .sharding import ShardingRules, batch_spec, logical_axes_of, shard_params
+
+
+class _TracedCount(dict):
+    """Stands in for Optimizer._index_update_count during tracing: every
+    index reads the traced step counter, writes are discarded."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, k):
+        return self._t
+
+    def __setitem__(self, k, v):
+        pass
+
+    def __contains__(self, k):
+        return True
+
+
+@contextlib.contextmanager
+def _traced_optimizer(opt: opt_mod.Optimizer, lr, t):
+    """Patch an Optimizer so its update() math traces cleanly: lr and the
+    per-index update count become traced scalars (so one compiled step serves
+    every iteration — bias correction, schedulers and all)."""
+    saved = (opt.lr, opt.lr_scheduler, opt._index_update_count)
+    opt.lr, opt.lr_scheduler = lr, None
+    opt._index_update_count = _TracedCount(t)
+    opt.__dict__["_update_count"] = lambda index: None
+    try:
+        yield opt
+    finally:
+        opt.lr, opt.lr_scheduler, opt._index_update_count = saved
+        opt.__dict__.pop("_update_count", None)
+
+
+def _flatten_state(state) -> Tuple[List[NDArray], Any]:
+    """Flatten an optimizer state pytree (None / NDArray / nested tuples)."""
+    leaves: List[NDArray] = []
+
+    def walk(s):
+        if s is None:
+            return ("none",)
+        if isinstance(s, NDArray):
+            leaves.append(s)
+            return ("leaf",)
+        if isinstance(s, (tuple, list)):
+            return ("seq", type(s) is list, [walk(x) for x in s])
+        raise _base.MXNetError(f"unsupported optimizer state {type(s)}")
+
+    tree = walk(state)
+    return leaves, tree
+
+
+def _wrap_state(tree, it) -> Any:
+    """Rebuild a state pytree with fresh NDArrays around traced leaves."""
+    kind = tree[0]
+    if kind == "none":
+        return None
+    if kind == "leaf":
+        return NDArray(next(it))
+    _, is_list, subs = tree
+    seq = [_wrap_state(s, it) for s in subs]
+    return seq if is_list else tuple(seq)
+
+
+def _state_leaves(state_nd) -> List[NDArray]:
+    leaves, _ = _flatten_state(state_nd)
+    return leaves
+
+
+class ShardedTrainer:
+    """Train a Gluon block SPMD over a mesh (parity role: gluon.Trainer +
+    KVStore ``dist_sync_device``, re-expressed as pjit).
+
+    Parameters
+    ----------
+    net : Block — initialized (or initializable via one forward) model.
+    optimizer : str or Optimizer — any registered MXNet optimizer.
+    loss : callable(out, *labels) -> NDArray, reduced to scalar mean.
+    mesh : jax.sharding.Mesh (default: ambient/current mesh).
+    rules : ShardingRules mapping logical param axes → mesh axes.
+    data_specs/label_specs : optional explicit PartitionSpecs per input;
+        default shards dim0 over ``dp`` (and ``seq_axis`` over ``sp``).
+    donate : donate param/state buffers to the step (XLA in-place update,
+        the static_alloc analogue).
+    """
+
+    def __init__(self, net, optimizer, loss=None, optimizer_params=None,
+                 mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 data_specs=None, label_specs=None, seq_axis: Optional[int] = None,
+                 donate: bool = True):
+        self.net = net
+        self.loss = loss
+        self.mesh = mesh or current_mesh()
+        if self.mesh is None:
+            raise _base.MXNetError(
+                "ShardedTrainer needs a mesh — parallel.make_mesh() first")
+        self.rules = rules or ShardingRules()
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self.optimizer = optimizer
+        else:
+            self.optimizer = opt_mod.create(optimizer,
+                                            **(optimizer_params or {}))
+        self._data_specs = data_specs
+        self._label_specs = label_specs
+        self._seq_axis = seq_axis
+        self._donate = donate
+        self._built = False
+        self._step_fn = None
+        self._trainable: List[Tuple[str, Any]] = []
+        self._aux: List[Tuple[str, Any]] = []
+        self._states: List[Any] = []       # NDArray pytrees, per trainable
+        self._state_flat: List[NDArray] = []
+
+    # ------------------------------------------------------------------
+    def _build(self, data, labels):
+        net = self.net
+        # settle deferred shapes with one eager forward
+        with _base.training_mode(True):
+            rec = _base.set_recording(False)
+            try:
+                net(*data)
+            finally:
+                _base.set_recording(rec)
+        seen = set()
+        for name, p in net.collect_params().items():
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            if p._data is None:
+                continue
+            if p.grad_req != "null":
+                self._trainable.append((name, p))
+            else:
+                self._aux.append((name, p))
+        # optimizer states (NDArray pytrees, kept for save/load parity)
+        self.optimizer.param_dict = {
+            i: p for i, (_, p) in enumerate(self._trainable)}
+        for i, (_, p) in enumerate(self._trainable):
+            st = self.optimizer.create_state_multi_precision(i, p.data())
+            self._states.append(st)
+            self._state_flat.extend(_state_leaves(st))
+        # place params on the mesh
+        shard_params(net, self.mesh, self.rules)
+        for st in self._state_flat:
+            st._rebind(jax.device_put(st.jax, self._leaf_sharding(st)))
+        self._state_trees = [_flatten_state(st)[1] for st in self._states]
+        self._state_counts = [len(_state_leaves(st)) for st in self._states]
+        self._compile(data, labels)
+        self._built = True
+
+    def _leaf_sharding(self, leaf_nd):
+        """A state leaf shards like its parameter when shapes match."""
+        for (name, p), st in zip(self._trainable, self._states):
+            for l in _state_leaves(st):
+                if l is leaf_nd:
+                    if tuple(l.shape) == tuple(p.shape):
+                        return NamedSharding(
+                            self.mesh, self.rules.spec(logical_axes_of(p)))
+                    return NamedSharding(self.mesh, P())
+        return NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------------
+    def _make_pure(self, n_data):
+        net, loss_fn, optimizer = self.net, self.loss, self.optimizer
+        trainable, aux = self._trainable, self._aux
+        state_trees, state_counts = self._state_trees, self._state_counts
+
+        mesh = self.mesh
+
+        def pure(param_vals, aux_vals, state_vals, batch_vals, key, lr, t):
+            _random.push_trace_key(key)
+            saved = []
+            ctx = use_mesh(mesh)
+            ctx.__enter__()
+            try:
+                data = [NDArray(v) for v in batch_vals[:n_data]]
+                labels = [NDArray(v) for v in batch_vals[n_data:]]
+
+                for (_, p), v in zip(aux, aux_vals):
+                    d = p._data
+                    saved.append((d, d._data, d._node))
+                    d._data, d._node = v, None
+
+                def forward(pvals):
+                    inner = []
+                    for (_, p), v in zip(trainable, pvals):
+                        d = p._data
+                        inner.append((d, d._data, d._node))
+                        d._data, d._node = v, None
+                    try:
+                        with _base.training_mode(True):
+                            rec = _base.set_recording(False)
+                            try:
+                                out = net.forward(*data)
+                            finally:
+                                _base.set_recording(rec)
+                        if loss_fn is not None:
+                            l = loss_fn(out, *labels)
+                        else:
+                            l = out
+                        lval = l.jax if isinstance(l, NDArray) else l
+                        lval = jnp.mean(lval)
+                        new_aux = tuple(
+                            p._data._data for _, p in aux)
+                        return lval, new_aux
+                    finally:
+                        for d, old, nodev in inner:
+                            d._data, d._node = old, nodev
+
+                (loss_val, new_aux), grads = jax.value_and_grad(
+                    forward, has_aux=True)(tuple(param_vals))
+
+                new_params, new_states = [], []
+                with _traced_optimizer(optimizer, lr, t):
+                    off = 0
+                    for i, ((name, p), g) in enumerate(zip(trainable, grads)):
+                        w_nd = NDArray(param_vals[i])
+                        n = state_counts[i]
+                        it = iter(state_vals[off:off + n])
+                        st = _wrap_state(state_trees[i], it)
+                        off += n
+                        optimizer.update_multi_precision(
+                            i, w_nd, NDArray(g), st)
+                        new_params.append(w_nd._data)
+                        new_states.extend(
+                            l._data for l in _state_leaves(st))
+                return (loss_val, tuple(new_params), tuple(new_aux),
+                        tuple(new_states))
+            finally:
+                ctx.__exit__()
+                for d, old, nodev in saved:
+                    d._data, d._node = old, nodev
+                _random.pop_trace_key()
+
+        return pure
+
+    # ------------------------------------------------------------------
+    def _compile(self, data, labels):
+        mesh, rules = self.mesh, self.rules
+        pure = self._make_pure(len(data))
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        param_sh = tuple(ns(rules.spec(logical_axes_of(p)))
+                         for _, p in self._trainable)
+        aux_sh = tuple(ns(rules.spec(logical_axes_of(p)))
+                       for _, p in self._aux)
+        state_sh = tuple(self._leaf_sharding(l).spec
+                         for l in self._state_flat)
+        state_sh = tuple(ns(s) for s in state_sh)
+
+        def default_spec(v):
+            return batch_spec(v.ndim, 0, self._seq_axis)
+
+        data_sh = tuple(ns(s) for s in (
+            self._data_specs or [default_spec(d) for d in data]))
+        label_sh = tuple(ns(s) for s in (
+            self._label_specs or [default_spec(l) for l in labels]))
+        self._batch_shardings = data_sh + label_sh
+        scalar = ns(P())
+
+        self._step_fn = jax.jit(
+            pure,
+            in_shardings=(param_sh, aux_sh, state_sh, data_sh + label_sh,
+                          scalar, scalar, scalar),
+            out_shardings=(scalar, param_sh, aux_sh, state_sh),
+            donate_argnums=(0, 1, 2) if self._donate else ())
+
+    # ------------------------------------------------------------------
+    def step(self, data, labels=()) -> NDArray:
+        """Run one full training step; returns the (replicated) loss."""
+        if not isinstance(data, (tuple, list)):
+            data = (data,)
+        if not isinstance(labels, (tuple, list)):
+            labels = (labels,)
+        if not self._built:
+            self._build(data, labels)
+        opt = self.optimizer
+        opt.num_update += 1
+        lr = jnp.asarray(opt.learning_rate, jnp.float32)
+        t = jnp.asarray(opt.num_update, jnp.int32)
+        key = _random.next_key()
+
+        param_vals = tuple(p._data.jax for _, p in self._trainable)
+        aux_vals = tuple(p._data.jax for _, p in self._aux)
+        state_vals = tuple(l.jax for l in self._state_flat)
+        batch_vals = tuple(
+            jax.device_put(x.jax if isinstance(x, NDArray) else jnp.asarray(x),
+                           sh)
+            for x, sh in zip(tuple(data) + tuple(labels),
+                             self._batch_shardings))
+
+        loss, new_params, new_aux, new_states = self._step_fn(
+            param_vals, aux_vals, state_vals, batch_vals, key, lr, t)
+
+        for (_, p), v in zip(self._trainable, new_params):
+            p._data._rebind(v)
+        for (_, p), v in zip(self._aux, new_aux):
+            p._data._rebind(v)
+        for l, v in zip(self._state_flat, new_states):
+            l._rebind(v)
+        return NDArray(loss)
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self.optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self.optimizer.set_learning_rate(lr)
+
+    def save_states(self, fname):
+        from ..utils.serialization import save
+        data = {}
+        for i, st in enumerate(self._states):
+            for j, l in enumerate(_state_leaves(st)):
+                data[f"state_{i}_{j}"] = l
+        save(fname, data)
+
+    def load_states(self, fname):
+        from ..utils.serialization import load
+        loaded = load(fname)
+        for i, st in enumerate(self._states):
+            for j, l in enumerate(_state_leaves(st)):
+                l._rebind(jax.device_put(loaded[f"state_{i}_{j}"].jax,
+                                         self._leaf_sharding(l)))

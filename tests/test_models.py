@@ -1,0 +1,137 @@
+"""Tests for mxnet_tpu.models (transformer/GPT-2/BERT/ResNet zoo)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def test_multi_head_attention_shapes():
+    attn = models.MultiHeadAttention(32, 4, causal=True)
+    attn.initialize()
+    x = mx.nd.array(onp.random.randn(2, 8, 32).astype("float32"))
+    out = attn(x)
+    assert out.shape == (2, 8, 32)
+
+
+def test_attention_causality():
+    """Causal attention: changing future tokens must not change past out."""
+    attn = models.MultiHeadAttention(16, 2, causal=True, use_bias=False)
+    attn.initialize()
+    x = onp.random.randn(1, 6, 16).astype("float32")
+    out1 = attn(mx.nd.array(x)).asnumpy()
+    x2 = x.copy()
+    x2[:, 4:] += 1.0
+    out2 = attn(mx.nd.array(x2)).asnumpy()
+    onp.testing.assert_allclose(out1[:, :4], out2[:, :4], rtol=1e-5,
+                                atol=1e-6)
+    assert not onp.allclose(out1[:, 4:], out2[:, 4:])
+
+
+def test_gpt2_forward_and_grad():
+    net = models.get_gpt2("gpt2_124m", vocab_size=128, units=32,
+                          num_layers=2, num_heads=2, max_length=64,
+                          dropout=0.0)
+    net.initialize()
+    toks = mx.nd.array(onp.random.randint(0, 128, (2, 16)), dtype="int32")
+    logits = net(toks)
+    assert logits.shape == (2, 16, 128)
+    labels = mx.nd.array(onp.random.randint(0, 128, (2, 16)), dtype="int32")
+    with mx.autograd.record():
+        logits = net(toks)
+        loss = models.gpt2_lm_loss(logits, labels)
+    loss.backward()
+    g = net.wte.weight.grad()
+    assert float(mx.nd.norm(g).asnumpy()) > 0
+
+
+def test_gpt2_hybridize_matches_imperative():
+    net = models.get_gpt2("gpt2_124m", vocab_size=64, units=32, num_layers=2,
+                          num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    toks = mx.nd.array(onp.random.randint(0, 64, (2, 8)), dtype="int32")
+    imp = net(toks).asnumpy()
+    net.hybridize()
+    hyb = net(toks).asnumpy()
+    onp.testing.assert_allclose(imp, hyb, rtol=1e-5, atol=1e-5)
+
+
+def test_bert_forward():
+    net = models.get_bert("bert_base", vocab_size=100, units=32,
+                          num_layers=2, num_heads=2, max_length=32,
+                          dropout=0.0)
+    net.initialize()
+    toks = mx.nd.array(onp.random.randint(0, 100, (2, 12)), dtype="int32")
+    types = mx.nd.zeros((2, 12), dtype="int32")
+    seq, pooled = net(toks, types)
+    assert seq.shape == (2, 12, 32)
+    assert pooled.shape == (2, 32)
+
+
+def test_bert_padding_mask():
+    net = models.get_bert("bert_base", vocab_size=50, units=16, num_layers=1,
+                          num_heads=2, max_length=16, dropout=0.0)
+    net.initialize()
+    toks = onp.random.randint(0, 50, (1, 8)).astype("int32")
+    vlen = mx.nd.array(onp.array([5]), dtype="float32")
+    seq1, _ = net(mx.nd.array(toks), None, vlen)
+    toks2 = toks.copy()
+    toks2[:, 5:] = 7  # change only padded positions
+    seq2, _ = net(mx.nd.array(toks2), None, vlen)
+    onp.testing.assert_allclose(seq1.asnumpy()[:, :5], seq2.asnumpy()[:, :5],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_bert_pretrain_heads():
+    backbone = models.get_bert("bert_base", vocab_size=64, units=16,
+                               num_layers=1, num_heads=2, max_length=16,
+                               dropout=0.0)
+    net = models.BERTForPretrain(backbone)
+    net.initialize()
+    toks = mx.nd.array(onp.random.randint(0, 64, (2, 10)), dtype="int32")
+    pos = mx.nd.array(onp.array([[1, 3], [0, 5]]), dtype="int32")
+    mlm, nsp = net(toks, None, None, pos)
+    assert mlm.shape == (2, 2, 64)
+    assert nsp.shape == (2, 2)
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2"])
+def test_resnet_forward(name):
+    net = models.get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(2, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_structure():
+    net = models.vision.resnet50_v1(classes=7)
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(1, 3, 64, 64).astype("float32"))
+    assert net(x).shape == (1, 7)
+
+
+def test_model_zoo_registry():
+    with pytest.raises(ValueError):
+        models.get_model("nope")
+
+
+def test_interleaved_selfatt_ops_match_reference():
+    """GluonNLP contrib op parity: fused qk/valatt == plain attention."""
+    from mxnet_tpu import ops as K
+    onp.random.seed(0)
+    t, b, h, d = 6, 2, 2, 4
+    qkv = onp.random.randn(t, b, 3 * h * d).astype("float32")
+    scores = K.interleaved_matmul_selfatt_qk(mx.nd.array(qkv), h)
+    assert scores.shape == (b * h, t, t)
+    att = mx.nd.softmax(scores, axis=-1)
+    out = K.interleaved_matmul_selfatt_valatt(mx.nd.array(qkv), att, h)
+    assert out.shape == (t, b, h * d)
+    # reference
+    x = qkv.reshape(t, b, h, 3, d)
+    q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+    sc = onp.einsum("qbhd,kbhd->bhqk", q, k) / onp.sqrt(d)
+    pr = onp.exp(sc - sc.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    ref = onp.einsum("bhqk,kbhd->qbhd", pr, v).reshape(t, b, h * d)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
