@@ -1,0 +1,246 @@
+"""``mx.np`` — the NumPy-compatible array namespace.
+
+Parity target: MXNet 2.x ``mx.np`` (``python/mxnet/numpy/multiarray.py`` +
+``src/operator/numpy/**``, SURVEY.md §2.3/§2.6).  TPU-first realization: each
+function is ``jax.numpy``'s implementation dispatched through
+:func:`mxnet_tpu.ndarray.ops.invoke`, which unwraps the NDArray facade,
+captures a vjp when autograd records, and re-wraps outputs.  Under hybridize
+the same wrappers run on tracers and lower into the step's single XLA
+computation — there is no separate "numpy op" kernel library to maintain,
+because XLA *is* the kernel library on TPU.
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+import functools as _functools
+
+import jax as _jax
+import jax.numpy as _jnp
+import numpy as _onp
+
+from .. import base as _base
+from ..context import current_context as _current_context
+from ..ndarray import ops as _ops
+from ..ndarray.ndarray import NDArray, from_jax as _from_jax
+
+# The mx.np array type IS the framework NDArray (one facade, two namespaces).
+ndarray = NDArray
+
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+PZERO = 0.0
+NZERO = -0.0
+
+# dtype names re-exported for `mx.np.float32` style usage
+bool_ = _onp.bool_
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = _jnp.bfloat16
+_np_version = _onp.__version__
+
+__all__ = ["ndarray", "pi", "e", "inf", "nan", "newaxis", "dtype"]
+
+dtype = _onp.dtype
+
+
+def _unwrap(x):
+    return x.jax if isinstance(x, NDArray) else x
+
+
+def _wrap_np_op(name, jfn, differentiable=True):
+    """Build an mx.np op from a jax.numpy function.
+
+    NDArray arguments (positional or keyword) become traced inputs; all other
+    arguments are closed over so the op stays a pure function of its arrays.
+    """
+
+    @_functools.wraps(jfn)
+    def op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("ctx", None)
+        kwargs.pop("device", None)
+        leaves, treedef = _jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+        nd_idx = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+        arrs = [leaves[i] for i in nd_idx]
+
+        def pure(*vals):
+            ls = list(leaves)
+            for i, v in zip(nd_idx, vals):
+                ls[i] = v
+            a, kw = _jax.tree_util.tree_unflatten(treedef, ls)
+            return jfn(*a, **kw)
+
+        if not arrs:
+            res = pure()
+            if isinstance(res, (tuple, list)):
+                return type(res)(_from_jax(_jnp.asarray(r)) for r in res)
+            return _from_jax(_jnp.asarray(res))
+        r = _ops.invoke(name, pure, arrs, differentiable=differentiable)
+        if out is not None:
+            out._rebind(r.jax, node=r._node)
+            return out
+        return r
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+# Functions whose outputs are integer/boolean (no gradient path).
+_NONDIFF = {
+    "argmax", "argmin", "argsort", "argwhere", "around", "ceil", "floor",
+    "rint", "round", "round_", "sign", "trunc", "fix", "equal", "not_equal",
+    "greater", "greater_equal", "less", "less_equal", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "isnan", "isinf", "isfinite",
+    "isneginf", "isposinf", "floor_divide", "mod", "fmod", "remainder",
+    "searchsorted", "count_nonzero", "nonzero", "digitize", "signbit",
+    "array_equal", "allclose", "isclose", "result_type", "bincount",
+    "may_share_memory", "shares_memory", "isscalar", "ndim", "shape", "size",
+    "unravel_index", "ravel_multi_index", "left_shift", "right_shift",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "all", "any", "packbits", "unpackbits", "iinfo", "finfo",
+}
+
+# jnp functions exported verbatim (name list is the mx.np parity surface).
+_SIMPLE_OPS = [
+    # elementwise math
+    "abs", "absolute", "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "mod", "fmod", "remainder", "power", "float_power",
+    "sqrt", "cbrt", "square", "reciprocal", "negative", "positive", "sign",
+    "exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "logaddexp",
+    "logaddexp2", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "hypot", "deg2rad", "rad2deg", "degrees", "radians", "ceil", "floor",
+    "rint", "trunc", "clip", "maximum", "minimum", "fmax", "fmin",
+    "heaviside", "copysign", "nextafter", "ldexp", "frexp", "sinc", "i0",
+    "nan_to_num", "real", "imag", "conj", "conjugate", "angle",
+    # comparison / logical
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor", "isnan",
+    "isinf", "isfinite", "isneginf", "isposinf", "signbit", "isclose",
+    "allclose", "array_equal",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "invert", "left_shift",
+    "right_shift",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax",
+    "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmin", "nanmax",
+    "argmax", "argmin", "nanargmax", "nanargmin", "all", "any", "ptp",
+    "median", "nanmedian", "quantile", "nanquantile", "percentile",
+    "nanpercentile", "average", "count_nonzero", "cumsum", "cumprod",
+    "nancumsum", "nancumprod", "trapezoid",
+    # linear algebra-ish
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "trace", "diagonal", "diag", "diagflat", "diag_indices",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "atleast_1d", "atleast_2d", "atleast_3d", "flip", "fliplr", "flipud",
+    "rot90", "roll", "tile", "repeat", "pad", "flatnonzero",
+    # joining / splitting
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "row_stack", "split", "array_split", "hsplit", "vsplit", "dsplit",
+    "append", "insert", "delete", "resize",
+    # indexing / selection
+    "where", "take", "take_along_axis", "choose", "compress", "extract",
+    "searchsorted", "argwhere", "nonzero", "unravel_index",
+    "ravel_multi_index", "tril", "triu", "tril_indices", "triu_indices",
+    "indices", "ix_", "select", "piecewise", "put_along_axis",
+    # sorting
+    "sort", "argsort", "lexsort", "partition", "argpartition",
+    # sets
+    "unique", "intersect1d", "union1d", "setdiff1d", "setxor1d", "in1d",
+    "isin",
+    # statistics / misc
+    "bincount", "digitize", "histogram", "histogram2d", "histogramdd",
+    "corrcoef", "cov", "convolve", "correlate", "interp", "gradient", "diff",
+    "ediff1d", "polyval", "polyfit", "vander", "around", "round",
+    # type utilities
+    "result_type", "can_cast", "promote_types", "iinfo", "finfo", "isscalar",
+    "ndim", "shape", "size",
+]
+
+_seen = set()
+for _name in _SIMPLE_OPS:
+    if _name in _seen or not hasattr(_jnp, _name):
+        continue
+    _seen.add(_name)
+    globals()[_name] = _wrap_np_op(_name, getattr(_jnp, _name),
+                                   differentiable=_name not in _NONDIFF)
+
+abs = globals()["abs"]  # noqa: A001 — numpy parity shadows builtin here
+round = globals()["round"]  # noqa: A001
+min = globals()["min"]  # noqa: A001
+max = globals()["max"]  # noqa: A001
+sum = globals()["sum"]  # noqa: A001
+all = globals()["all"]  # noqa: A001
+any = globals()["any"]  # noqa: A001
+
+
+# ------------------------------------------------------------ array creation
+
+def _creation(name, jfn):
+    @_functools.wraps(jfn)
+    def op(*args, **kwargs):
+        kwargs.pop("ctx", None)
+        kwargs.pop("device", None)
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        res = jfn(*args, **kwargs)
+        if isinstance(res, (tuple, list)):
+            return type(res)(_from_jax(r) for r in res)
+        return _from_jax(res)
+
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+for _name in ["zeros", "ones", "full", "empty", "arange", "linspace",
+              "logspace", "geomspace", "eye", "identity", "tri",
+              "zeros_like", "ones_like", "full_like", "empty_like",
+              "meshgrid", "fromfunction", "frombuffer", "copy",
+              "ascontiguousarray", "asarray"]:
+    if hasattr(_jnp, _name):
+        globals()[_name] = _creation(_name, getattr(_jnp, _name))
+
+
+def array(obj, dtype=None, ctx=None, device=None, copy=True):
+    """Create an array (parity: mx.np.array; ``ctx``/``device`` accepted)."""
+    if isinstance(obj, NDArray):
+        obj = obj.jax
+    val = _jnp.array(obj, dtype=_base.dtype_np_to_jax(dtype) if dtype else None)
+    return NDArray(val, ctx=ctx or device or _current_context())
+
+
+__all__.append("array")
+
+
+def may_share_memory(a, b, max_work=None):
+    return False
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+# ------------------------------------------------------------- submodules
+
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+
+__all__ += ["linalg", "random"]

@@ -1,0 +1,22 @@
+"""``mx.np.linalg`` — linear algebra (parity: python/mxnet/numpy/linalg.py,
+src/operator/numpy/linalg/**; TPU-first: jnp.linalg lowers to XLA's
+decomposition ops which run on the MXU where applicable)."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+from . import _wrap_np_op
+
+__all__ = []
+
+_NONDIFF_LA = {"matrix_rank"}
+
+for _name in ["norm", "svd", "svdvals", "cholesky", "qr", "inv", "pinv",
+              "det", "slogdet", "solve", "lstsq", "eig", "eigh", "eigvals",
+              "eigvalsh", "matrix_rank", "matrix_power", "multi_dot",
+              "tensorinv", "tensorsolve", "cond"]:
+    if hasattr(_jnp.linalg, _name):
+        globals()[_name] = _wrap_np_op(
+            _name, getattr(_jnp.linalg, _name),
+            differentiable=_name not in _NONDIFF_LA)
+        __all__.append(_name)

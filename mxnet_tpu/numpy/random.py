@@ -1,0 +1,233 @@
+"""``mx.np.random`` — stateful-feeling RNG over JAX functional keys.
+
+Parity: python/mxnet/numpy/random.py.  Each call draws a fresh key from the
+per-context RandomState (mxnet_tpu.random), so MXNet's
+``mx.np.random.seed(42)`` reproducibility contract holds while the underlying
+sampling stays functional (threefry — per-device counters, SURVEY.md §7.3.6).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax as _jax
+import jax.numpy as _jnp
+import numpy as _onp
+
+from .. import random as _random
+from ..base import dtype_np_to_jax as _canon
+from ..context import current_context as _current_context
+from ..ndarray.ndarray import NDArray, from_jax as _from_jax
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "multinomial", "gamma",
+           "beta", "exponential", "poisson", "lognormal", "laplace",
+           "gumbel", "logistic", "chisquare", "multivariate_normal",
+           "binomial", "bernoulli", "weibull", "pareto", "power", "rayleigh",
+           "f"]
+
+
+def seed(s):
+    _random.seed(int(s))
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _key():
+    return _random.next_key(_current_context())
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    val = _jax.random.uniform(_key(), _shape(size),
+                              dtype=_canon(dtype or "float32"),
+                              minval=low, maxval=high)
+    r = _from_jax(val)
+    if out is not None:
+        out._rebind(r.jax)
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    val = _jax.random.normal(_key(), _shape(size),
+                             dtype=_canon(dtype or "float32")) * scale + loc
+    r = _from_jax(val)
+    if out is not None:
+        out._rebind(r.jax)
+        return out
+    return r
+
+
+def randn(*shape, dtype=None):
+    return normal(0.0, 1.0, size=shape, dtype=dtype)
+
+
+def rand(*shape, dtype=None):
+    return uniform(0.0, 1.0, size=shape, dtype=dtype)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None):
+    if high is None:
+        low, high = 0, low
+    val = _jax.random.randint(_key(), _shape(size), int(low), int(high),
+                              dtype=_canon(dtype or "int32"))
+    return _from_jax(val)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None):
+    if isinstance(a, NDArray):
+        a = a.jax
+    elif isinstance(a, int):
+        a = _jnp.arange(a)
+    else:
+        a = _jnp.asarray(a)
+    if p is not None:
+        p = p.jax if isinstance(p, NDArray) else _jnp.asarray(p)
+    val = _jax.random.choice(_key(), a, _shape(size), replace=replace, p=p)
+    return _from_jax(val)
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (MXNet semantic)."""
+    perm = _jax.random.permutation(_key(), x.shape[0])
+    x._rebind(_jnp.take(x.jax, perm, axis=0))
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return _from_jax(_jax.random.permutation(_key(), x))
+    val = x.jax if isinstance(x, NDArray) else _jnp.asarray(x)
+    return _from_jax(_jax.random.permutation(_key(), val, independent=False))
+
+
+def multinomial(n, pvals, size=None):
+    pv = pvals.jax if isinstance(pvals, NDArray) else _jnp.asarray(pvals)
+    shape = _shape(size)
+    draws = _jax.random.choice(_key(), pv.shape[-1], shape + (int(n),),
+                               replace=True, p=pv)
+    counts = _jax.vmap(lambda d: _jnp.bincount(d, length=pv.shape[-1]))(
+        draws.reshape(-1, int(n))).reshape(shape + (pv.shape[-1],))
+    return _from_jax(counts)
+
+
+def _transform_sampler(name):
+    jfn = getattr(_jax.random, name)
+
+    def op(*args, size=None, ctx=None, device=None, dtype=None, **kw):
+        args = tuple(a.jax if isinstance(a, NDArray) else a for a in args)
+        val = jfn(_key(), *args, shape=_shape(size) or None, **kw)
+        if dtype is not None:
+            val = val.astype(_canon(dtype))
+        return _from_jax(val)
+
+    op.__name__ = name
+    return op
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    a = shape.jax if isinstance(shape, NDArray) else shape
+    val = _jax.random.gamma(_key(), a, shape=_shape(size) or None)
+    return _from_jax(val * scale)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    a = a.jax if isinstance(a, NDArray) else a
+    b = b.jax if isinstance(b, NDArray) else b
+    val = _jax.random.beta(_key(), a, b, shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def exponential(scale=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.exponential(_key(), _shape(size)) * scale
+    return _from_jax(val)
+
+
+def poisson(lam=1.0, size=None, ctx=None, device=None):
+    lam = lam.jax if isinstance(lam, NDArray) else lam
+    val = _jax.random.poisson(_key(), lam, shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.normal(_key(), _shape(size)) * sigma + mean
+    return _from_jax(_jnp.exp(val))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.laplace(_key(), _shape(size)) * scale + loc
+    return _from_jax(val)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.gumbel(_key(), _shape(size)) * scale + loc
+    return _from_jax(val)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.logistic(_key(), _shape(size)) * scale + loc
+    return _from_jax(val)
+
+
+def chisquare(df, size=None, ctx=None, device=None):
+    df = df.jax if isinstance(df, NDArray) else df
+    val = _jax.random.chisquare(_key(), df, shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None, device=None):
+    mean = mean.jax if isinstance(mean, NDArray) else _jnp.asarray(mean)
+    cov = cov.jax if isinstance(cov, NDArray) else _jnp.asarray(cov)
+    val = _jax.random.multivariate_normal(_key(), mean, cov,
+                                          shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def binomial(n, p, size=None, ctx=None, device=None):
+    n = n.jax if isinstance(n, NDArray) else n
+    p = p.jax if isinstance(p, NDArray) else p
+    val = _jax.random.binomial(_key(), n, p, shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def bernoulli(p, size=None, dtype=None, ctx=None, device=None):
+    p = p.jax if isinstance(p, NDArray) else p
+    val = _jax.random.bernoulli(_key(), p, shape=_shape(size) or None)
+    if dtype is not None:
+        val = val.astype(_canon(dtype))
+    return _from_jax(val)
+
+
+def weibull(a, size=None, ctx=None, device=None):
+    a = a.jax if isinstance(a, NDArray) else a
+    u = _jax.random.uniform(_key(), _shape(size) or _jnp.shape(a))
+    return _from_jax((-_jnp.log1p(-u)) ** (1.0 / a))
+
+
+def pareto(a, size=None, ctx=None, device=None):
+    a = a.jax if isinstance(a, NDArray) else a
+    val = _jax.random.pareto(_key(), a, shape=_shape(size) or None)
+    return _from_jax(val)
+
+
+def power(a, size=None, ctx=None, device=None):
+    a = a.jax if isinstance(a, NDArray) else a
+    u = _jax.random.uniform(_key(), _shape(size) or _jnp.shape(a))
+    return _from_jax(u ** (1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, device=None):
+    val = _jax.random.rayleigh(_key(), _shape(size)) * scale
+    return _from_jax(val)
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    num = chisquare(dfnum, size=size).jax / dfnum
+    den = chisquare(dfden, size=size).jax / dfden
+    return _from_jax(num / den)

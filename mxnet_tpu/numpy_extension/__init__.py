@@ -1,0 +1,144 @@
+"""``mx.npx`` — numpy-extension namespace (parity: python/mxnet/numpy_extension
++ ``mx.npx`` operator surface from src/operator/numpy/ non-numpy ops).
+
+These are the deep-learning ops that fall outside the NumPy standard
+(activation/norm/conv/pooling/embedding/...).  They delegate to the
+``mx.nd`` implementations, which are pure JAX functions — so npx code
+hybridizes into the same single XLA computation.
+"""
+from __future__ import annotations
+
+import threading as _threading
+
+from .. import base as _base
+from ..ndarray import ops as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "is_np_default_dtype", "use_np", "np_shape", "np_array"]
+
+_np_state = _threading.local()
+
+
+def set_np(shape=True, array=True, dtype=False):
+    _np_state.shape = shape
+    _np_state.array = array
+    _np_state.dtype = dtype
+
+
+def reset_np():
+    set_np(False, False, False)
+
+
+def is_np_array():
+    return getattr(_np_state, "array", False)
+
+
+def is_np_shape():
+    return getattr(_np_state, "shape", False)
+
+
+def is_np_default_dtype():
+    return getattr(_np_state, "dtype", False)
+
+
+class _NpScope:
+    def __init__(self, shape=True, array=True, dtype=False):
+        self._new = (shape, array, dtype)
+
+    def __enter__(self):
+        self._old = (is_np_shape(), is_np_array(), is_np_default_dtype())
+        set_np(*self._new)
+        return self
+
+    def __exit__(self, *a):
+        set_np(*self._old)
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with _NpScope(*self._new):
+                return fn(*args, **kwargs)
+        return wrapped
+
+
+def use_np(fn=None):
+    scope = _NpScope(True, True, False)
+    return scope(fn) if fn is not None else scope
+
+
+def np_shape(active=True):
+    return _NpScope(active, is_np_array(), is_np_default_dtype())
+
+
+def np_array(active=True):
+    return _NpScope(is_np_shape(), active, is_np_default_dtype())
+
+
+# ----------------------------------------------------------- op delegation
+
+_DELEGATED = [
+    # activations / nn
+    "relu", "sigmoid", "softmax", "log_softmax", "softplus", "softsign",
+    "erf", "erfinv", "gamma", "gammaln",
+    # layers
+    "activation", "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "convolution", "deconvolution", "fully_connected", "pooling", "dropout",
+    "embedding", "rnn", "leaky_relu", "l2_normalization",
+    # indexing / shape
+    "one_hot", "pick", "topk", "gather_nd", "scatter_nd", "reshape_like",
+    "broadcast_like", "arange_like", "shape_array", "slice", "slice_axis",
+    "slice_like", "sequence_mask", "batch_dot",
+    # misc
+    "smooth_l1", "multibox_detection", "sample_multinomial",
+]
+
+_ALIAS_TO_ND = {
+    "activation": "Activation",
+    "batch_norm": "BatchNorm",
+    "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm",
+    "convolution": "Convolution",
+    "deconvolution": "Deconvolution",
+    "fully_connected": "FullyConnected",
+    "pooling": "Pooling",
+    "dropout": "Dropout",
+    "embedding": "Embedding",
+    "rnn": "RNN",
+    "leaky_relu": "LeakyReLU",
+    "l2_normalization": "L2Normalization",
+    "sequence_mask": "SequenceMask",
+}
+
+for _name in _DELEGATED:
+    _target = _ALIAS_TO_ND.get(_name, _name)
+    _fn = getattr(_nd, _target, None)
+    if _fn is not None:
+        globals()[_name] = _fn
+        __all__.append(_name)
+
+
+def save(file, arr):
+    """Save dict/list of np arrays (same container as mx.nd.save)."""
+    _nd.save(file, arr)
+
+
+def load(file):
+    return _nd.load(file)
+
+
+def waitall():
+    _nd.waitall()
+
+
+def seed(s):
+    from .. import random as _random
+    _random.seed(int(s))
+
+
+from ..context import cpu, gpu, num_gpus  # noqa: E402,F401
+
+__all__ += ["save", "load", "waitall", "seed", "cpu", "gpu", "num_gpus"]

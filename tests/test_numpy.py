@@ -1,0 +1,201 @@
+"""mx.np / mx.npx namespace tests (parity model: tests/python/unittest/
+test_numpy_op.py — numerics vs NumPy reference, autograd through np ops)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import NDArray
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_creation():
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    assert isinstance(a, NDArray)
+    assert a.shape == (2, 2)
+    onp.testing.assert_allclose(np.zeros((3, 2)).asnumpy(), onp.zeros((3, 2)))
+    onp.testing.assert_allclose(np.ones(4).asnumpy(), onp.ones(4))
+    onp.testing.assert_allclose(np.arange(5).asnumpy(), onp.arange(5))
+    onp.testing.assert_allclose(
+        np.linspace(0, 1, 5).asnumpy(), onp.linspace(0, 1, 5), rtol=1e-6)
+    onp.testing.assert_allclose(np.eye(3).asnumpy(), onp.eye(3))
+    onp.testing.assert_allclose(
+        np.full((2, 2), 7.0).asnumpy(), onp.full((2, 2), 7.0))
+
+
+@pytest.mark.parametrize("name", [
+    "exp", "log1p", "sqrt", "tanh", "sin", "arctan", "floor", "sign",
+])
+def test_unary_vs_numpy(name):
+    x = onp.random.RandomState(0).uniform(0.1, 2.0, (3, 4)).astype("float32")
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", [
+    "add", "subtract", "multiply", "divide", "power", "maximum", "arctan2",
+])
+def test_binary_vs_numpy(name):
+    rs = onp.random.RandomState(1)
+    a = rs.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    b = rs.uniform(0.5, 2.0, (3, 4)).astype("float32")
+    got = getattr(np, name)(np.array(a), np.array(b)).asnumpy()
+    want = getattr(onp, name)(a, b)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_broadcast_and_scalar_mix():
+    a = np.ones((2, 3))
+    out = np.add(a, 2.0)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 3.0))
+    out2 = np.multiply(3.0, a)
+    onp.testing.assert_allclose(out2.asnumpy(), onp.full((2, 3), 3.0))
+
+
+def test_reductions():
+    x = onp.random.RandomState(2).randn(4, 5).astype("float32")
+    a = np.array(x)
+    onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.mean(a).asnumpy(), x.mean(), rtol=1e-5)
+    onp.testing.assert_allclose(np.std(a, axis=0).asnumpy(), x.std(0),
+                                rtol=1e-4)
+    assert int(np.argmax(a).asnumpy()) == int(x.argmax())
+    onp.testing.assert_allclose(np.cumsum(a, axis=1).asnumpy(),
+                                x.cumsum(1), rtol=1e-5)
+
+
+def test_shape_manipulation():
+    x = onp.arange(24).reshape(2, 3, 4).astype("float32")
+    a = np.array(x)
+    onp.testing.assert_allclose(np.transpose(a, (2, 0, 1)).asnumpy(),
+                                x.transpose(2, 0, 1))
+    onp.testing.assert_allclose(np.reshape(a, (6, 4)).asnumpy(),
+                                x.reshape(6, 4))
+    onp.testing.assert_allclose(
+        np.concatenate([a, a], axis=1).asnumpy(),
+        onp.concatenate([x, x], axis=1))
+    parts = np.split(a, 2, axis=2)
+    assert len(parts) == 2 and parts[0].shape == (2, 3, 2)
+    onp.testing.assert_allclose(np.stack([a, a]).asnumpy(),
+                                onp.stack([x, x]))
+
+
+def test_linalg():
+    rs = onp.random.RandomState(3)
+    m = rs.randn(4, 4).astype("float32")
+    spd = m @ m.T + 4 * onp.eye(4, dtype="float32")
+    a = np.array(spd)
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm(spd), rtol=1e-5)
+    L = np.linalg.cholesky(a).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.linalg.det(a).asnumpy(),
+                                onp.linalg.det(spd), rtol=1e-3)
+    x = np.linalg.solve(a, np.ones((4, 1))).asnumpy()
+    onp.testing.assert_allclose(spd @ x, onp.ones((4, 1)), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_einsum_matmul_dot():
+    rs = onp.random.RandomState(4)
+    a = rs.randn(3, 4).astype("float32")
+    b = rs.randn(4, 5).astype("float32")
+    onp.testing.assert_allclose(np.matmul(np.array(a), np.array(b)).asnumpy(),
+                                a @ b, rtol=1e-5)
+    onp.testing.assert_allclose(np.dot(np.array(a), np.array(b)).asnumpy(),
+                                a @ b, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy(),
+        a @ b, rtol=1e-5)
+
+
+def test_autograd_through_np_ops():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.multiply(x, x))
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_autograd_einsum():
+    x = np.array(onp.random.RandomState(5).randn(3, 3).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = np.einsum("ij->", np.exp(x))
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.exp(x.asnumpy()),
+                                rtol=1e-5)
+
+
+def test_random_reproducible():
+    np.random.seed(42)
+    a = np.random.uniform(size=(3, 3)).asnumpy()
+    np.random.seed(42)
+    b = np.random.uniform(size=(3, 3)).asnumpy()
+    onp.testing.assert_allclose(a, b)
+    c = np.random.uniform(size=(3, 3)).asnumpy()
+    assert not onp.allclose(a, c)
+
+
+def test_random_distributions():
+    np.random.seed(0)
+    n = np.random.normal(2.0, 0.5, size=(10000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.05
+    assert abs(n.std() - 0.5) < 0.05
+    r = np.random.randint(0, 10, size=(1000,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 10
+    g = np.random.gamma(2.0, 2.0, size=(20000,)).asnumpy()
+    assert abs(g.mean() - 4.0) < 0.2
+    p = np.random.poisson(3.0, size=(10000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.15
+
+
+def test_random_shuffle_and_choice():
+    np.random.seed(1)
+    x = np.arange(10)
+    np.random.shuffle(x)
+    assert sorted(x.asnumpy().tolist()) == list(range(10))
+    c = np.random.choice(5, size=(100,)).asnumpy()
+    assert set(c.tolist()) <= set(range(5))
+
+
+def test_npx_ops():
+    x = np.array([[-1.0, 2.0], [0.5, -3.0]])
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(),
+                                onp.maximum(x.asnumpy(), 0))
+    s = npx.softmax(x, axis=-1).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(2), rtol=1e-6)
+    oh = npx.one_hot(np.array([0, 2], dtype="int32"), 3).asnumpy()
+    onp.testing.assert_allclose(oh, onp.eye(3)[[0, 2]])
+
+
+def test_npx_np_scope():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+    @npx.use_np
+    def inner():
+        return npx.is_np_array()
+    assert inner()
+    assert not npx.is_np_array()
+
+
+def test_where_take_sort():
+    x = onp.random.RandomState(6).randn(5, 5).astype("float32")
+    a = np.array(x)
+    onp.testing.assert_allclose(
+        np.where(a > 0, a, np.zeros_like(a)).asnumpy(),
+        onp.where(x > 0, x, 0))
+    onp.testing.assert_allclose(np.sort(a, axis=1).asnumpy(),
+                                onp.sort(x, axis=1))
+    onp.testing.assert_allclose(
+        np.take(a, np.array([0, 2], dtype="int32"), axis=0).asnumpy(),
+        onp.take(x, [0, 2], axis=0))
