@@ -28,6 +28,7 @@ from .. import base as _base
 from .. import random as _random
 from ..autograd.tape import OpNode, OutRef, node_of
 from ..ndarray import NDArray
+from ..ndarray.ndarray import swap_values
 
 
 class CachedOp:
@@ -59,40 +60,33 @@ class CachedOp:
         def pure(flat_args, key):
             param_vals = flat_args[:n_params]
             input_vals = flat_args[n_params:]
-            provider = _random.push_trace_key(key)
-            saved = []
+            _random.push_trace_key(key)
             try:
-                # swap traced values into the parameter payloads
-                for (name, p), v in zip(param_objs, param_vals):
-                    d = p._data
-                    saved.append((d, d._data, d._node))
-                    d._data = v
-                    d._node = None
-                args = unflatten(input_vals)
-                with _base.training_mode(train):
-                    rec = _base.set_recording(False)
-                    try:
-                        out = block.forward(*args)
-                    finally:
-                        _base.set_recording(rec)
-                outs, out_tree = _flatten_out(out)
-                out_vals = [o.jax for o in outs]
-                # functionalized aux-state updates: a param whose payload no
-                # longer is the tracer we swapped in was mutated in forward
-                aux_vals = []
-                aux_idx = []
-                for i, (((name, p), v), (d, old, _)) in enumerate(
-                        zip(zip(param_objs, param_vals), saved)):
-                    if d._data is not v:
-                        aux_vals.append(d._data)
-                        aux_idx.append(i)
-                pure._out_tree = out_tree
-                pure._aux_idx = aux_idx
-                return tuple(out_vals) + tuple(aux_vals)
+                nds = [p._data for _, p in param_objs]
+                with swap_values(nds, param_vals) as saved:
+                    args = unflatten(input_vals)
+                    with _base.training_mode(train):
+                        rec = _base.set_recording(False)
+                        try:
+                            out = block.forward(*args)
+                        finally:
+                            _base.set_recording(rec)
+                    outs, out_tree = _flatten_out(out)
+                    out_vals = [o.jax for o in outs]
+                    # functionalized aux-state updates: a param whose payload
+                    # no longer is the tracer we swapped in was mutated in
+                    # forward
+                    aux_vals = []
+                    aux_idx = []
+                    for i, (v, (d, old, _)) in enumerate(
+                            zip(param_vals, saved)):
+                        if d._data is not v:
+                            aux_vals.append(d._data)
+                            aux_idx.append(i)
+                    pure._out_tree = out_tree
+                    pure._aux_idx = aux_idx
+                    return tuple(out_vals) + tuple(aux_vals)
             finally:
-                for d, old, nodev in saved:
-                    d._data = old
-                    d._node = nodev
                 _random.pop_trace_key()
 
         return pure

@@ -26,6 +26,7 @@ class BERTModel(HybridBlock):
         super().__init__(**kwargs)
         self._units = units
         self.vocab_size = vocab_size
+        self.max_length = max_length
         self.word_embed = Embedding(vocab_size, units)
         annotate(self.word_embed.weight, "vocab", "embed")
         self.token_type_embed = Embedding(type_vocab_size, units)
@@ -45,6 +46,10 @@ class BERTModel(HybridBlock):
 
     def forward(self, tokens, token_types=None, valid_length=None):
         b, t = tokens.shape
+        if isinstance(t, int) and t > self.max_length:
+            raise ValueError(
+                f"sequence length {t} exceeds max_length={self.max_length} "
+                "(position table size)")
         pos = F.arange_like(tokens, axis=1).astype("int32")
         x = self.word_embed(tokens) + self.position_embed(pos)
         if token_types is not None:

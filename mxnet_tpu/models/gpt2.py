@@ -49,6 +49,10 @@ class GPT2Model(HybridBlock):
 
     def forward(self, tokens):
         b, t = tokens.shape
+        if isinstance(t, int) and t > self.max_length:
+            raise ValueError(
+                f"sequence length {t} exceeds max_length={self.max_length} "
+                "(position table size)")
         pos = F.arange_like(tokens, axis=1).astype("int32")
         x = self.wte(tokens) + self.wpe(pos)
         x = _par.with_sharding_constraint(x, "batch", "seq", None)

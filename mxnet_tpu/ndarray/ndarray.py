@@ -487,6 +487,30 @@ def _rebind_view(self, new_value, node=None):
 NDArray._rebind = _rebind_view
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def swap_values(nds, values):
+    """Temporarily rebind each NDArray's payload to a traced value.
+
+    The functionalization primitive shared by CachedOp, ShardedTrainer and
+    the driver entry: inside the scope each NDArray in `nds` holds the
+    corresponding (usually tracer) value with no autograd node; on exit the
+    original payload/node are restored.  Mutations made inside the scope are
+    visible via each NDArray's current payload before exit (callers read them
+    to functionalize aux-state updates such as BatchNorm running stats).
+    """
+    saved = [(d, d._data, d._node) for d in nds]
+    for d, v in zip(nds, values):
+        d._data, d._node = v, None
+    try:
+        yield saved
+    finally:
+        for d, old, node in saved:
+            d._data, d._node = old, node
+
+
 # ----------------------------------------------------------------- creation
 
 def _put(value, ctx: Optional[Context]) -> jax.Array:

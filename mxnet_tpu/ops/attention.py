@@ -93,6 +93,16 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
             "impl='flash' does not support an explicit mask or attention "
             "dropout — use impl='auto'/'ref'")
 
+    if impl == "flash" and not _use_flash(query.shape, causal, mask_val,
+                                          dropout):
+        raise _base.MXNetError(
+            f"impl='flash' requested but the Pallas kernel does not support "
+            f"this configuration (shape={tuple(query.shape)}, platform="
+            f"{query.jax.devices().pop().platform if hasattr(query.jax, 'devices') else '?'}): "
+            "seq_len and head_dim must be multiples of the kernel block "
+            "sizes and the device must be a TPU — use impl='auto' to fall "
+            "back silently")
+
     def f(q, k, v):
         if impl != "ref" and _use_flash(q.shape, causal, mask_val, dropout):
             from .flash import flash_attention as _pallas

@@ -491,9 +491,14 @@ class Updater:
             return s
 
         buf = io.BytesIO()
-        onp.save(buf, onp.asarray(
-            [{k: conv(v) for k, v in self.states.items()}], dtype=object),
-            allow_pickle=True)
+        payload = {
+            "__states__": {k: conv(v) for k, v in self.states.items()},
+            "__num_update__": self.optimizer.num_update,
+            "__index_update_count__": dict(
+                self.optimizer._index_update_count),
+        }
+        onp.save(buf, onp.asarray([payload], dtype=object),
+                 allow_pickle=True)
         return buf.getvalue()
 
     def set_states(self, states_bytes):
@@ -512,7 +517,16 @@ class Updater:
                 return tuple(conv(x) for x in s)
             return s
 
-        self.states = {k: conv(v) for k, v in loaded.items()}
+        if "__states__" in loaded:
+            # format with optimizer progress (Adam bias correction, lr
+            # schedules) so a resumed run matches an uninterrupted one
+            self.states = {k: conv(v)
+                           for k, v in loaded["__states__"].items()}
+            self.optimizer.num_update = int(loaded["__num_update__"])
+            self.optimizer._index_update_count.update(
+                loaded["__index_update_count__"])
+        else:
+            self.states = {k: conv(v) for k, v in loaded.items()}
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

@@ -61,11 +61,12 @@ def current_mesh() -> Optional[Mesh]:
     if _current:
         return _current[-1]
     try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
         m = jax.interpreters.pxla.thread_resources.env.physical_mesh
-        if len(m.axis_names) > 0:
-            return m
-    except Exception:
-        pass
+    if len(m.axis_names) > 0:
+        return m
     return None
 
 

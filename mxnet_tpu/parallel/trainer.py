@@ -23,6 +23,7 @@ from .. import base as _base
 from .. import optimizer as opt_mod
 from .. import random as _random
 from ..ndarray import NDArray
+from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
 from .sharding import ShardingRules, batch_spec, logical_axes_of, shard_params
 
@@ -140,12 +141,15 @@ class ShardedTrainer:
         self._aux: List[Tuple[str, Any]] = []
         self._states: List[Any] = []       # NDArray pytrees, per trainable
         self._state_flat: List[NDArray] = []
+        self._state_shardings: List[NamedSharding] = []
+        self._pending_states: Optional[dict] = None
 
     # ------------------------------------------------------------------
     def _build(self, data, labels):
         net = self.net
-        # settle deferred shapes with one eager forward
-        with _base.training_mode(True):
+        # settle deferred shapes with one eager forward — in inference mode
+        # so BatchNorm running stats / dropout are untouched by shape settling
+        with _base.training_mode(False):
             rec = _base.set_recording(False)
             try:
                 net(*data)
@@ -171,23 +175,23 @@ class ShardedTrainer:
             self._state_flat.extend(_state_leaves(st))
         # place params on the mesh
         shard_params(net, self.mesh, self.rules)
-        for st in self._state_flat:
-            st._rebind(jax.device_put(st.jax, self._leaf_sharding(st)))
+        # a state leaf shards like its parameter when shapes match
+        self._state_shardings = []
+        for (name, p), st in zip(self._trainable, self._states):
+            psh = NamedSharding(self.mesh, self.rules.spec(logical_axes_of(p)))
+            repl = NamedSharding(self.mesh, P())
+            for l in _state_leaves(st):
+                self._state_shardings.append(
+                    psh if tuple(l.shape) == tuple(p.shape) else repl)
+        for st, sh in zip(self._state_flat, self._state_shardings):
+            st._rebind(jax.device_put(st.jax, sh))
         self._state_trees = [_flatten_state(st)[1] for st in self._states]
         self._state_counts = [len(_state_leaves(st)) for st in self._states]
         self._compile(data, labels)
         self._built = True
-
-    def _leaf_sharding(self, leaf_nd):
-        """A state leaf shards like its parameter when shapes match."""
-        for (name, p), st in zip(self._trainable, self._states):
-            for l in _state_leaves(st):
-                if l is leaf_nd:
-                    if tuple(l.shape) == tuple(p.shape):
-                        return NamedSharding(
-                            self.mesh, self.rules.spec(logical_axes_of(p)))
-                    return NamedSharding(self.mesh, P())
-        return NamedSharding(self.mesh, P())
+        if self._pending_states is not None:
+            self._apply_loaded_states(self._pending_states)
+            self._pending_states = None
 
     # ------------------------------------------------------------------
     def _make_pure(self, n_data):
@@ -199,25 +203,17 @@ class ShardedTrainer:
 
         def pure(param_vals, aux_vals, state_vals, batch_vals, key, lr, t):
             _random.push_trace_key(key)
-            saved = []
             ctx = use_mesh(mesh)
             ctx.__enter__()
+            aux_nds = [p._data for _, p in aux]
+            swap_ctx = swap_values(aux_nds, aux_vals)
+            swap_ctx.__enter__()
             try:
                 data = [NDArray(v) for v in batch_vals[:n_data]]
                 labels = [NDArray(v) for v in batch_vals[n_data:]]
 
-                for (_, p), v in zip(aux, aux_vals):
-                    d = p._data
-                    saved.append((d, d._data, d._node))
-                    d._data, d._node = v, None
-
                 def forward(pvals):
-                    inner = []
-                    for (_, p), v in zip(trainable, pvals):
-                        d = p._data
-                        inner.append((d, d._data, d._node))
-                        d._data, d._node = v, None
-                    try:
+                    with swap_values([p._data for _, p in trainable], pvals):
                         with _base.training_mode(True):
                             rec = _base.set_recording(False)
                             try:
@@ -233,9 +229,6 @@ class ShardedTrainer:
                         new_aux = tuple(
                             p._data._data for _, p in aux)
                         return lval, new_aux
-                    finally:
-                        for d, old, nodev in inner:
-                            d._data, d._node = old, nodev
 
                 (loss_val, new_aux), grads = jax.value_and_grad(
                     forward, has_aux=True)(tuple(param_vals))
@@ -257,9 +250,8 @@ class ShardedTrainer:
                 return (loss_val, tuple(new_params), tuple(new_aux),
                         tuple(new_states))
             finally:
+                swap_ctx.__exit__(None, None, None)
                 ctx.__exit__()
-                for d, old, nodev in saved:
-                    d._data, d._node = old, nodev
                 _random.pop_trace_key()
 
         return pure
@@ -276,9 +268,7 @@ class ShardedTrainer:
                          for _, p in self._trainable)
         aux_sh = tuple(ns(rules.spec(logical_axes_of(p)))
                        for _, p in self._aux)
-        state_sh = tuple(self._leaf_sharding(l).spec
-                         for l in self._state_flat)
-        state_sh = tuple(ns(s) for s in state_sh)
+        state_sh = tuple(self._state_shardings)
 
         def default_spec(v):
             return batch_spec(v.ndim, 0, self._seq_axis)
@@ -341,8 +331,14 @@ class ShardedTrainer:
         self.optimizer.set_learning_rate(lr)
 
     def save_states(self, fname):
+        from ..ndarray import array as _nd_array
         from ..utils.serialization import save
-        data = {}
+        if not self._built:
+            raise _base.MXNetError(
+                "save_states before the first step(): optimizer states do "
+                "not exist yet (nothing to save)")
+        data = {"num_update": _nd_array([self.optimizer.num_update],
+                                        dtype="int64")}
         for i, st in enumerate(self._states):
             for j, l in enumerate(_state_leaves(st)):
                 data[f"state_{i}_{j}"] = l
@@ -351,7 +347,18 @@ class ShardedTrainer:
     def load_states(self, fname):
         from ..utils.serialization import load
         loaded = load(fname)
+        if not self._built:
+            # states don't exist until the first step; apply after _build
+            self._pending_states = loaded
+            return
+        self._apply_loaded_states(loaded)
+
+    def _apply_loaded_states(self, loaded):
+        if "num_update" in loaded:
+            self.optimizer.num_update = int(loaded["num_update"].asnumpy()[0])
+        flat_idx = 0
         for i, st in enumerate(self._states):
             for j, l in enumerate(_state_leaves(st)):
                 l._rebind(jax.device_put(loaded[f"state_{i}_{j}"].jax,
-                                         self._leaf_sharding(l)))
+                                         self._state_shardings[flat_idx]))
+                flat_idx += 1
