@@ -1,0 +1,510 @@
+"""``mx.sym`` — the symbolic graph API.
+
+Parity target: ``python/mxnet/symbol/symbol.py`` + NNVM graph JSON
+(``nnvm::Symbol``, SaveJSON/LoadJSON passes — SURVEY.md §2.2).
+
+TPU-first realization: a Symbol is a lightweight deferred-expression DAG over
+the SAME pure-JAX op registry that backs ``mx.nd`` — there is no separate
+symbolic kernel path.  ``Executor.forward`` evaluates the DAG by replaying it
+through the nd ops (so autograd, hybridize and sharding all behave exactly as
+imperative code), and ``simple_bind`` jits the whole graph into one XLA
+computation — the GraphExecutor role (src/executor/graph_executor.cc) with
+XLA doing memory planning, fusion and scheduling.
+
+The JSON format mirrors NNVM graph JSON (nodes with op/name/attrs/inputs,
+``op == "null"`` for variables, arg_nodes/heads) so tooling that inspects
+exported MXNet graphs can read ours.
+"""
+from __future__ import annotations
+
+import json as _json
+from builtins import all as builtins_all
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _onp
+
+from .. import base as _base
+from ..context import current_context
+from ..ndarray import NDArray
+from .. import ndarray as _nd_ops
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor", "zeros", "ones", "arange"]
+
+
+class Symbol:
+    """A node in the deferred op DAG.
+
+    ``op`` is an ``mx.nd`` op name (or ``"null"`` for a variable); ``inputs``
+    are parent Symbols; ``attrs`` are the op's non-tensor kwargs.  A Symbol
+    may be multi-output (``num_outputs > 1``, e.g. ``split``); ``out_index``
+    selects one output of a multi-output parent.
+    """
+
+    def __init__(self, op: str, name: str, inputs: Sequence["Symbol"] = (),
+                 attrs: Optional[Dict[str, Any]] = None, num_outputs: int = 1,
+                 out_index: Optional[int] = None, base: "Symbol" = None):
+        self._op = op
+        self._name = name
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._num_outputs = num_outputs
+        self._out_index = out_index
+        self._base = base  # multi-output selection points at the base node
+
+    # ------------------------------------------------------------- info
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+    def list_arguments(self) -> List[str]:
+        seen, order = set(), []
+
+        def walk(s):
+            s = s._base or s
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            if s._op == "null":
+                order.append(s._name)
+
+        walk(self)
+        return order
+
+    def list_outputs(self) -> List[str]:
+        if self._op == "group":
+            return [o.list_outputs()[0] for o in self._inputs]
+        if self._num_outputs > 1 and self._out_index is None:
+            return [f"{self._name}_output{i}"
+                    for i in range(self._num_outputs)]
+        return [f"{self._name}_output"]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self.list_outputs())
+
+    def get_internals(self) -> "Symbol":
+        nodes = _topo(self)
+        outs = [n for n in nodes if n._op != "null"]
+        return Group([_select(n, 0) if n._num_outputs > 1 else n
+                      for n in outs]) if len(outs) > 1 else self
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for n in _topo(self):
+                if n._name == index or f"{n._name}_output" == index:
+                    return n
+            raise _base.MXNetError(f"no internal output {index!r}")
+        if self._op == "group":
+            return self._inputs[index]
+        if self._num_outputs > 1 and self._out_index is None:
+            return _select(self, index)
+        if index == 0:
+            return self
+        raise IndexError(index)
+
+    def __iter__(self):
+        return iter([self[i] for i in range(self.num_outputs)])
+
+    # -------------------------------------------------------- arithmetic
+    def _binary(self, opname, other, reflected=False):
+        if isinstance(other, Symbol):
+            ins = (other, self) if reflected else (self, other)
+            return _apply(opname, ins, {})
+        attrs = {"scalar": float(other), "reflected": reflected}
+        return _apply(f"_{opname}_scalar", (self,), attrs)
+
+    def __add__(self, o): return self._binary("add", o)
+    def __radd__(self, o): return self._binary("add", o, True)
+    def __sub__(self, o): return self._binary("subtract", o)
+    def __rsub__(self, o): return self._binary("subtract", o, True)
+    def __mul__(self, o): return self._binary("multiply", o)
+    def __rmul__(self, o): return self._binary("multiply", o, True)
+    def __truediv__(self, o): return self._binary("divide", o)
+    def __rtruediv__(self, o): return self._binary("divide", o, True)
+    def __pow__(self, o): return self._binary("power", o)
+    def __neg__(self): return _apply("negative", (self,), {})
+
+    def __eq__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary("equal", o)
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary("not_equal", o)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------- evaluation
+    def eval(self, ctx=None, **bindings) -> List[NDArray]:
+        """Evaluate imperatively with NDArray bindings for each argument."""
+        env = {k: v if isinstance(v, NDArray) else _nd_ops.array(v)
+               for k, v in bindings.items()}
+        outs = _evaluate(self, env)
+        return outs
+
+    def infer_shape(self, **shapes):
+        """Returns (arg_shapes, out_shapes, aux_shapes) like MXNet."""
+        import jax
+
+        args = self.list_arguments()
+        missing = [a for a in args if a not in shapes]
+        if missing:
+            raise _base.MXNetError(f"infer_shape missing args {missing}")
+        avals = {a: jax.ShapeDtypeStruct(tuple(shapes[a]), _onp.float32)
+                 for a in args}
+
+        def f(env):
+            outs = _evaluate_abstract(self, env)
+            return [o for o in outs]
+
+        outs = jax.eval_shape(f, avals)
+        return ([tuple(shapes[a]) for a in args],
+                [tuple(o.shape) for o in outs], [])
+
+    def infer_type(self, **dtypes):
+        args = self.list_arguments()
+        return ([_onp.dtype(dtypes.get(a, _onp.float32)) for a in args],
+                [_onp.dtype(_onp.float32) for _ in self.list_outputs()], [])
+
+    # ---------------------------------------------------------- binding
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None) -> "Executor":
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes) -> "Executor":
+        arg_shapes, _, _ = self.infer_shape(**shapes)
+        names = self.list_arguments()
+        args = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
+        grads = None
+        if grad_req != "null":
+            grads = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
+        return Executor(self, ctx, args, grads, grad_req)
+
+    # ---------------------------------------------------- serialization
+    def tojson(self) -> str:
+        nodes = _topo(self)
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": n._op if n._op != "null" else "null",
+                "name": n._name,
+                "attrs": {k: _json.dumps(v) if not isinstance(v, str) else v
+                          for k, v in n._attrs.items()},
+                "inputs": [[idx[id(i._base or i)], i._out_index or 0, 0]
+                           for i in n._inputs],
+            })
+        heads = []
+        if self._op == "group":
+            for o in self._inputs:
+                heads.append([idx[id(o._base or o)], o._out_index or 0, 0])
+        else:
+            base = self._base or self
+            heads.append([idx[id(base)], self._out_index or 0, 0])
+        return _json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n._op == "null"],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": heads,
+            "attrs": {"mxnet_version": ["int", 20000]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # debug string roughly like MXNet's
+    def debug_str(self):
+        lines = []
+        for n in _topo(self):
+            ins = ", ".join(i._name for i in n._inputs)
+            lines.append(f"{n._op}\t{n._name}({ins})")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------- internals
+
+_UID = [0]
+
+
+def _auto_name(op):
+    _UID[0] += 1
+    return f"{op.lower()}{_UID[0]}"
+
+
+def _select(base: Symbol, index: int) -> Symbol:
+    s = Symbol(base._op, base._name, base._inputs, base._attrs,
+               num_outputs=base._num_outputs, out_index=index, base=base)
+    return s
+
+
+def _apply(op: str, inputs: Sequence[Symbol], attrs: Dict[str, Any],
+           name: Optional[str] = None, num_outputs: int = 1) -> Symbol:
+    return Symbol(op, name or _auto_name(op), inputs, attrs, num_outputs)
+
+
+def _topo(root: Symbol) -> List[Symbol]:
+    seen, order = set(), []
+
+    def walk(s):
+        s = s._base or s
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        for i in s._inputs:
+            walk(i)
+        if s._op != "group":
+            order.append(s)
+
+    walk(root)
+    return order
+
+
+def _run_node(n: Symbol, in_vals):
+    """Execute one graph node through the nd op registry."""
+    attrs = dict(n._attrs)
+    if n._op.endswith("_scalar") and n._op.startswith("_"):
+        opname = n._op[1:-len("_scalar")]
+        scalar = attrs["scalar"]
+        fn = getattr(_nd_ops, opname)
+        if attrs.get("reflected"):
+            return fn(scalar, in_vals[0])
+        return fn(in_vals[0], scalar)
+    fn = getattr(_nd_ops, n._op, None)
+    if fn is None:
+        raise _base.MXNetError(f"unknown op in graph: {n._op}")
+    return fn(*in_vals, **attrs)
+
+
+def _evaluate(root: Symbol, env: Dict[str, NDArray]) -> List[NDArray]:
+    cache: Dict[int, Any] = {}
+    for n in _topo(root):
+        if n._op == "none":
+            cache[id(n)] = None
+            continue
+        if n._op == "null":
+            if n._name not in env:
+                raise _base.MXNetError(f"unbound argument {n._name!r}")
+            cache[id(n)] = env[n._name]
+            continue
+        ins = []
+        for i in n._inputs:
+            v = cache[id(i._base or i)]
+            if i._out_index is not None:
+                v = v[i._out_index]
+            ins.append(v)
+        cache[id(n)] = _run_node(n, ins)
+
+    def out_of(s):
+        v = cache[id(s._base or s)]
+        if s._out_index is not None:
+            v = v[s._out_index]
+        if isinstance(v, (list, tuple)):
+            return list(v)
+        return [v]
+
+    if root._op == "group":
+        outs = []
+        for o in root._inputs:
+            outs.extend(out_of(o))
+        return outs
+    return out_of(root)
+
+
+def _evaluate_abstract(root: Symbol, traced: Dict[str, Any]):
+    env = {k: v if isinstance(v, NDArray) else NDArray(v)
+           for k, v in traced.items()}
+    outs = _evaluate(root, env)
+    return [o.jax if isinstance(o, NDArray) else o for o in outs]
+
+
+# ----------------------------------------------------------------- factory
+
+def Variable(name, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
+    s = Symbol("null", name)
+    if shape is not None:
+        s._attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        s._attrs["__dtype__"] = str(_onp.dtype(dtype))
+    return s
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    return Symbol("group", _auto_name("group"), list(symbols))
+
+
+def load(fname) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    g = _json.loads(json_str)
+    nodes: List[Symbol] = []
+    for jn in g["nodes"]:
+        attrs = {}
+        for k, v in jn.get("attrs", {}).items():
+            try:
+                attrs[k] = _json.loads(v) if isinstance(v, str) else v
+            except (ValueError, TypeError):
+                attrs[k] = v
+        if jn["op"] == "null":
+            s = Symbol("null", jn["name"], attrs=attrs)
+        else:
+            ins = []
+            for (nid, out_i, _) in jn["inputs"]:
+                parent = nodes[nid]
+                ins.append(_select(parent, out_i)
+                           if parent._num_outputs > 1 else parent)
+            s = Symbol(jn["op"], jn["name"], ins, attrs)
+        nodes.append(s)
+    heads = g["heads"]
+    outs = []
+    for (nid, out_i, _) in heads:
+        parent = nodes[nid]
+        outs.append(_select(parent, out_i)
+                    if parent._num_outputs > 1 else parent)
+    return outs[0] if len(outs) == 1 else Group(outs)
+
+
+# ------------------------------------------------------------- executor
+
+class Executor:
+    """Graph executor (parity: GraphExecutor via simple_bind/bind).
+
+    ``forward`` replays the graph through the nd ops (recording autograd when
+    ``is_train``); ``backward`` pulls gradients into ``args_grad`` honoring
+    ``grad_req``.  XLA does memory planning/fusion when the surrounding code
+    jits — there is no hand-built memory planner to maintain.
+    """
+
+    def __init__(self, symbol: Symbol, ctx, args, args_grad, grad_req):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(names, args_grad))
+        self.arg_dict: Dict[str, NDArray] = dict(args or {})
+        self.grad_dict: Dict[str, NDArray] = dict(args_grad or {})
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in names}
+        self._grad_req = grad_req
+        self.outputs: List[NDArray] = []
+        self.aux_dict: Dict[str, NDArray] = {}
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    def forward(self, is_train=False, **kwargs):
+        from .. import autograd
+        for k, v in kwargs.items():
+            if not isinstance(v, NDArray):
+                v = _nd_ops.array(v)
+            self.arg_dict[k] = v
+        if is_train:
+            self._tracked = []
+            for n, a in self.arg_dict.items():
+                req = self._grad_req.get(n, "null")
+                if req != "null" and n in self.grad_dict:
+                    a.attach_grad(req)
+                    self._tracked.append(n)
+            with autograd.record():
+                self.outputs = _evaluate(self._symbol, self.arg_dict)
+                self._train_outputs = self.outputs
+        else:
+            self.outputs = _evaluate(self._symbol, self.arg_dict)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from .. import autograd
+        outs = getattr(self, "_train_outputs", None)
+        if outs is None:
+            raise _base.MXNetError("backward before forward(is_train=True)")
+        if out_grads is None:
+            grads = [_nd_ops.ones_like(o) for o in outs]
+        elif isinstance(out_grads, NDArray):
+            grads = [out_grads]
+        else:
+            grads = list(out_grads)
+        autograd.backward(outs, grads)
+        for n in self._tracked:
+            g = self.arg_dict[n].grad
+            if g is not None:
+                self.grad_dict[n]._rebind(g.jax)
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(v.jax)
+
+
+# ------------------------------------------------- symbolic op namespace
+
+_SYM_ONLY = {"null", "group"}
+
+
+def _sym_op(opname):
+    def op(*args, name: Optional[str] = None, **kwargs):
+        # None positional inputs (e.g. bias with no_bias=True) become "none"
+        # sentinel nodes so argument positions survive serialization
+        args = tuple(Symbol("none", _auto_name("none")) if a is None else a
+                     for a in args)
+        if not builtins_all(isinstance(a, Symbol) for a in args):
+            raise _base.MXNetError(
+                f"sym.{opname} expects Symbol inputs, got "
+                f"{[type(a).__name__ for a in args]}")
+        num_outputs = 1
+        if opname in ("split", "SliceChannel"):
+            num_outputs = int(kwargs.get("num_outputs",
+                                         kwargs.get("indices_or_sections", 1)))
+        return _apply(opname, args, kwargs, name=name,
+                      num_outputs=num_outputs)
+
+    op.__name__ = opname
+    return op
+
+
+def __getattr__(name):
+    if name.startswith("_") or name in _SYM_ONLY:
+        raise AttributeError(name)
+    if hasattr(_nd_ops, name) and callable(getattr(_nd_ops, name)):
+        fn = _sym_op(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute "
+                         f"{name!r}")
+
+
+def zeros(shape, dtype="float32", name=None, **kw):
+    return _apply("zeros", (), {"shape": tuple(shape), "dtype": dtype},
+                  name=name)
+
+
+def ones(shape, dtype="float32", name=None, **kw):
+    return _apply("ones", (), {"shape": tuple(shape), "dtype": dtype},
+                  name=name)
+
+
+def arange(start, stop=None, step=1.0, name=None, **kw):
+    return _apply("arange", (), {"start": start, "stop": stop, "step": step},
+                  name=name)

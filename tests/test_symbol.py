@@ -1,0 +1,128 @@
+"""mx.sym symbolic API tests (parity model: tests/python/unittest/
+test_symbol.py — compose, JSON roundtrip, bind/executor, infer_shape)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sym = mx.sym
+
+
+def test_variable_and_compose():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2.0
+    assert set(c.list_arguments()) == {"a", "b"}
+    out = c.eval(a=nd.array([1.0, 2.0]), b=nd.array([3.0, 4.0]))
+    onp.testing.assert_allclose(out[0].asnumpy(), [7.0, 10.0])
+
+
+def test_named_ops_and_eval():
+    x = sym.Variable("x")
+    y = sym.relu(x, name="act")
+    z = sym.sum(y)
+    out = z.eval(x=nd.array([-1.0, 2.0, -3.0, 4.0]))
+    assert float(out[0].asnumpy()) == 6.0
+
+
+def test_fully_connected_graph():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    b = sym.Variable("b")
+    fc = sym.FullyConnected(x, w, b, num_hidden=3)
+    loss = sym.sum(fc)
+    args = loss.list_arguments()
+    assert args == ["data", "w", "b"]
+    out = loss.eval(data=nd.ones((2, 4)), w=nd.ones((3, 4)),
+                    b=nd.zeros((3,)))
+    assert float(out[0].asnumpy()) == 2 * 3 * 4
+
+
+def test_json_roundtrip():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = sym.tanh(x * y + 2.0)
+    js = z.tojson()
+    z2 = sym.load_json(js)
+    assert z2.list_arguments() == z.list_arguments()
+    xa, ya = nd.array([0.5, 1.0]), nd.array([2.0, -1.0])
+    onp.testing.assert_allclose(z.eval(x=xa, y=ya)[0].asnumpy(),
+                                z2.eval(x=xa, y=ya)[0].asnumpy())
+
+
+def test_save_load_file(tmp_path):
+    x = sym.Variable("x")
+    z = sym.exp(sym.negative(x))
+    f = str(tmp_path / "m-symbol.json")
+    z.save(f)
+    z2 = sym.load(f)
+    xa = nd.array([0.0, 1.0])
+    onp.testing.assert_allclose(z2.eval(x=xa)[0].asnumpy(),
+                                onp.exp(-xa.asnumpy()), rtol=1e-6)
+
+
+def test_infer_shape():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    fc = sym.FullyConnected(x, w, None, num_hidden=8, no_bias=True)
+    args, outs, aux = fc.infer_shape(data=(32, 100), w=(8, 100))
+    assert outs == [(32, 8)]
+
+
+def test_group_and_multi_output():
+    x = sym.Variable("x")
+    g = sym.Group([sym.relu(x), sym.negative(x)])
+    outs = g.eval(x=nd.array([-1.0, 2.0]))
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(), [0.0, 2.0])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [1.0, -2.0])
+    assert len(g.list_outputs()) == 2
+
+
+def test_split_multi_output():
+    x = sym.Variable("x")
+    parts = sym.split(x, num_outputs=2, axis=1)
+    s0, s1 = parts[0], parts[1]
+    y = s0 + s1
+    out = y.eval(x=nd.array([[1.0, 2.0, 3.0, 4.0]]))
+    onp.testing.assert_allclose(out[0].asnumpy(), [[4.0, 6.0]])
+
+
+def test_executor_forward_backward():
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = sym.sum(x * w)
+    xa, wa = nd.array([1.0, 2.0, 3.0]), nd.array([4.0, 5.0, 6.0])
+    exe = y.bind(args={"x": xa, "w": wa},
+                 args_grad={"x": nd.zeros((3,)), "w": nd.zeros((3,))})
+    outs = exe.forward(is_train=True)
+    assert float(outs[0].asnumpy()) == 32.0
+    exe.backward()
+    onp.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), [4.0, 5.0, 6.0])
+    onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_simple_bind():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.sum(sym.relu(sym.FullyConnected(x, w, None, num_hidden=4,
+                                              no_bias=True)))
+    exe = out.simple_bind(data=(2, 8), w=(4, 8))
+    exe.arg_dict["data"]._rebind(nd.ones((2, 8)).jax)
+    exe.arg_dict["w"]._rebind(nd.ones((4, 8)).jax)
+    outs = exe.forward(is_train=True)
+    assert float(outs[0].asnumpy()) == 2 * 4 * 8
+    exe.backward()
+    assert exe.grad_dict["w"].shape == (4, 8)
+    onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
+                                onp.full((4, 8), 2.0))
+
+
+def test_get_internals_and_getitem():
+    x = sym.Variable("x")
+    h = sym.relu(x, name="h")
+    y = sym.sum(h, name="y")
+    internals = y.get_internals()
+    hsym = internals["h"]
+    out = hsym.eval(x=nd.array([-2.0, 3.0]))
+    onp.testing.assert_allclose(out[0].asnumpy(), [0.0, 3.0])
