@@ -1,0 +1,94 @@
+"""Training callbacks (parity: python/mxnet/callback.py).
+
+Speedometer is the throughput probe whose samples/sec lines are the classic
+MXNet benchmark readout (SURVEY.md §5.5) — kept byte-similar so
+tools/parse_log-style scrapers work.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from collections import namedtuple
+
+__all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
+           "BatchEndParam"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+class Speedometer:
+    """Log samples/sec (and metrics) every `frequent` batches."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    msg += "\t%s=%f" * len(name_value)
+                    logging.info(msg, param.epoch, count, speed,
+                                 *sum(name_value, ()))
+                else:
+                    logging.info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar for the batch loop."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param: BatchEndParam):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving module checkpoints every `period` epochs."""
+    def _callback(iter_no, sym, arg, aux):
+        if (iter_no + 1) % period == 0:
+            from . import ndarray as nd
+            sym.save(f"{prefix}-symbol.json")
+            payload = {f"arg:{k}": v for k, v in arg.items()}
+            payload.update({f"aux:{k}": v for k, v in aux.items()})
+            nd.save(f"{prefix}-{iter_no + 1:04d}.params", payload)
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    def _callback(param: BatchEndParam):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback

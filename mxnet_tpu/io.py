@@ -1,0 +1,481 @@
+"""``mx.io`` — data iterators (parity: src/io/* registry + python/mxnet/io/,
+SURVEY.md §2.5).
+
+TPU-first notes: iterators yield host-side batches; device transfer happens
+when the training step consumes them (jit donates/overlaps H2D — the
+prefetcher role of src/io/iter_prefetcher.h is a thread pool here, and the
+heavy decode path can use the native helper library when built).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from . import base as _base
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=onp.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), onp.dtype(dtype),
+                               layout)
+
+
+class DataBatch:
+    """One batch: ``data``/``label`` lists of NDArray + pad/index bookkeeping
+    (parity: mx.io.DataBatch)."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label if label is not None else []
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [tuple(d.shape) for d in self.data]
+        return f"DataBatch: data shapes: {shapes} pad: {self.pad}"
+
+
+class DataIter:
+    """Iterator base (parity: mx.io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data into an ordered list of (name, ndarray)."""
+    if data is None:
+        if not allow_empty:
+            raise _base.MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, onp.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, onp.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: mx.io.NDArrayIter), with
+    pad/discard/roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", dtype=None):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cache_idx = onp.arange(self.num_data)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self._cache_idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
+                % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, arrs):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            idx = self._cache_idx[self.cursor:end]
+        else:  # pad by wrapping
+            idx = onp.concatenate([self._cache_idx[self.cursor:],
+                                   self._cache_idx[:end - self.num_data]])
+        return [nd_array(onp.take(v, idx, axis=0)) for _, v in arrs]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+    def getindex(self):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self._cache_idx[self.cursor:end]
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (parity: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",",
+                           dtype=onp.dtype(dtype), ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",",
+                                dtype=onp.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = onp.zeros((data.shape[0], 1), dtype=onp.float32)
+        self._it = NDArrayIter(data, label, batch_size,
+                               last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+
+def _load_mnist_images(path):
+    import gzip
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = onp.frombuffer(f.read(), dtype=onp.uint8)
+        return data.reshape(n, rows, cols)
+
+
+def _load_mnist_labels(path):
+    import gzip
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        return onp.frombuffer(f.read(), dtype=onp.uint8)
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (parity: src/io/iter_mnist.cc); falls back
+    to the deterministic synthetic digits used by gluon's MNIST dataset when
+    the raw files are absent (no network egress)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128, shuffle=True,
+                 flat=False, seed=0, num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        if os.path.exists(image) and os.path.exists(label):
+            imgs = _load_mnist_images(image).astype(onp.float32) / 255.0
+            labs = _load_mnist_labels(label).astype(onp.float32)
+            self.synthetic = False
+        else:
+            from .gluon.data.vision.datasets import _synthetic_images
+            imgs, labs = _synthetic_images(2048, (28, 28), 10, seed, 7)
+            imgs = imgs.astype(onp.float32) / 255.0
+            labs = labs.astype(onp.float32)
+            self.synthetic = True
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, 28, 28)
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            labs = labs[part_index::num_parts]
+        self._it = NDArrayIter(imgs, labs, batch_size, shuffle=shuffle,
+                               last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with decode + augmentation worker pool
+    (parity: src/io/iter_image_recordio_2.cc).
+
+    Decode runs on a Python thread pool (PIL); resize/crop/mirror match the
+    default augmenter (src/io/image_aug_default.cc) semantics.  mean/std
+    normalization and NCHW layout are applied host-side so the device step
+    receives ready tensors.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 path_imgidx=None, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0., mean_g=0., mean_b=0.,
+                 std_r=1., std_g=1., std_b=1., resize=-1,
+                 label_width=1, preprocess_threads=4, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+        self._unpack_img = unpack_img
+        self.data_shape = tuple(data_shape)   # (C, H, W)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.label_width = label_width
+        self.mean = onp.array([mean_r, mean_g, mean_b],
+                              dtype=onp.float32).reshape(3, 1, 1)
+        self.std = onp.array([std_r, std_g, std_b],
+                             dtype=onp.float32).reshape(3, 1, 1)
+        self.shuffle = shuffle
+        self.rng = onp.random.RandomState(seed)
+        self.n_threads = max(1, preprocess_threads)
+        # read the record offsets once
+        if path_imgidx and os.path.exists(path_imgidx):
+            rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self._records = [rec.read_idx(k) for k in rec.keys]
+            rec.close()
+        else:
+            rec = MXRecordIO(path_imgrec, "r")
+            self._records = []
+            while True:
+                r = rec.read()
+                if r is None:
+                    break
+                self._records.append(r)
+            rec.close()
+        self._order = onp.arange(len(self._records))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        if self.shuffle:
+            self.rng.shuffle(self._order)
+        self._pos = 0
+
+    def _process_one(self, raw):
+        header, img = self._unpack_img(raw, iscolor=1)
+        c, h, w = self.data_shape
+        from PIL import Image
+        pil = Image.fromarray(img)
+        if self.resize > 0:
+            ow, oh = pil.size
+            scale = self.resize / min(ow, oh)
+            pil = pil.resize((max(1, int(ow * scale)),
+                              max(1, int(oh * scale))), Image.BILINEAR)
+        ow, oh = pil.size
+        if ow < w or oh < h:
+            pil = pil.resize((max(w, ow), max(h, oh)), Image.BILINEAR)
+            ow, oh = pil.size
+        if self.rand_crop:
+            x0 = self.rng.randint(0, ow - w + 1)
+            y0 = self.rng.randint(0, oh - h + 1)
+        else:
+            x0, y0 = (ow - w) // 2, (oh - h) // 2
+        pil = pil.crop((x0, y0, x0 + w, y0 + h))
+        arr = onp.asarray(pil, dtype=onp.float32)
+        if arr.ndim == 2:
+            arr = onp.stack([arr] * 3, axis=-1)
+        arr = arr.transpose(2, 0, 1)  # HWC → CHW
+        if self.rand_mirror and self.rng.randint(2):
+            arr = arr[:, :, ::-1]
+        arr = (arr - self.mean) / self.std
+        label = header.label
+        if isinstance(label, onp.ndarray):
+            label = label[:self.label_width]
+            if self.label_width == 1:
+                label = float(label[0])
+        return arr.astype(onp.float32), label
+
+    def next(self):
+        if self._pos + self.batch_size > len(self._records):
+            raise StopIteration
+        idxs = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        from concurrent.futures import ThreadPoolExecutor
+        if not hasattr(self, "_pool"):
+            self._pool = ThreadPoolExecutor(self.n_threads)
+        results = list(self._pool.map(
+            lambda i: self._process_one(self._records[i]), idxs))
+        data = onp.stack([r[0] for r in results])
+        label = onp.asarray([r[1] for r in results], dtype=onp.float32)
+        return DataBatch([nd_array(data)], [nd_array(label)],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher wrapping any DataIter
+    (parity: src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        self.iters = iters
+        super().__init__(iters[0].batch_size)
+        self._depth = prefetch_depth
+        self._start()
+
+    @property
+    def provide_data(self):
+        return sum([i.provide_data for i in self.iters], [])
+
+    @property
+    def provide_label(self):
+        return sum([i.provide_label for i in self.iters], [])
+
+    def _start(self):
+        self._q: _queue.Queue = _queue.Queue(self._depth)
+        self._stop = False
+
+        def worker():
+            while not self._stop:
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._q.put(None)
+                    return
+                self._q.put(batches)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._start()
+
+    def next(self):
+        batches = self._q.get()
+        if batches is None:
+            raise StopIteration
+        if len(batches) == 1:
+            return batches[0]
+        return DataBatch(sum([b.data for b in batches], []),
+                         sum([b.label for b in batches], []))
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches
+    (parity: mx.io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()

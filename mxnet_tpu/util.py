@@ -1,0 +1,76 @@
+"""Misc user-facing utilities (parity: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
+           "np_array", "np_shape", "get_gpu_count", "get_gpu_memory",
+           "getenv", "setenv", "default_array"]
+
+
+def _npx():
+    from . import numpy_extension as npx
+    return npx
+
+
+def is_np_array():
+    return _npx().is_np_array()
+
+
+def is_np_shape():
+    return _npx().is_np_shape()
+
+
+def set_np(shape=True, array=True, dtype=False):
+    return _npx().set_np(shape, array, dtype)
+
+
+def reset_np():
+    return _npx().reset_np()
+
+
+def use_np(fn=None):
+    return _npx().use_np(fn)
+
+
+def np_array(active=True):
+    return _npx().np_array(active)
+
+
+def np_shape(active=True):
+    return _npx().np_shape(active)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        d = jax.devices()[dev_id]
+        stats = d.memory_stats() or {}
+        return (stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0))
+    except Exception:
+        return (0, 0)
+
+
+def getenv(name):
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create an NDArray in the default (np or legacy nd) API style."""
+    if is_np_array():
+        from . import numpy as np
+        return np.array(source_array, dtype=dtype, ctx=ctx)
+    from . import ndarray as nd
+    return nd.array(source_array, ctx=ctx, dtype=dtype)
