@@ -1,0 +1,180 @@
+"""``mx.amp`` — automatic mixed precision.
+
+Parity target: python/mxnet/amp (2.x) / contrib/amp (1.x): op allow/deny
+lists + ``amp_cast`` insertion + dynamic LossScaler (SURVEY.md §2.6,
+src/nnvm/low_precision_pass.cc).
+
+TPU-first realization: instead of monkey-patching generated op namespaces
+and inserting cast nodes into an NNVM graph, ``amp.init()`` installs a
+process-wide *cast policy* consulted by the single op dispatcher
+(mxnet_tpu.ndarray.ops.invoke).  Ops on the target-dtype list see their
+float inputs cast to bf16/fp16 (MXU-friendly); ops on the fp32 list are
+computed in fp32 (numerics-sensitive: softmax, norms, exp/log).  Because
+hybridize traces through the same dispatcher, the policy bakes the casts
+into the step's single XLA computation — the low_precision_pass with the
+compiler doing the fusion.  Default target on TPU is bfloat16, which needs
+no loss scaling; the fp16 LossScaler is kept for API/semantics parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import base as _base
+from ..ndarray import NDArray
+
+from .lists import FP16_FUNCS, FP32_FUNCS, WIDEST_TYPE_CASTS
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler",
+           "current_policy", "amp_cast", "amp_multicast"]
+
+_state = threading.local()
+
+
+class _Policy:
+    def __init__(self, target_dtype):
+        self.target_dtype = jnp.dtype(target_dtype)
+        self.target_ops = set(FP16_FUNCS)
+        self.fp32_ops = set(FP32_FUNCS)
+
+    def cast_args(self, opname, arrs):
+        if opname in self.target_ops:
+            return tuple(
+                a.astype(self.target_dtype)
+                if a.dtype in (jnp.float32, jnp.float64) else a
+                for a in arrs)
+        if opname in self.fp32_ops:
+            return tuple(
+                a.astype(jnp.float32)
+                if a.dtype in (jnp.bfloat16, jnp.float16) else a
+                for a in arrs)
+        return arrs
+
+
+def current_policy() -> Optional[_Policy]:
+    return getattr(_state, "policy", None)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.init).  Default bf16 on TPU."""
+    if str(target_dtype) in ("float16", "fp16"):
+        target_dtype = "float16"
+    else:
+        target_dtype = "bfloat16"
+    p = _Policy(target_dtype)
+    if target_precision_ops:
+        p.target_ops |= set(target_precision_ops)
+    if fp32_ops:
+        p.fp32_ops |= set(fp32_ops)
+    _state.policy = p
+    return p
+
+
+def reset():
+    _state.policy = None
+
+
+class LossScaler:
+    """Dynamic loss scaling (parity: contrib/amp/loss_scaler.py).  Needed
+    for fp16 only; bf16 runs unscaled."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else p.grad
+            if g is None:
+                continue
+            a = g.asnumpy() if isinstance(g, NDArray) else onp.asarray(g)
+            if not onp.isfinite(a).all():
+                return True
+        return False
+
+    def update_scale(self, skip: bool):
+        if skip:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a LossScaler to a Trainer (parity: amp.init_trainer)."""
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss before backward; trainer.step unscales
+    (parity: amp.scale_loss)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+    # after backward: trainer must divide grads by the scale
+    trainer._scale = getattr(trainer, "_amp_original_scale", 1.0) / \
+        scaler.loss_scale
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p.grad()
+        if g is not None:
+            g._rebind((g.jax * inv).astype(g.jax.dtype))
+
+
+def _cast_block_params(block, dtype, keep_fp32=("gamma", "beta",
+                                                "running_mean",
+                                                "running_var")):
+    for name, p in block.collect_params().items():
+        if any(name.endswith(k) for k in keep_fp32):
+            continue
+        if p._data is not None and p.dtype in (onp.float32,):
+            p.cast(dtype)
+    return block
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a model's compute params to the target dtype
+    (parity: amp.convert_model)."""
+    return _cast_block_params(net, target_dtype)
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16", ctx=None):
+    return _cast_block_params(net, target_dtype)
+
+
+# amp_cast / amp_multicast op-parity helpers (graph nodes in MXNet; plain
+# functions here since casts fuse under XLA anyway)
+
+def amp_cast(data, dtype="bfloat16"):
+    return data.astype(dtype)
+
+
+def amp_multicast(*data, num_outputs=None):
+    dt = jnp.result_type(*[d.jax for d in data])
+    return [d.astype(dt) for d in data]

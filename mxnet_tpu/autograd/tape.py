@@ -118,7 +118,11 @@ def backward_on(heads, head_grads=None):
         if missing:
             continue
         if node.out_avals is not None:
-            outs = [jnp.zeros(a.shape, a.dtype) if c is None else c
+            # cotangent dtype must match the forward output's dtype; under
+            # AMP a downstream fp32 op hands an fp32 cotangent to a bf16
+            # producer — cast back (the amp_cast gradient in MXNet terms)
+            outs = [jnp.zeros(a.shape, a.dtype) if c is None
+                    else (c.astype(a.dtype) if c.dtype != a.dtype else c)
                     for c, a in zip(outs, node.out_avals)]
         outs = tuple(outs)
         cot_in = node.vjp_fn(outs if node.n_out > 1 else outs[0])

@@ -38,9 +38,23 @@ def _export(fn):
 
 # ---------------------------------------------------------------- dispatcher
 
+_amp_mod = None
+
+
+def _amp_policy():
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _a
+        _amp_mod = _a
+    return _amp_mod.current_policy()
+
+
 def invoke(name, pure_fn, nd_inputs, nout=1, ctx=None, differentiable=True):
     """Dispatch a pure jax function over NDArray inputs with autograd."""
     arrs = tuple(x.jax for x in nd_inputs)
+    pol = _amp_policy()
+    if pol is not None:
+        arrs = pol.cast_args(name, arrs)
     recording = _base.is_recording() and differentiable
     in_nodes = [node_of(x) for x in nd_inputs] if recording else None
     needs_grad = recording and any(n is not None for n in in_nodes)

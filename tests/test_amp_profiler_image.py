@@ -1,0 +1,131 @@
+"""AMP / profiler / mx.image tests."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+@pytest.fixture
+def amp_off():
+    yield
+    mx.amp.reset()
+
+
+def test_amp_policy_casts_matmul(amp_off):
+    mx.amp.init(target_dtype="bfloat16")
+    a = nd.array(onp.random.RandomState(0).randn(8, 8).astype("float32"))
+    b = nd.array(onp.random.RandomState(1).randn(8, 8).astype("float32"))
+    out = nd.dot(a, b)
+    assert str(out.dtype) == "bfloat16"
+    # fp32-forced op keeps fp32 even from bf16 inputs
+    sm = nd.softmax(out, axis=-1)
+    assert str(sm.dtype) == "float32"
+
+
+def test_amp_off_no_cast():
+    a = nd.array(onp.ones((4, 4), "float32"))
+    out = nd.dot(a, a)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_end_to_end_training(amp_off):
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    mx.amp.init()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    rs = onp.random.RandomState(0)
+    X = nd.array(rs.randn(32, 8).astype("float32"))
+    y = nd.array((rs.rand(32) > 0.5).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            out = net(X)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(32)
+        losses.append(float(loss.asnumpy().mean()))
+    assert losses[-1] < losses[0]
+    # master weights stay fp32
+    for _, p in net.collect_params().items():
+        assert str(p.data().dtype) == "float32"
+
+
+def test_amp_convert_model(amp_off):
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    mx.amp.convert_model(net, "bfloat16")
+    assert str(net.weight.data().dtype) == "bfloat16"
+
+
+def test_loss_scaler():
+    s = mx.amp.LossScaler(init_scale=1024.0, scale_factor=2.0,
+                          scale_window=2)
+    s.update_scale(skip=True)
+    assert s.loss_scale == 512.0
+    s.update_scale(skip=False)
+    s.update_scale(skip=False)
+    assert s.loss_scale == 1024.0
+
+
+def test_profiler_roundtrip(tmp_path):
+    f = str(tmp_path / "prof.json")
+    mx.profiler.set_config(filename=f, profile_all=True)
+    mx.profiler.set_state("run")
+    with mx.profiler.scope("bench_range"):
+        a = nd.array(onp.ones((64, 64), "float32"))
+        nd.dot(a, a).wait_to_read()
+    mx.profiler.set_state("stop")
+    d = mx.profiler.dump()
+    assert d and os.path.isdir(d)
+    assert "Profile data" in mx.profiler.dumps()
+
+
+def test_image_ops():
+    img = (onp.random.RandomState(0).rand(48, 64, 3) * 255).astype("uint8")
+    a = nd.array(img)
+    r = mx.image.imresize(a, 32, 24)
+    assert r.shape == (24, 32, 3)
+    rs = mx.image.resize_short(a, 32)
+    assert min(rs.shape[:2]) == 32
+    c, _ = mx.image.center_crop(a, (32, 32))
+    assert c.shape == (32, 32, 3)
+    rc, _ = mx.image.random_crop(a, (16, 16))
+    assert rc.shape == (16, 16, 3)
+    normed = mx.image.color_normalize(
+        a, onp.array([128.0, 128.0, 128.0]), onp.array([64.0, 64.0, 64.0]))
+    assert abs(float(normed.asnumpy().mean())) < 2.0
+
+
+def test_image_iter_from_imglist(tmp_path):
+    from PIL import Image
+    paths = []
+    rs = onp.random.RandomState(0)
+    for i in range(6):
+        arr = (rs.rand(40, 40, 3) * 255).astype("uint8")
+        p = str(tmp_path / f"img{i}.png")
+        Image.fromarray(arr).save(p)
+        paths.append(p)
+    imglist = [[float(i % 2), p] for i, p in enumerate(paths)]
+    it = mx.image.ImageIter(batch_size=3, data_shape=(3, 32, 32),
+                            imglist=imglist, rand_mirror=True)
+    b = it.next()
+    assert b.data[0].shape == (3, 3, 32, 32)
+    assert b.label[0].shape == (3,)
+
+
+def test_augmenter_dumps():
+    augs = mx.image.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True)
+    assert any(isinstance(a, mx.image.RandomCropAug) for a in augs)
+    assert any(isinstance(a, mx.image.HorizontalFlipAug) for a in augs)
+    for a in augs:
+        assert isinstance(a.dumps(), str)
