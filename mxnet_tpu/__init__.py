@@ -44,6 +44,9 @@ _LAZY = {
     "mod": ".module",
     "callback": ".callback",
     "util": ".util",
+    "contrib": ".contrib",
+    "operator": ".operator",
+    "library": ".library",
 }
 
 

@@ -1,0 +1,171 @@
+"""``mx.contrib`` — control-flow operators and contrib surface.
+
+Parity target: src/operator/control_flow.cc (`foreach`, `while_loop`,
+`cond` higher-order ops; SURVEY.md §2.3) exposed as
+``mx.nd.contrib.foreach`` etc.
+
+TPU-first dispatch per mode:
+- hybridized/traced (inputs are JAX tracers): lower to ``lax.scan`` /
+  ``lax.while_loop`` / ``lax.cond`` so the loop is ONE XLA op (no unrolling,
+  no retraces) — this is what the subgraph executor of control_flow.cc
+  becomes under a real compiler.
+- eager while autograd records: a Python loop, so every step's ops land on
+  the tape and gradients flow to closure parameters exactly as MXNet's
+  imperative control flow does.
+- plain eager: Python loop (simple, correct).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import base as _base
+from ..ndarray import NDArray
+from .. import ndarray as _ops
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _is_traced(*nds) -> bool:
+    for x in nds:
+        if isinstance(x, NDArray) and isinstance(x.jax, jax.core.Tracer):
+            return True
+    return False
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body: Callable, data, init_states):
+    """Iterate `body(item, states) -> (outputs, new_states)` over axis 0 of
+    `data` (parity: mx.nd.contrib.foreach)."""
+    data_list = _as_list(data)
+    states = _as_list(init_states)
+    multi_data = isinstance(data, (list, tuple))
+    multi_states = isinstance(init_states, (list, tuple))
+
+    if _is_traced(*data_list, *states):
+        def scan_body(carry, xs):
+            st = [NDArray(c) for c in carry]
+            item = [NDArray(x) for x in xs]
+            out, new_st = body(item if multi_data else item[0],
+                               st if multi_states else st[0])
+            out_l = _as_list(out)
+            new_l = _as_list(new_st)
+            return (tuple(s.jax for s in new_l),
+                    tuple(o.jax for o in out_l))
+
+        carry0 = tuple(s.jax for s in states)
+        xs = tuple(d.jax for d in data_list)
+        final, stacked = lax.scan(scan_body, carry0, xs)
+        outs = [NDArray(o) for o in stacked]
+        fst = [NDArray(s) for s in final]
+        return (outs if (multi_data or len(outs) > 1) and len(outs) != 1
+                else outs[0],
+                fst if multi_states else fst[0])
+
+    # eager: python loop (tape-visible)
+    n = data_list[0].shape[0]
+    step_outs: List[List[NDArray]] = []
+    cur = states
+    for i in range(n):
+        item = [d[i] for d in data_list]
+        out, new_st = body(item if multi_data else item[0],
+                           cur if multi_states else cur[0])
+        step_outs.append(_as_list(out))
+        cur = _as_list(new_st)
+    stacked = [_ops.stack(*[s[j] for s in step_outs], axis=0)
+               for j in range(len(step_outs[0]))]
+    return (stacked if len(stacked) != 1 else stacked[0],
+            cur if multi_states else cur[0])
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int = None):
+    """`while cond(vars): vars = func(vars)` with per-step outputs stacked
+    and padded to max_iterations (parity: mx.nd.contrib.while_loop)."""
+    vars_list = _as_list(loop_vars)
+    multi = isinstance(loop_vars, (list, tuple))
+    if max_iterations is None:
+        raise _base.MXNetError("while_loop requires max_iterations")
+
+    if _is_traced(*vars_list):
+        # fixed-trip scan with an active mask: XLA-friendly (static shape),
+        # semantically identical incl. output padding with zeros
+        def scan_body(carry, _):
+            active, vals = carry
+            nd_vals = [NDArray(v) for v in vals]
+            packed = nd_vals if multi else nd_vals[0]
+            pred = cond_fn(*nd_vals) if multi else cond_fn(nd_vals[0])
+            pred_v = pred.jax if isinstance(pred, NDArray) else pred
+            pred_v = jnp.reshape(pred_v, ()).astype(jnp.bool_)
+            take = jnp.logical_and(active, pred_v)
+            step_out, new_vals = func(*nd_vals) if multi else func(nd_vals[0])
+            out_l = [o.jax for o in _as_list(step_out)]
+            new_l = [v.jax for v in _as_list(new_vals)]
+            sel_vals = tuple(
+                jnp.where(take, nv, ov) for nv, ov in zip(new_l, vals))
+            sel_outs = tuple(
+                jnp.where(take, o, jnp.zeros_like(o)) for o in out_l)
+            return (take, sel_vals), sel_outs
+
+        carry0 = (jnp.asarray(True), tuple(v.jax for v in vars_list))
+        (_, final), outs = lax.scan(scan_body, carry0, None,
+                                    length=max_iterations)
+        out_nds = [NDArray(o) for o in outs]
+        fin_nds = [NDArray(v) for v in final]
+        return (out_nds if len(out_nds) != 1 else out_nds[0],
+                fin_nds if multi else fin_nds[0])
+
+    # eager
+    cur = vars_list
+    step_outs = []
+    steps = 0
+    while steps < max_iterations:
+        pred = cond_fn(*cur) if multi else cond_fn(cur[0])
+        if not bool(pred.asnumpy() if isinstance(pred, NDArray) else pred):
+            break
+        out, new_vals = func(*cur) if multi else func(cur[0])
+        step_outs.append(_as_list(out))
+        cur = _as_list(new_vals)
+        steps += 1
+    if step_outs:
+        stacked = []
+        for j in range(len(step_outs[0])):
+            col = [s[j] for s in step_outs]
+            st = _ops.stack(*col, axis=0)
+            pad = max_iterations - len(col)
+            if pad > 0:
+                zeros = _ops.zeros((pad,) + tuple(col[0].shape))
+                st = _ops.concat(st, zeros.astype(str(st.dtype)), dim=0)
+            stacked.append(st)
+    else:
+        stacked = []
+    return (stacked if len(stacked) != 1 else stacked[0],
+            cur if multi else cur[0])
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
+    """Conditional execution (parity: mx.nd.contrib.cond)."""
+    pred_v = pred.jax if isinstance(pred, NDArray) else pred
+    if _is_traced(pred if isinstance(pred, NDArray) else NDArray(pred_v)):
+        def then_b(_):
+            out = then_func()
+            return tuple(o.jax for o in _as_list(out))
+
+        def else_b(_):
+            out = else_func()
+            return tuple(o.jax for o in _as_list(out))
+
+        p = jnp.reshape(pred_v, ()).astype(jnp.bool_)
+        outs = lax.cond(p, then_b, else_b, operand=None)
+        nds = [NDArray(o) for o in outs]
+        return nds if len(nds) != 1 else nds[0]
+    take = bool(pred.asnumpy() if isinstance(pred, NDArray) else pred_v)
+    return then_func() if take else else_func()

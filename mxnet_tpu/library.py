@@ -1,0 +1,56 @@
+"""``mx.library`` — dynamic extension loading (parity: python/mxnet/
+library.py + include/mxnet/lib_api.h, SURVEY.md §2.3 custom-op libraries).
+
+TPU-first: an extension is a Python module (or a C shared library with a
+Python shim) that registers ops/partitioners at load time by calling this
+framework's registries — the stable-ABI C++ lib_api becomes "import and
+register", since compute kernels here are JAX/Pallas functions, not raw
+device code.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib.util
+import os
+import sys
+
+from . import base as _base
+
+__all__ = ["load", "compiled_with_cxx11_abi"]
+
+_loaded = {}
+
+
+def load(path, verbose=True):
+    """Load an extension library.
+
+    ``.py`` → imported as a module (its top level registers custom ops via
+    mx.operator.register / op registries).  ``.so`` → dlopen'd and its
+    ``mxnet_tpu_init`` entry point (if present) is called with no args.
+    """
+    path = os.path.abspath(path)
+    if path in _loaded:
+        return _loaded[path]
+    if not os.path.exists(path):
+        raise _base.MXNetError(f"library not found: {path}")
+    if path.endswith(".py"):
+        name = "mxnet_tpu_ext_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        _loaded[path] = mod
+        return mod
+    if path.endswith(".so") or path.endswith(".dylib"):
+        lib = ctypes.CDLL(path, ctypes.RTLD_GLOBAL)
+        if hasattr(lib, "mxnet_tpu_init"):
+            lib.mxnet_tpu_init()
+        _loaded[path] = lib
+        return lib
+    raise _base.MXNetError(
+        f"unsupported extension type: {path} (.py or .so)")
+
+
+def compiled_with_cxx11_abi():
+    return True

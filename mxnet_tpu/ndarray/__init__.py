@@ -23,3 +23,19 @@ def save(fname, data):
 def load(fname):
     from ..utils.serialization import load as _load
     return _load(fname)
+
+
+def Custom(*data, op_type, **kwargs):
+    """Invoke a registered Python custom op (parity: mx.nd.Custom)."""
+    from ..operator import Custom as _custom
+    return _custom(*data, op_type=op_type, **kwargs)
+
+
+def __getattr__(name):
+    # mx.nd.contrib.* (control flow etc.) resolves lazily to mx.contrib
+    if name == "contrib":
+        from .. import contrib
+        globals()["contrib"] = contrib
+        return contrib
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,147 @@
+"""``mx.operator`` — Python custom operators.
+
+Parity target: python/mxnet/operator.py + src/operator/custom/custom.cc
+(SURVEY.md §2.3): ``CustomOp``/``CustomOpProp`` subclasses registered by
+name, invoked via ``mx.nd.Custom(..., op_type=name)``.
+
+TPU-first note: custom ops written against this API run as host callbacks
+(eager) — same as MXNet, where custom ops ran on a special engine path that
+synchronized with Python.  Gradients integrate with the autograd tape via
+the same mechanism as autograd.Function.  For jit-compatible custom kernels
+use ``mxnet_tpu.ops`` (pure-JAX/Pallas) instead.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from . import base as _base
+from .autograd.tape import OpNode, OutRef, node_of
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_entry", "Custom"]
+
+_custom_registry = _base.registry("custom_op")
+
+
+class CustomOp:
+    """Base for custom operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst: NDArray, req: str, src):
+        if req in ("null", None):
+            return
+        src_val = src.jax if isinstance(src, NDArray) else \
+            nd_array(src).jax
+        if req in ("write", "inplace"):
+            dst._rebind(src_val)
+        elif req == "add":
+            dst._rebind(dst.jax + src_val)
+        else:
+            raise _base.MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Describes a custom op: shapes, dtypes, arg names."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp by name."""
+    def do_register(prop_cls):
+        _custom_registry.register(reg_name)(prop_cls)
+        return prop_cls
+    return do_register
+
+
+def get_entry(name: str):
+    return _custom_registry.get(name)
+
+
+def Custom(*data, op_type: str, **kwargs) -> NDArray:
+    """Invoke a registered custom op on NDArray inputs
+    (parity: mx.nd.Custom)."""
+    prop_cls = _custom_registry.get(op_type)
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    accepted = {k: v for k, v in kwargs.items()
+                if k in sig.parameters}
+    prop = prop_cls(**accepted)
+    in_shapes = [tuple(d.shape) for d in data]
+    in_shapes_out, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [d.dtype for d in data]
+    _, out_types, _ = prop.infer_type(in_types)
+    op = prop.create_operator(None, in_shapes_out, in_types)
+
+    from .ndarray import zeros as nd_zeros
+    out_data = [nd_zeros(s, dtype=str(onp.dtype(t)))
+                for s, t in zip(out_shapes, out_types)]
+    aux = []
+    is_train = _base.is_training()
+    req = ["write"] * len(out_data)
+    op.forward(is_train, req, list(data), out_data, aux)
+
+    if _base.is_recording():
+        in_nodes = [node_of(d) for d in data]
+        if any(n is not None for n in in_nodes):
+            data_snapshot = list(data)
+            outs_snapshot = list(out_data)
+
+            def vjp_fn(cots):
+                cots_t = (cots,) if len(out_data) == 1 else tuple(cots)
+                in_grad = [nd_zeros(tuple(d.shape), dtype=str(d.dtype))
+                           for d in data_snapshot]
+                with _base.training_mode(_base.is_training()):
+                    rec = _base.set_recording(False)
+                    try:
+                        op.backward(["write"] * len(in_grad),
+                                    [NDArray(c) for c in cots_t],
+                                    data_snapshot, outs_snapshot, in_grad,
+                                    aux)
+                    finally:
+                        _base.set_recording(rec)
+                return tuple(g.jax for g in in_grad)
+
+            import jax
+            node = OpNode(vjp_fn, in_nodes, len(out_data), name=op_type,
+                          out_avals=[jax.ShapeDtypeStruct(o.shape,
+                                                          o.jax.dtype)
+                                     for o in out_data])
+            for i, o in enumerate(out_data):
+                o._node = OutRef(node, i)
+
+    return out_data[0] if len(out_data) == 1 else out_data

@@ -1,0 +1,163 @@
+"""Control flow / custom op / library tests (parity model:
+tests/python/unittest/test_contrib_control_flow.py, test_operator custom)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.contrib import cond, foreach, while_loop
+
+
+def test_foreach_eager():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = nd.zeros((3,))
+
+    def body(x, st):
+        new = st + x
+        return new * 2.0, new
+
+    outs, final = foreach(body, data, init)
+    want_final = onp.arange(12, dtype="float32").reshape(4, 3).sum(0)
+    onp.testing.assert_allclose(final.asnumpy(), want_final)
+    assert outs.shape == (4, 3)
+
+
+def test_foreach_grad():
+    data = nd.array(onp.ones((3, 2), dtype="float32"))
+    w = nd.array(onp.array([2.0, 3.0], dtype="float32"))
+    w.attach_grad()
+    init = nd.zeros((2,))
+    with autograd.record():
+        outs, final = foreach(lambda x, st: (x * w, st + x * w), data, init)
+        loss = nd.sum(final)
+    loss.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_foreach_hybridized():
+    """foreach inside a hybridized block lowers to one lax.scan."""
+    from mxnet_tpu.gluon import HybridBlock
+
+    class Cumul(HybridBlock):
+        def forward(self, x):
+            outs, final = foreach(
+                lambda item, st: (st + item, st + item), x,
+                nd.zeros((x.shape[1],)))
+            return outs
+
+    net = Cumul()
+    net.hybridize()
+    x = nd.array(onp.ones((5, 2), dtype="float32"))
+    out = net(x)
+    onp.testing.assert_allclose(out.asnumpy()[:, 0], [1, 2, 3, 4, 5])
+    out2 = net(nd.array(onp.ones((5, 2), dtype="float32") * 2))
+    onp.testing.assert_allclose(out2.asnumpy()[:, 0], [2, 4, 6, 8, 10])
+
+
+def test_while_loop_eager():
+    def cond_fn(i, s):
+        return i < 4
+
+    def body(i, s):
+        return (s + i), (i + 1, s + i)
+
+    outs, (fi, fs) = while_loop(cond_fn, body,
+                                (nd.array([0.0]), nd.array([0.0])),
+                                max_iterations=10)
+    assert float(fi.asnumpy()) == 4.0
+    assert float(fs.asnumpy()) == 0 + 1 + 2 + 3
+    assert outs.shape[0] == 10  # padded
+
+
+def test_while_loop_traced():
+    from mxnet_tpu.gluon import HybridBlock
+
+    class W(HybridBlock):
+        def forward(self, x):
+            def cond_fn(i, s):
+                return nd.sum(i) < 4
+
+            def body(i, s):
+                return (s + i), (i + 1.0, s + i)
+
+            outs, (fi, fs) = while_loop(cond_fn, body,
+                                        (x, nd.zeros(x.shape)),
+                                        max_iterations=8)
+            return fs
+
+    net = W()
+    net.hybridize()
+    out = net(nd.array([0.0]))
+    assert float(out.asnumpy()) == 6.0   # 0+1+2+3
+
+
+def test_cond_eager_and_traced():
+    x = nd.array([2.0])
+    r = cond(nd.sum(x) > 1.0, lambda: x * 10.0, lambda: x - 1.0)
+    assert float(r.asnumpy()) == 20.0
+
+    from mxnet_tpu.gluon import HybridBlock
+
+    class C(HybridBlock):
+        def forward(self, x):
+            return cond(nd.sum(x) > 1.0, lambda: x * 10.0,
+                        lambda: x - 1.0)
+
+    net = C()
+    net.hybridize()
+    assert float(net(nd.array([2.0])).asnumpy()) == 20.0
+    assert float(net(nd.array([0.5])).asnumpy()) == -0.5
+
+
+def test_custom_op():
+    import mxnet_tpu.operator as mo
+
+    class Sigmoid(mo.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            y = 1.0 / (1.0 + onp.exp(-x))
+            self.assign(out_data[0], req[0], nd.array(y))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0].asnumpy()
+            gy = out_grad[0].asnumpy()
+            self.assign(in_grad[0], req[0], nd.array(gy * y * (1 - y)))
+
+    @mo.register("my_sigmoid")
+    class SigmoidProp(mo.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid()
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="my_sigmoid")
+        loss = nd.sum(y)
+    loss.backward()
+    sig = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(y.asnumpy(), sig, rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
+                                rtol=1e-5)
+
+
+def test_library_load_py(tmp_path):
+    ext = tmp_path / "my_ext.py"
+    ext.write_text(
+        "import mxnet_tpu.operator as mo\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "class Double(mo.CustomOp):\n"
+        "    def forward(self, is_train, req, in_data, out_data, aux):\n"
+        "        self.assign(out_data[0], req[0], in_data[0] * 2.0)\n"
+        "    def backward(self, req, out_grad, in_data, out_data, in_grad,"
+        " aux):\n"
+        "        self.assign(in_grad[0], req[0], out_grad[0] * 2.0)\n"
+        "@mo.register('ext_double')\n"
+        "class DoubleProp(mo.CustomOpProp):\n"
+        "    def create_operator(self, ctx, shapes, dtypes):\n"
+        "        return Double()\n")
+    mx.library.load(str(ext))
+    out = nd.Custom(nd.array([3.0]), op_type="ext_double")
+    assert float(out.asnumpy()) == 6.0
