@@ -32,10 +32,19 @@ def Custom(*data, op_type, **kwargs):
 
 
 def __getattr__(name):
-    # mx.nd.contrib.* (control flow etc.) resolves lazily to mx.contrib
+    # mx.nd.contrib / mx.nd.sparse resolve lazily (import cost + cycles)
     if name == "contrib":
         from .. import contrib
         globals()["contrib"] = contrib
         return contrib
+    if name == "sparse":
+        import importlib
+        mod = importlib.import_module(".sparse", __name__)
+        globals()["sparse"] = mod
+        return mod
+    if name == "cast_storage":
+        from .sparse import cast_storage
+        globals()["cast_storage"] = cast_storage
+        return cast_storage
     raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute "
                          f"{name!r}")

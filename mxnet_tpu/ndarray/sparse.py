@@ -1,0 +1,253 @@
+"""Sparse NDArray types (parity: python/mxnet/ndarray/sparse.py + the CSR/
+RowSparse storage kernels spread through src/operator/tensor — SURVEY.md
+§2.3 "Sparse ops").
+
+TPU-first stance: the MXU has no sparse formats, so sparse here is a
+*storage* optimization with explicit dense boundaries — exactly MXNet's
+semantics, where most ops on sparse inputs fall back to dense with a storage
+warning.  Compact components (data/indices/indptr) live as device arrays;
+``dot(csr, dense)`` uses gather/segment-sum (XLA-native), RowSparse drives
+the optimizers' lazy row-wise updates, and anything else densifies.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import base as _base
+from ..context import current_context
+from .ndarray import NDArray, array as nd_array, from_jax
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "cast_storage", "zeros", "empty", "dot",
+           "BaseSparseNDArray", "sparse_add", "retain"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base: dense value materialized lazily from components."""
+
+    __slots__ = ()
+
+    def __init__(self, dense_value, ctx=None):
+        super().__init__(dense_value, ctx=ctx)
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return NDArray(self.jax, ctx=self.context)
+        if stype == self.stype:
+            return self
+        return cast_storage(self, stype)
+
+    def todense(self) -> NDArray:
+        return NDArray(self.jax, ctx=self.context)
+
+    def asscipy(self):
+        raise _base.MXNetError("scipy interop not available")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (parity: mx.nd.sparse.CSRNDArray)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr", "_sp_shape")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, jnp.int32)
+        self._sp_indptr = jnp.asarray(indptr, jnp.int32)
+        self._sp_shape = tuple(shape)
+        dense = _csr_to_dense(self._sp_data, self._sp_indices,
+                              self._sp_indptr, self._sp_shape)
+        super().__init__(dense, ctx=ctx)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return from_jax(self._sp_data)
+
+    @property
+    def indices(self) -> NDArray:
+        return from_jax(self._sp_indices)
+
+    @property
+    def indptr(self) -> NDArray:
+        return from_jax(self._sp_indptr)
+
+    def __repr__(self):
+        return (f"<CSRNDArray {self._sp_shape} "
+                f"nnz={int(self._sp_data.shape[0])}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: a subset of rows is stored (parity:
+    mx.nd.sparse.RowSparseNDArray; the storage type of sparse gradients)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_shape")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, jnp.int32)
+        self._sp_shape = tuple(shape)
+        dense = jnp.zeros(self._sp_shape, self._sp_data.dtype).at[
+            self._sp_indices].set(self._sp_data)
+        super().__init__(dense, ctx=ctx)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self) -> NDArray:
+        return from_jax(self._sp_data)
+
+    @property
+    def indices(self) -> NDArray:
+        return from_jax(self._sp_indices)
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {self._sp_shape} "
+                f"rows={int(self._sp_indices.shape[0])}>")
+
+
+def _csr_to_dense(data, indices, indptr, shape):
+    n_rows = shape[0]
+    # row id per nnz from indptr (searchsorted over the nnz positions)
+    nnz = data.shape[0]
+    if nnz == 0:
+        return jnp.zeros(shape, data.dtype)
+    rows = jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right")
+    dense = jnp.zeros(shape, data.dtype)
+    return dense.at[rows, indices].set(data)
+
+
+# ----------------------------------------------------------------- factory
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """Create a CSRNDArray from (data, indices, indptr) or a dense array."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else \
+            onp.asarray(data)
+        if dtype is not None:
+            data = data.astype(_base.canonical_dtype(dtype))
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else \
+            onp.asarray(indices)
+        indptr = indptr.asnumpy() if isinstance(indptr, NDArray) else \
+            onp.asarray(indptr)
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        onp.asarray(arg1, dtype=onp.float32)
+    return _dense_to_csr(dense, ctx)
+
+
+def _dense_to_csr(dense: onp.ndarray, ctx=None) -> CSRNDArray:
+    mask = dense != 0
+    indptr = onp.concatenate([[0], mask.sum(axis=1).cumsum()]).astype("int64")
+    indices = onp.nonzero(mask)[1]
+    data = dense[mask]
+    return CSRNDArray(data, indices, indptr, dense.shape, ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None,
+                     dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data.asnumpy() if isinstance(data, NDArray) else \
+            onp.asarray(data)
+        if dtype is not None:
+            data = data.astype(_base.canonical_dtype(dtype))
+        indices = indices.asnumpy() if isinstance(indices, NDArray) else \
+            onp.asarray(indices)
+        return RowSparseNDArray(data, indices, shape, ctx=ctx)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        onp.asarray(arg1, dtype=onp.float32)
+    nz_rows = onp.nonzero((dense != 0).any(axis=tuple(
+        range(1, dense.ndim))))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows, dense.shape, ctx=ctx)
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """Convert between storage types (parity: mx.nd.cast_storage)."""
+    if stype == "default":
+        return NDArray(arr.jax, ctx=arr.context)
+    dense = arr.asnumpy()
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise _base.MXNetError("csr storage requires 2-D")
+        return _dense_to_csr(dense, arr.context)
+    if stype == "row_sparse":
+        nz = onp.nonzero((dense != 0).any(axis=tuple(
+            range(1, dense.ndim))))[0]
+        return RowSparseNDArray(dense[nz], nz, dense.shape, ctx=arr.context)
+    raise _base.MXNetError(f"unknown stype {stype!r}")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    dt = _base.canonical_dtype(dtype)
+    if stype == "csr":
+        return CSRNDArray(onp.zeros((0,), dt), onp.zeros((0,), "int64"),
+                          onp.zeros((shape[0] + 1,), "int64"), shape, ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), dt),
+                                onp.zeros((0,), "int64"), shape, ctx)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx=ctx, dtype=dtype)
+
+
+def empty(stype, shape, ctx=None, dtype="float32"):
+    return zeros(stype, shape, ctx, dtype)
+
+
+# --------------------------------------------------------------- operators
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
+    """Sparse-aware dot.  csr·dense uses gather+segment-sum (XLA-native);
+    everything else goes through the dense path."""
+    if isinstance(lhs, CSRNDArray) and not transpose_a \
+            and isinstance(rhs, NDArray) and not isinstance(rhs,
+                                                            BaseSparseNDArray):
+        data, indices, indptr = (lhs._sp_data, lhs._sp_indices,
+                                 lhs._sp_indptr)
+        nnz = data.shape[0]
+        n_rows = lhs._sp_shape[0]
+        r = rhs.jax
+        if transpose_b:
+            r = r.T
+        if nnz == 0:
+            return from_jax(jnp.zeros((n_rows, r.shape[1]), data.dtype))
+        rows = jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right")
+        gathered = r[indices] * data[:, None]       # (nnz, N)
+        out = jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
+        return from_jax(out)
+    from . import ops as _ops
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rr = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _ops.dot(l, rr, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def sparse_add(lhs, rhs):
+    from . import ops as _ops
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _ops.add(l, r)
+
+
+def retain(data: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the requested rows (parity: mx.nd.sparse.retain)."""
+    idx = indices.asnumpy().astype("int64") if isinstance(indices, NDArray) \
+        else onp.asarray(indices, "int64")
+    have = data._sp_indices
+    keep_mask = jnp.isin(have, jnp.asarray(idx))
+    keep = onp.nonzero(onp.asarray(keep_mask))[0]
+    return RowSparseNDArray(onp.asarray(data._sp_data)[keep],
+                            onp.asarray(have)[keep], data._sp_shape,
+                            ctx=data.context)

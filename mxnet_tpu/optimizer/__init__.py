@@ -140,6 +140,7 @@ class SGD(Optimizer):
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -181,6 +182,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         z = lambda: _ndmod.zeros(weight.shape, ctx=weight.context,
@@ -360,6 +362,7 @@ class LAMB(Optimizer):
                  bias_correction=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
         self.lower_bound, self.upper_bound = lower_bound, upper_bound
         self.bias_correction = bias_correction
 
@@ -474,8 +477,49 @@ class Updater:
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and \
+                getattr(self.optimizer, "lazy_update", True):
+            self._lazy_row_update(index, grad, weight)
+            return
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    def _lazy_row_update(self, index, grad, weight):
+        """Row-sparse lazy update: only rows present in the gradient touch
+        the weight and optimizer state (parity: sgd_update/adam_update with
+        lazy_update=True on row_sparse grads)."""
+        import jax.numpy as jnp
+        rows = grad._sp_indices
+        sub_w = NDArray(weight.jax[rows])
+        sub_g = NDArray(grad._sp_data)
+
+        def take_rows(s):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return type(s)(take_rows(x) for x in s)
+            if isinstance(s, NDArray) and \
+                    tuple(s.shape) == tuple(weight.shape):
+                return NDArray(s.jax[rows])
+            return s
+
+        def put_rows(full, sub):
+            if full is None:
+                return
+            if isinstance(full, (tuple, list)):
+                for f, s in zip(full, sub):
+                    put_rows(f, s)
+                return
+            if isinstance(full, NDArray) and \
+                    tuple(full.shape) == tuple(weight.shape):
+                full._rebind(full.jax.at[rows].set(sub.jax))
+
+        state = self.states[index]
+        sub_state = take_rows(state)
+        self.optimizer.update_multi_precision(index, sub_w, sub_g, sub_state)
+        weight._rebind(weight.jax.at[rows].set(sub_w.jax))
+        put_rows(state, sub_state)
 
     def get_states(self, dump_optimizer=False):
         import io

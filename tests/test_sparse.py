@@ -1,0 +1,122 @@
+"""Sparse NDArray tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py / test_sparse_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr(shape, density, seed=0):
+    rs = onp.random.RandomState(seed)
+    dense = rs.randn(*shape).astype("float32")
+    dense[rs.rand(*shape) > density] = 0.0
+    return dense
+
+
+def test_csr_roundtrip():
+    dense = _rand_csr((6, 8), 0.3)
+    a = sparse.csr_matrix(dense)
+    assert a.stype == "csr"
+    onp.testing.assert_allclose(a.todense().asnumpy(), dense)
+    onp.testing.assert_allclose(a.asnumpy(), dense)
+    # component construction
+    b = sparse.csr_matrix((a.data, a.indices, a.indptr), shape=(6, 8))
+    onp.testing.assert_allclose(b.asnumpy(), dense)
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((8, 4), "float32")
+    dense[2] = 1.0
+    dense[5] = [1, 2, 3, 4]
+    a = sparse.row_sparse_array(dense)
+    assert a.stype == "row_sparse"
+    assert a.indices.asnumpy().tolist() == [2, 5]
+    onp.testing.assert_allclose(a.todense().asnumpy(), dense)
+
+
+def test_cast_storage():
+    dense = _rand_csr((5, 5), 0.4, seed=1)
+    d = nd.array(dense)
+    c = nd.cast_storage(d, "csr")
+    assert c.stype == "csr"
+    onp.testing.assert_allclose(c.asnumpy(), dense)
+    r = sparse.cast_storage(d, "row_sparse")
+    assert r.stype == "row_sparse"
+    back = sparse.cast_storage(c, "default")
+    assert back.stype == "default"
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (4, 5))
+    assert z.stype == "csr"
+    onp.testing.assert_allclose(z.asnumpy(), onp.zeros((4, 5)))
+    z2 = sparse.zeros("row_sparse", (4, 5))
+    onp.testing.assert_allclose(z2.asnumpy(), onp.zeros((4, 5)))
+
+
+def test_csr_dot_dense():
+    dense = _rand_csr((6, 10), 0.3, seed=2)
+    rhs = onp.random.RandomState(3).randn(10, 7).astype("float32")
+    a = sparse.csr_matrix(dense)
+    out = sparse.dot(a, nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_csr_dot_empty():
+    a = sparse.zeros("csr", (3, 4))
+    out = sparse.dot(a, nd.array(onp.ones((4, 2), "float32")))
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros((3, 2)))
+
+
+def test_retain():
+    dense = onp.zeros((6, 3), "float32")
+    dense[1] = 1
+    dense[3] = 2
+    dense[4] = 3
+    a = sparse.row_sparse_array(dense)
+    kept = sparse.retain(a, nd.array([1, 4], dtype="int64"))
+    assert kept.indices.asnumpy().tolist() == [1, 4]
+    want = dense.copy()
+    want[3] = 0
+    onp.testing.assert_allclose(kept.todense().asnumpy(), want)
+
+
+def test_lazy_sparse_sgd_update():
+    from mxnet_tpu.optimizer import SGD, get_updater
+    w = nd.array(onp.ones((6, 2), "float32"))
+    gdense = onp.zeros((6, 2), "float32")
+    gdense[1] = 1.0
+    gdense[4] = 2.0
+    grad = sparse.row_sparse_array(gdense)
+    upd = get_updater(SGD(learning_rate=0.5, momentum=0.9))
+    upd(0, grad, w)
+    want = onp.ones((6, 2), "float32")
+    want[1] -= 0.5
+    want[4] -= 1.0
+    onp.testing.assert_allclose(w.asnumpy(), want)
+    # momentum state touched only on updated rows
+    mom = upd.states[0].asnumpy()
+    assert onp.all(mom[0] == 0) and onp.all(mom[2] == 0)
+    assert onp.all(mom[1] != 0)
+    # second update applies momentum on touched rows only
+    upd(0, grad, w)
+    w2 = w.asnumpy()
+    assert onp.allclose(w2[0], 1.0)
+    assert w2[1][0] < want[1][0]
+
+
+def test_lazy_sparse_adam_update():
+    from mxnet_tpu.optimizer import Adam, get_updater
+    w = nd.array(onp.ones((5, 3), "float32"))
+    gdense = onp.zeros((5, 3), "float32")
+    gdense[2] = 1.0
+    grad = sparse.row_sparse_array(gdense)
+    upd = get_updater(Adam(learning_rate=0.1))
+    upd(0, grad, w)
+    out = w.asnumpy()
+    assert onp.allclose(out[0], 1.0) and onp.allclose(out[4], 1.0)
+    assert not onp.allclose(out[2], 1.0)
