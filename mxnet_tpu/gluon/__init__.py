@@ -9,3 +9,13 @@ from .utils import split_and_load
 
 from . import rnn  # noqa: E402
 from . import data  # noqa: E402
+
+
+def __getattr__(name):
+    if name == "contrib":
+        import importlib
+        mod = importlib.import_module(".contrib", __name__)
+        globals()["contrib"] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute "
+                         f"{name!r}")

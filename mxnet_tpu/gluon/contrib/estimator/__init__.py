@@ -1,0 +1,6 @@
+"""Estimator fit-loop (parity: python/mxnet/gluon/contrib/estimator)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,  # noqa
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            LoggingHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)

@@ -1,0 +1,127 @@
+"""Estimator (parity: python/mxnet/gluon/contrib/estimator/estimator.py) —
+the Keras-ish fit loop with event handlers."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .... import base as _base
+from .... import metric as _metric_mod
+from ....ndarray import NDArray
+from ... import Trainer
+from ...loss import Loss
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class _LossMetric(_metric_mod.EvalMetric):
+    """Tracks the running mean of the loss (parity: estimator's internal
+    'loss' metric)."""
+
+    def __init__(self, name="loss"):
+        super().__init__(name)
+
+    def update(self, _, losses):
+        for l in losses if isinstance(losses, (list, tuple)) else [losses]:
+            arr = l.asnumpy()
+            self.sum_metric += float(arr.sum())
+            self.num_inst += arr.size
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, context=None,
+                 evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        self.stop_training = False
+        self.context = context
+
+        def norm_metrics(ms):
+            if ms is None:
+                return []
+            ms = ms if isinstance(ms, (list, tuple)) else [ms]
+            return [m if isinstance(m, _metric_mod.EvalMetric)
+                    else _metric_mod.create(m) for m in ms]
+
+        self.train_metrics = norm_metrics(train_metrics) or \
+            [_metric_mod.Accuracy()]
+        self.val_metrics = norm_metrics(val_metrics) or \
+            [type(m)() for m in self.train_metrics]
+        self.train_loss_metric = _LossMetric("train_loss")
+        self.val_loss_metric = _LossMetric("val_loss")
+
+        if initializer is not None:
+            self.net.initialize(initializer)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+
+    # ------------------------------------------------------------------
+    def evaluate(self, val_data, batch_axis=0):
+        from .... import autograd
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data, label = self._unpack(batch)
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            self.val_loss_metric.update(None, loss)
+            for m in self.val_metrics:
+                m.update([label], [pred])
+        return [self.val_loss_metric] + list(self.val_metrics)
+
+    def _unpack(self, batch):
+        if isinstance(batch, (list, tuple)):
+            data, label = batch[0], batch[1]
+        else:
+            data, label = batch.data[0], batch.label[0]
+        return data, label
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        from .... import autograd
+
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+
+        def fire(event, cls):
+            for h in handlers:
+                if isinstance(h, cls):
+                    getattr(h, event)(self)
+
+        self.stop_training = False
+        fire("train_begin", TrainBegin)
+        while not self.stop_training:
+            for m in self.train_metrics:
+                m.reset()
+            self.train_loss_metric.reset()
+            fire("epoch_begin", EpochBegin)
+            for batch in train_data:
+                if self.stop_training:
+                    break
+                fire("batch_begin", BatchBegin)
+                data, label = self._unpack(batch)
+                bsz = data.shape[batch_axis]
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(bsz)
+                self.train_loss_metric.update(None, loss)
+                for m in self.train_metrics:
+                    m.update([label], [pred])
+                fire("batch_end", BatchEnd)
+            fire("epoch_end", EpochEnd)
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+        fire("train_end", TrainEnd)
+        return self

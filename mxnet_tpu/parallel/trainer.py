@@ -353,6 +353,51 @@ class ShardedTrainer:
             return
         self._apply_loaded_states(loaded)
 
+    # -------------------------------------------------- sharded checkpoints
+    def _checkpoint_tree(self):
+        return {
+            "params": {n: p._data for n, p in self._trainable},
+            "aux": {n: p._data for n, p in self._aux},
+            "states": {f"s{i}": l for i, l in enumerate(self._state_flat)},
+        }
+
+    def save_checkpoint(self, directory, step: int, async_save=True,
+                        max_to_keep=5):
+        """Async sharded checkpoint (orbax): params + aux + optimizer states
+        + step counter; each host writes only its shards.  Returns the
+        manager so callers can overlap (`wait_until_finished` before exit)."""
+        from ..utils.checkpoint import CheckpointManager
+        if not self._built:
+            raise _base.MXNetError("save_checkpoint before first step()")
+        m = CheckpointManager(directory, max_to_keep=max_to_keep,
+                              async_save=async_save)
+        tree = self._checkpoint_tree()
+        tree["num_update"] = jnp.asarray(self.optimizer.num_update, jnp.int32)
+        m.save(step, tree)
+        return m
+
+    def load_checkpoint(self, directory, step=None):
+        """Restore a sharded checkpoint with the live NamedShardings."""
+        from ..utils.checkpoint import CheckpointManager
+        if not self._built:
+            raise _base.MXNetError(
+                "load_checkpoint needs the trainer built — run one step() "
+                "on example data first (shapes/shardings must exist)")
+        like = self._checkpoint_tree()
+        like["num_update"] = jnp.asarray(0, jnp.int32)
+        m = CheckpointManager(directory, async_save=False)
+        try:
+            restored = m.restore(step, like=like)
+        finally:
+            m.close()
+        for n, p in self._trainable:
+            p._data._rebind(restored["params"][n])
+        for n, p in self._aux:
+            p._data._rebind(restored["aux"][n])
+        for i, l in enumerate(self._state_flat):
+            l._rebind(restored["states"][f"s{i}"])
+        self.optimizer.num_update = int(restored["num_update"])
+
     def _apply_loaded_states(self, loaded):
         if "num_update" in loaded:
             self.optimizer.num_update = int(loaded["num_update"].asnumpy()[0])

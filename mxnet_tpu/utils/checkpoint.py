@@ -1,0 +1,101 @@
+"""Sharded async checkpointing (orbax-backed).
+
+Parity role: SURVEY.md §5.4 — the TPU-native upgrade under Trainer/
+ShardedTrainer state persistence: MXNet's dmlc-container save/load remains
+the portable format (mx.nd.save), while pod-scale runs use this module for
+**async, per-shard** checkpoints that don't stall the step loop and restore
+with the original NamedShardings (each host writes only its shards).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from .. import base as _base
+from ..ndarray import NDArray
+
+__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
+
+
+def _to_jax_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.jax if isinstance(x, NDArray) else x, tree,
+        is_leaf=lambda x: isinstance(x, NDArray))
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager with async saves.
+
+    `save(step, tree)` returns immediately (background write); call
+    `wait_until_finished()` before exiting.  `restore(step, like=tree)`
+    restores with the shardings/dtypes of `like`'s leaves.
+    """
+
+    def __init__(self, directory, max_to_keep: int = 5,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mngr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def save(self, step: int, tree: Any) -> bool:
+        return self._mngr.save(step, args=self._ocp.args.StandardSave(
+            _to_jax_tree(tree)))
+
+    def restore(self, step: Optional[int] = None, like: Any = None):
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise _base.MXNetError(
+                f"no checkpoint found under {self.directory}")
+        if like is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape,
+                    (x.jax.dtype if isinstance(x, NDArray) else x.dtype),
+                    sharding=_sharding_of(x)),
+                like, is_leaf=lambda x: isinstance(x, NDArray))
+            return self._mngr.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+        return self._mngr.restore(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def all_steps(self):
+        return self._mngr.all_steps()
+
+    def wait_until_finished(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+
+def _sharding_of(x):
+    v = x.jax if isinstance(x, NDArray) else x
+    return getattr(v, "sharding", None)
+
+
+def save_checkpoint(directory, step: int, tree, async_save=True,
+                    max_to_keep=5):
+    """One-shot convenience save."""
+    m = CheckpointManager(directory, max_to_keep=max_to_keep,
+                          async_save=async_save)
+    m.save(step, tree)
+    m.wait_until_finished()
+    m.close()
+
+
+def load_checkpoint(directory, step=None, like=None):
+    m = CheckpointManager(directory, async_save=False)
+    try:
+        return m.restore(step, like=like)
+    finally:
+        m.close()
