@@ -1,0 +1,348 @@
+"""Pallas TPU flash attention: O(T) memory, MXU-tiled, fwd + custom bwd.
+
+Capability add over the reference (SURVEY.md §5.7: MXNet ships NO
+flash/ring attention; its fused BERT matmuls in
+src/operator/contrib/transformer.cc materialize the full (T, T) score
+matrix).  This kernel never materializes scores: the softmax is computed
+online per (block_q, block_k) tile held in VMEM, accumulating into an
+f32 VMEM scratch, so long sequences are bounded by HBM for Q/K/V only.
+
+Layout: public entry takes (B, T, H, D) and flattens to (B*H, T, D);
+grid = (batch*heads, q_blocks, kv_blocks) with the kv dimension innermost
+("arbitrary" semantics — it carries the online-softmax accumulator) and
+the first two parallel.  Causal blocks above the diagonal are predicated
+out with ``pl.when`` so the MXU never sees them.
+
+The backward pass is the standard flash-attention-2 split: a ``dq``
+kernel (grid over q blocks, reducing across kv) and a ``dkv`` kernel
+(grid over kv blocks, reducing across q), both re-computing the tile of
+probabilities from the saved per-row logsumexp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_MASK = -1e30
+_LANES = 128
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------- fwd
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _MASK)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _tile():
+        q = q_ref[0]                                   # (bq, d)
+        k = k_ref[0]                                   # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, _MASK)
+        m_prev = m_ref[:, :1]                          # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_next)                        # (bq, bk)
+        corr = jnp.exp(m_prev - m_next)                # (bq, 1)
+        l_next = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, d)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    if causal:
+        @pl.when(ki * block_k < (qi + 1) * block_q)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = pl.cdiv(tq, block_q)
+    nk = pl.cdiv(tk, block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse is (bh, 1, tq) so each qi owns its own (1, 1, block_q)
+            # tile — TPU block rules demand last-two dims divisible by
+            # (8, 128) or equal to the array dims, and a shared full-row
+            # block would race across megacore's parallel qi partitions.
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * tq * tk * d, transcendentals=bh * tq * tk,
+            bytes_accessed=2 * (q.size + k.size + v.size) * q.dtype.itemsize),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------- bwd
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _tile():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, _MASK)
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if causal:
+        @pl.when(ki * block_k < (qi + 1) * block_q)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _tile():
+        q = q_ref[0]                                   # (bq, d)
+        k = k_ref[0]                                   # (bk, d)
+        do = do_ref[0]                                 # (bq, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col <= row, s, _MASK)
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        # dV += P^T @ dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        # dK += dS^T @ Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _tile()
+    else:
+        _tile()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, out, lse, do, causal, scale, block_q, block_k,
+              interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq = pl.cdiv(tq, block_q)
+    nk = pl.cdiv(tk, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]               # (bh, 1, tq)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------- custom_vjp glue
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _bwd_impl(q, k, v, out, lse, do, causal, scale,
+                     block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Flash attention on (B, T, H, D) inputs → (B, T, H, D).
+
+    T must be a multiple of the block sizes and D one of 64/128/256 (the
+    dispatcher in :mod:`mxnet_tpu.ops.attention` guarantees this before
+    routing here).  ``interpret`` defaults to True off-TPU so the same
+    kernel is unit-testable on the CPU backend.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if causal and tq != tk:
+        raise ValueError("causal flash attention requires tq == tk "
+                         f"(got {tq} vs {tk})")
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(
+            f"seq lens ({tq}, {tk}) must divide by blocks "
+            f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = _default_interpret()
+
+    def flat(x, t):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash(flat(q, tq), flat(k, tk), flat(v, tk),
+                 causal, scale, block_q, block_k, bool(interpret))
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,96 @@
+"""Pallas flash-attention kernel vs the XLA reference attention.
+
+Runs in interpret mode on the CPU backend (same kernel code path that
+compiles on TPU).  Parity note: the reference framework has no flash
+attention (SURVEY.md §5.7) — the contract here is agreement with
+``_attention_ref``, the XLA attention both models and tests share.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu.ops.attention import _attention_ref, dot_product_attention
+from mxnet_tpu.ops.flash import flash_attention
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(onp.random.RandomState(seed).randn(*shape),
+                       jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t,d", [(256, 64), (384, 128)])
+def test_flash_forward_matches_ref(causal, t, d):
+    b, h = 2, 2
+    q, k, v = (_rand((b, t, h, d), s) for s in (0, 1, 2))
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _attention_ref(q, k, v, causal=causal)
+    assert out.shape == (b, t, h, d)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_ref(causal):
+    b, t, h, d = 1, 256, 2, 64
+    q, k, v = (_rand((b, t, h, d), s) for s in (3, 4, 5))
+
+    def f(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def g(q, k, v):
+        return jnp.sum(_attention_ref(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b_),
+                                    rtol=5e-2, atol=5e-2)
+
+
+def test_flash_cross_attention_lengths():
+    # non-causal tq != tk (cross attention)
+    b, h, d = 1, 2, 64
+    q = _rand((b, 256, h, d), 6)
+    k = _rand((b, 512, h, d), 7)
+    v = _rand((b, 512, h, d), 8)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = _attention_ref(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+
+def test_flash_bf16():
+    b, t, h, d = 1, 256, 2, 64
+    q, k, v = (_rand((b, t, h, d), s).astype(jnp.bfloat16)
+               for s in (9, 10, 11))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _attention_ref(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    onp.testing.assert_allclose(
+        onp.asarray(out, onp.float32), onp.asarray(ref, onp.float32),
+        rtol=1e-1, atol=1e-1)
+
+
+def test_flash_rejects_bad_shapes():
+    b, h, d = 1, 2, 64
+    q = _rand((b, 200, h, d))
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=128, block_k=128, interpret=True)
+    k = _rand((b, 512, h, d))
+    with pytest.raises(ValueError):
+        flash_attention(q[:, :256], k, k, causal=True, interpret=True)
+
+
+def test_dot_product_attention_dispatch_ref():
+    # off-TPU the public entry must route to the XLA reference and agree
+    # with it exactly.
+    import mxnet_tpu as mx
+    b, t, h, d = 2, 64, 2, 16
+    q = mx.nd.array(onp.random.RandomState(1).randn(b, t, h, d))
+    out = dot_product_attention(q, q, q, causal=True)
+    ref = _attention_ref(q.jax, q.jax, q.jax, causal=True)
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref), rtol=1e-5,
+                                atol=1e-5)
