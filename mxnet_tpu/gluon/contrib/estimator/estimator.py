@@ -2,13 +2,10 @@
 the Keras-ish fit loop with event handlers."""
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import copy
 
-from .... import base as _base
 from .... import metric as _metric_mod
-from ....ndarray import NDArray
 from ... import Trainer
-from ...loss import Loss
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             LoggingHandler, StoppingHandler, TrainBegin,
                             TrainEnd, ValidationHandler)
@@ -48,8 +45,9 @@ class Estimator:
 
         self.train_metrics = norm_metrics(train_metrics) or \
             [_metric_mod.Accuracy()]
+        # deepcopy keeps constructor config (e.g. TopKAccuracy(top_k=5))
         self.val_metrics = norm_metrics(val_metrics) or \
-            [type(m)() for m in self.train_metrics]
+            [copy.deepcopy(m) for m in self.train_metrics]
         self.train_loss_metric = _LossMetric("train_loss")
         self.val_loss_metric = _LossMetric("val_loss")
 
@@ -60,7 +58,6 @@ class Estimator:
 
     # ------------------------------------------------------------------
     def evaluate(self, val_data, batch_axis=0):
-        from .... import autograd
         for m in self.val_metrics:
             m.reset()
         self.val_loss_metric.reset()
@@ -85,6 +82,10 @@ class Estimator:
             batches=None, batch_axis=0):
         from .... import autograd
 
+        if epochs is None and batches is None:
+            raise ValueError(
+                "fit() needs a stopping criterion: pass epochs or batches")
+
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
         if not any(isinstance(h, LoggingHandler) for h in handlers):
@@ -92,6 +93,9 @@ class Estimator:
         if val_data is not None and \
                 not any(isinstance(h, ValidationHandler) for h in handlers):
             handlers.append(ValidationHandler(val_data, self.evaluate))
+        # lower priority fires first (ValidationHandler is -1000 so metrics
+        # exist before early-stop/checkpoint handlers read them)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
 
         def fire(event, cls):
             for h in handlers:

@@ -57,15 +57,19 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
     def train_begin(self, estimator, *args, **kwargs):
         self.current_batch = 0
         self.current_epoch = 0
+        # epochs=0 / batches=0 means "train nothing", not "train forever"
+        if (self.max_epoch is not None and self.max_epoch <= 0) or \
+                (self.max_batch is not None and self.max_batch <= 0):
+            estimator.stop_training = True
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
-        if self.max_batch and self.current_batch >= self.max_batch:
+        if self.max_batch is not None and self.current_batch >= self.max_batch:
             estimator.stop_training = True
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
-        if self.max_epoch and self.current_epoch >= self.max_epoch:
+        if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
             estimator.stop_training = True
 
 
@@ -169,7 +173,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
 
-    def _save(self, estimator, tag):
+    def _save(self, estimator, tag, rotate=True):
         prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
         estimator.net.save_parameters(prefix + ".params")
         if estimator.trainer is not None:
@@ -177,6 +181,8 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                 estimator.trainer.save_states(prefix + ".states")
             except Exception:
                 pass
+        if not rotate:      # the single 'best' file never enters rotation
+            return
         self.saved.append(prefix)
         while len(self.saved) > self.max_checkpoints:
             old = self.saved.pop(0)
@@ -199,7 +205,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             _, val = self.monitor.get()
             if self.better(val, self.best):
                 self.best = val
-                self._save(estimator, "best")
+                self._save(estimator, "best", rotate=False)
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
@@ -216,14 +222,18 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         name = monitor.get()[0] if monitor is not None else ""
         if mode == "min" or (mode == "auto" and "loss" in name):
             self.better = lambda a, b: a < b - self.min_delta
-            self.best = onp.inf if baseline is None else baseline
+            self._initial_best = onp.inf if baseline is None else baseline
         else:
             self.better = lambda a, b: a > b + self.min_delta
-            self.best = -onp.inf if baseline is None else baseline
+            self._initial_best = -onp.inf if baseline is None else baseline
+        self.best = self._initial_best
 
     def train_begin(self, estimator, *args, **kwargs):
+        # full reset so a second fit() doesn't compare against the last run
         self.wait = 0
         self.current_epoch = 0
+        self.stopped_epoch = 0
+        self.best = self._initial_best
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1

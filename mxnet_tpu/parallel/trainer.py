@@ -143,6 +143,7 @@ class ShardedTrainer:
         self._state_flat: List[NDArray] = []
         self._state_shardings: List[NamedSharding] = []
         self._pending_states: Optional[dict] = None
+        self._ckpt_managers: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     def _build(self, data, labels):
@@ -364,13 +365,19 @@ class ShardedTrainer:
     def save_checkpoint(self, directory, step: int, async_save=True,
                         max_to_keep=5):
         """Async sharded checkpoint (orbax): params + aux + optimizer states
-        + step counter; each host writes only its shards.  Returns the
-        manager so callers can overlap (`wait_until_finished` before exit)."""
+        + step counter; each host writes only its shards.  One manager is
+        cached per directory (so periodic saves share async machinery and
+        max_to_keep GC never races an in-flight write); returns it so
+        callers can `wait_until_finished` before exit."""
         from ..utils.checkpoint import CheckpointManager
         if not self._built:
             raise _base.MXNetError("save_checkpoint before first step()")
-        m = CheckpointManager(directory, max_to_keep=max_to_keep,
-                              async_save=async_save)
+        key = str(directory)
+        m = self._ckpt_managers.get(key)
+        if m is None:
+            m = CheckpointManager(directory, max_to_keep=max_to_keep,
+                                  async_save=async_save)
+            self._ckpt_managers[key] = m
         tree = self._checkpoint_tree()
         tree["num_update"] = jnp.asarray(self.optimizer.num_update, jnp.int32)
         m.save(step, tree)

@@ -140,3 +140,55 @@ def test_sharded_trainer_checkpoint(tmp_path):
             onp.testing.assert_allclose(p.data().asnumpy(), w_before[n],
                                         rtol=1e-6)
         assert tr.optimizer.num_update == nu_before
+
+
+def test_fit_requires_stopping_criterion():
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    with pytest.raises(ValueError):
+        est.fit(_toy_loader())            # no epochs, no batches
+    est.fit(_toy_loader(), epochs=0)      # trains nothing, terminates
+    est.fit(_toy_loader(), batches=3)     # batch-bounded run terminates
+
+
+def test_validation_runs_before_monitors():
+    # ValidationHandler (priority -1000) must fire before the early stopper
+    # reads val metrics: with a fresh estimator the first epoch_end would
+    # otherwise see an empty (nan) metric and stop instantly.
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    stopper = EarlyStoppingHandler(monitor=est.val_metrics[0], patience=0,
+                                   mode="max")
+    est.fit(_toy_loader(), val_data=_toy_loader(seed=1), epochs=3,
+            event_handlers=[stopper])
+    n, v = est.val_metrics[0].get()
+    assert not onp.isnan(v)
+    # second fit on the same handler starts from a clean slate
+    est.fit(_toy_loader(), val_data=_toy_loader(seed=1), epochs=2,
+            event_handlers=[stopper])
+    assert stopper.current_epoch >= 1
+
+
+def test_checkpoint_best_survives_rotation(tmp_path):
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="m", epoch_period=1,
+                             max_checkpoints=2, save_best=True, mode="max",
+                             monitor=est.train_metrics[0])
+    est.fit(_toy_loader(), epochs=6, event_handlers=[ckpt])
+    assert os.path.exists(os.path.join(tmp_path, "m-best.params"))
+    kept = [f for f in os.listdir(tmp_path)
+            if f.startswith("m-epoch") and f.endswith(".params")]
+    assert len(kept) == 2  # rotation still bounded
+
+
+def test_val_metric_copies_config():
+    from mxnet_tpu import metric as mmetric
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mmetric.TopKAccuracy(top_k=2))
+    assert est.val_metrics[0].top_k == 2
