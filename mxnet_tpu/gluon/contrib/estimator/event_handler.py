@@ -164,14 +164,21 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.saved = []
         if mode == "min" or (mode == "auto" and monitor is not None
                              and "loss" in monitor.get()[0]):
-            self.best = onp.inf
+            self._initial_best = onp.inf
             self.better = lambda a, b: a < b
         else:
-            self.best = -onp.inf
+            self._initial_best = -onp.inf
             self.better = lambda a, b: a > b
+        self.best = self._initial_best
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
+        # full reset so a reused handler doesn't compare run 2 against
+        # run 1's best or keep rotating run 1's files
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.saved = []
+        self.best = self._initial_best
 
     def _save(self, estimator, tag, rotate=True):
         prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")

@@ -59,9 +59,22 @@ class MultiHeadAttention(HybridBlock):
         q = self.q_proj(x).reshape((b, t, h, d))
         k = self.k_proj(x).reshape((b, t, h, d))
         v = self.v_proj(x).reshape((b, t, h, d))
-        out = dot_product_attention(
-            q, k, v, causal=self._causal, mask=mask,
-            dropout=self._att_dropout)
+        mesh = _par.current_mesh()
+        sp = _par.axis_size(mesh, "sp") if mesh is not None else 1
+        # shard_map needs every sharded dim to divide its mesh axis —
+        # uneven shapes (e.g. a last odd-sized batch) keep the GSPMD path
+        divisible = (sp > 1 and isinstance(t, int) and t % sp == 0
+                     and b % _par.axis_size(mesh, "dp") == 0
+                     and h % _par.axis_size(mesh, "tp") == 0)
+        if divisible and mask is None and self._att_dropout == 0.0:
+            # sequence parallel: K/V chunks ride the ICI ring instead of
+            # an all-gather of the full sequence per device
+            from ..ops import nd_ring_attention
+            out = nd_ring_attention(q, k, v, causal=self._causal, mesh=mesh)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=self._causal, mask=mask,
+                dropout=self._att_dropout)
         out = _par.with_sharding_constraint(out, "batch", "seq", "heads",
                                             None)
         out = self.out_proj(out.reshape((b, t, h * d)))

@@ -11,7 +11,9 @@ reference implementations used for testing and CPU execution.
 from .attention import (dot_product_attention, flash_attention,
                         interleaved_matmul_selfatt_qk,
                         interleaved_matmul_selfatt_valatt)
+from .ring import nd_ring_attention, ring_attention
 
 __all__ = ["dot_product_attention", "flash_attention",
            "interleaved_matmul_selfatt_qk",
-           "interleaved_matmul_selfatt_valatt"]
+           "interleaved_matmul_selfatt_valatt",
+           "nd_ring_attention", "ring_attention"]

@@ -1,0 +1,133 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Capability add over the reference (SURVEY.md §5.7: MXNet has no sequence
+parallelism of any kind).  Q stays resident; K/V chunks rotate around the
+ring of ``sp`` devices via ``jax.lax.ppermute`` (XLA lowers this to ICI
+neighbor RDMA), and partial attention results merge with the numerically
+stable online-softmax rule — so a sequence of length T costs each device
+O(T/sp) memory and the compute of its own chunk, while the compiler
+overlaps each step's ppermute with the previous step's matmuls.
+
+Each per-chunk block is wrapped in ``jax.checkpoint`` so the backward pass
+recomputes the (Tl x Tl) score tiles instead of keeping ``sp`` of them
+alive, matching flash attention's memory discipline.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .attention import _NEG_INF as _MASK
+
+
+@functools.partial(jax.checkpoint, static_argnums=(5, 6))
+def _block(q, k, v, q_pos, kv_pos, causal, scale):
+    """Partial attention of local Q against one K/V chunk.
+
+    q: (B, Tl, H, D); k/v: (B, Tc, H, D); returns un-normalized
+    (pv (B, H, Tl, D) f32, m (B, H, Tl, 1), l (B, H, Tl, 1)).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        keep = kv_pos[None, :] <= q_pos[:, None]       # (Tl, Tc)
+        s = jnp.where(keep[None, None], s, _MASK)
+    m = jnp.max(s, axis=-1, keepdims=True)             # (B, H, Tl, 1)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    return pv, m, l
+
+
+def _ring_local(q, k, v, *, axis, steps, causal, scale):
+    """Per-device body under shard_map: q/k/v are local (B, Tl, H, D)."""
+    idx = jax.lax.axis_index(axis)
+    tl = q.shape[1]
+    offs = jax.lax.broadcasted_iota(jnp.int32, (tl, 1), 0)[:, 0]
+    q_pos = idx * tl + offs
+    perm = [(i, (i + 1) % steps) for i in range(steps)]
+
+    acc = m = l = None
+    for t in range(steps):
+        owner = (idx - t) % steps                      # chunk's home device
+        kv_pos = owner * tl + offs
+        pv, m_c, l_c = _block(q, k, v, q_pos, kv_pos, causal, scale)
+        if t == 0:
+            # step 0 is the diagonal chunk: every causal row has >= 1
+            # unmasked key, so m is finite and later fully-masked chunks
+            # (m_c = _MASK) merge with weight exp(_MASK - m) = 0, nan-free
+            acc, m, l = pv, m_c, l_c
+        else:
+            m_new = jnp.maximum(m, m_c)
+            c_old = jnp.exp(m - m_new)
+            c_new = jnp.exp(m_c - m_new)
+            acc = acc * c_old + pv * c_new
+            l = l * c_old + l_c * c_new
+            m = m_new
+        if t + 1 < steps:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+    out = acc / l                                      # (B, H, Tl, D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None, mesh=None,
+                   axis: str = "sp", batch_axis: str = "dp",
+                   heads_axis: str = "tp"):
+    """Sequence-parallel attention on global (B, T, H, D) jax arrays.
+
+    Shards T over ``axis`` (and B over ``batch_axis``, H over
+    ``heads_axis``) with shard_map; falls back to single-device attention
+    when the axis has size 1.  Requires T divisible by the axis size.
+    """
+    from ..parallel.mesh import axis_size, current_mesh
+    mesh = mesh or current_mesh()
+    steps = axis_size(mesh, axis) if mesh is not None else 1
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if steps == 1:
+        from .attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    t = q.shape[1]
+    if t % steps or k.shape[1] != t:
+        raise ValueError(
+            f"ring attention needs tq == tk divisible by |{axis}|={steps}, "
+            f"got tq={t}, tk={k.shape[1]}")
+    spec = P(batch_axis, axis, heads_axis, None)
+    restore = None
+    if not isinstance(q, jax.core.Tracer):
+        # eager entry: spread single-device arrays over the mesh, and put
+        # the result back afterwards so downstream eager math (residual
+        # adds on the caller's device) sees a consistent placement
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, spec)
+        if q.sharding != sh:
+            restore = q.sharding
+        q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    body = functools.partial(_ring_local, axis=axis, steps=steps,
+                             causal=causal, scale=scale)
+    f = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    out = f(q, k, v)
+    if restore is not None:
+        out = jax.device_put(out, restore)
+    return out
+
+
+def nd_ring_attention(query, key, value, *, causal=False, scale=None,
+                      mesh=None, axis="sp"):
+    """NDArray-level entry (autograd-recorded) for ring attention."""
+    from ..ndarray.ops import _as_nd, invoke
+    query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, causal=causal, scale=scale,
+                              mesh=mesh, axis=axis)
+
+    return invoke("ring_attention", f, [query, key, value])

@@ -369,15 +369,22 @@ class ShardedTrainer:
         cached per directory (so periodic saves share async machinery and
         max_to_keep GC never races an in-flight write); returns it so
         callers can `wait_until_finished` before exit."""
+        import os
         from ..utils.checkpoint import CheckpointManager
         if not self._built:
             raise _base.MXNetError("save_checkpoint before first step()")
-        key = str(directory)
-        m = self._ckpt_managers.get(key)
-        if m is None:
+        key = os.path.abspath(str(directory))
+        cached = self._ckpt_managers.get(key)
+        if cached is not None and cached[1] != (max_to_keep, async_save):
+            cached[0].wait_until_finished()
+            cached[0].close()
+            cached = None
+        if cached is None:
             m = CheckpointManager(directory, max_to_keep=max_to_keep,
                                   async_save=async_save)
-            self._ckpt_managers[key] = m
+            self._ckpt_managers[key] = (m, (max_to_keep, async_save))
+        else:
+            m = cached[0]
         tree = self._checkpoint_tree()
         tree["num_update"] = jnp.asarray(self.optimizer.num_update, jnp.int32)
         m.save(step, tree)
@@ -385,11 +392,17 @@ class ShardedTrainer:
 
     def load_checkpoint(self, directory, step=None):
         """Restore a sharded checkpoint with the live NamedShardings."""
+        import os
         from ..utils.checkpoint import CheckpointManager
         if not self._built:
             raise _base.MXNetError(
                 "load_checkpoint needs the trainer built — run one step() "
                 "on example data first (shapes/shardings must exist)")
+        # drain any in-flight async save to this directory first, else the
+        # restore silently lands on the previous step
+        cached = self._ckpt_managers.get(os.path.abspath(str(directory)))
+        if cached is not None:
+            cached[0].wait_until_finished()
         like = self._checkpoint_tree()
         like["num_update"] = jnp.asarray(0, jnp.int32)
         m = CheckpointManager(directory, async_save=False)

@@ -1,0 +1,94 @@
+"""Ring attention (sequence parallelism over the sp mesh axis).
+
+Runs on the 8-virtual-device CPU mesh from conftest.  Capability add over
+the reference (SURVEY.md §5.7: MXNet has no SP/CP) — the contract is
+numerical agreement with single-device attention.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel as par
+from mxnet_tpu.ops.attention import _attention_ref
+from mxnet_tpu.ops.ring import ring_attention
+
+
+def _qkv(b=4, t=64, h=4, d=16, seed=0):
+    rs = onp.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dp,sp,tp", [(2, 4, 1), (1, 8, 1), (2, 2, 2)])
+def test_ring_matches_ref(causal, dp, sp, tp):
+    mesh = par.make_mesh(dp=dp, sp=sp, tp=tp)
+    q, k, v = _qkv()
+    with par.use_mesh(mesh):
+        out = ring_attention(q, k, v, causal=causal)
+    ref = _attention_ref(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_grads_match_ref(causal):
+    mesh = par.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    q, k, v = _qkv(b=2, t=32, h=2, d=8, seed=1)
+    with par.use_mesh(mesh):
+        gf = jax.grad(
+            lambda q, k, v: jnp.sum(
+                ring_attention(q, k, v, causal=causal) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(_attention_ref(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gf, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(r),
+                                    rtol=1e-3, atol=1e-3)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = par.make_mesh(dp=2, sp=4)
+    q, k, v = _qkv(t=66)
+    with par.use_mesh(mesh):
+        with pytest.raises(ValueError):
+            ring_attention(q, k, v)
+
+
+def test_mha_routes_to_ring_under_sp_mesh():
+    """MultiHeadAttention must produce identical results with and without
+    sequence parallelism (ring vs single-device path)."""
+    from mxnet_tpu.models.transformer import MultiHeadAttention
+    rs = onp.random.RandomState(3)
+    x = nd.array(rs.randn(2, 32, 16).astype("float32"))
+    attn = MultiHeadAttention(16, 4, causal=True)
+    attn.initialize()
+    base = attn(x).asnumpy()
+    mesh = par.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    with par.use_mesh(mesh):
+        ringed = attn(x).asnumpy()
+    onp.testing.assert_allclose(ringed, base, rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_trainer_sp_training_step():
+    """Full sharded GPT-2 training step with sp>1 goes through ring
+    attention and still decreases the loss."""
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+    mesh = par.make_mesh(dp=2, sp=2, tp=2)
+    net = get_gpt2("gpt2_124m", vocab_size=128, units=32, num_layers=2,
+                   num_heads=4, max_length=64, dropout=0.0)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    toks = mx.nd.array(rs.randint(0, 128, (4, 32)), dtype="int32")
+    labels = mx.nd.array(rs.randint(0, 128, (4, 32)), dtype="int32")
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                optimizer_params={"learning_rate": 1e-2},
+                                mesh=mesh, seq_axis=1)
+        first = float(tr.step(toks, labels).asnumpy())
+        for _ in range(5):
+            last = float(tr.step(toks, labels).asnumpy())
+    assert last < first, (first, last)
