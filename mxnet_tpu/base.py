@@ -147,6 +147,34 @@ def set_recording(flag: bool) -> bool:
     return prev
 
 
+# Ambient auxiliary-loss collector (MoE router losses etc.): layers append
+# during forward, loss functions drain within the same trace/tape.  Traced
+# (jit) values may only be recorded inside an aux_collection scope — the
+# scope owner guarantees the loss is computed within the SAME trace, so
+# tracers never leak (e.g. out of a CachedOp forward into an eager loss).
+
+def aux_collection_active() -> bool:
+    return getattr(_STATE, "aux_collect", False)
+
+
+def set_aux_collection(flag: bool) -> bool:
+    prev = aux_collection_active()
+    _STATE.aux_collect = bool(flag)
+    return prev
+
+
+def record_aux_loss(x) -> None:
+    if not hasattr(_STATE, "aux_losses"):
+        _STATE.aux_losses = []
+    _STATE.aux_losses.append(x)
+
+
+def pop_aux_losses() -> list:
+    out = list(getattr(_STATE, "aux_losses", ()))
+    _STATE.aux_losses = []
+    return out
+
+
 # Numeric promotion helper shared by the nd namespace.
 
 def wrap_scalar(x, like_dtype=None):

@@ -7,10 +7,14 @@ transformer) — all built on TP/SP-aware blocks (see models.transformer).
 from . import vision
 from .bert import BERTForPretrain, BERTModel, get_bert
 from .gpt2 import GPT2Model, get_gpt2, gpt2_lm_loss
+from .moe import MoELayer, MoETransformerBlock, pop_aux_losses
+from .stacked import StackedGPT2Model, get_stacked_gpt2
 from .transformer import (MultiHeadAttention, PositionwiseFFN,
                           TransformerBlock, TransformerEncoderLayer)
 from .vision import get_model
 
 __all__ = ["vision", "get_model", "BERTModel", "BERTForPretrain", "get_bert",
-           "GPT2Model", "get_gpt2", "gpt2_lm_loss", "MultiHeadAttention",
-           "PositionwiseFFN", "TransformerBlock", "TransformerEncoderLayer"]
+           "GPT2Model", "get_gpt2", "gpt2_lm_loss", "MoELayer",
+           "MoETransformerBlock", "pop_aux_losses", "StackedGPT2Model",
+           "get_stacked_gpt2", "MultiHeadAttention", "PositionwiseFFN",
+           "TransformerBlock", "TransformerEncoderLayer"]

@@ -28,7 +28,8 @@ class GPT2Model(HybridBlock):
 
     def __init__(self, vocab_size=50257, units=768, num_layers=12,
                  num_heads=12, max_length=1024, dropout=0.1,
-                 layer_norm_eps=1e-5, **kwargs):
+                 layer_norm_eps=1e-5, num_experts=0, moe_every=2,
+                 moe_top_k=2, moe_capacity_factor=1.25, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.vocab_size = vocab_size
@@ -40,9 +41,17 @@ class GPT2Model(HybridBlock):
         self.drop = Dropout(dropout) if dropout else None
         self.blocks = []
         for i in range(num_layers):
-            blk = TransformerBlock(units, 4 * units, num_heads,
-                                   dropout=dropout, causal=True,
-                                   layer_norm_eps=layer_norm_eps)
+            if num_experts and i % moe_every == moe_every - 1:
+                from .moe import MoETransformerBlock
+                blk = MoETransformerBlock(
+                    units, 4 * units, num_heads, num_experts,
+                    top_k=moe_top_k, capacity_factor=moe_capacity_factor,
+                    dropout=dropout, causal=True,
+                    layer_norm_eps=layer_norm_eps)
+            else:
+                blk = TransformerBlock(units, 4 * units, num_heads,
+                                       dropout=dropout, causal=True,
+                                       layer_norm_eps=layer_norm_eps)
             self.register_child(blk, f"h{i}")
             self.blocks.append(blk)
         self.ln_f = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
@@ -68,11 +77,17 @@ class GPT2Model(HybridBlock):
         return _par.with_sharding_constraint(logits, "batch", "seq", "vocab")
 
 
-def gpt2_lm_loss(logits, labels):
-    """Next-token cross entropy; labels (B, T) already shifted."""
+def gpt2_lm_loss(logits, labels, aux_weight=0.01):
+    """Next-token cross entropy; labels (B, T) already shifted.  Any MoE
+    router aux losses recorded during the forward are drained and added
+    (weight 0 cost for dense models — the collector is simply empty)."""
+    from .moe import pop_aux_losses
     logp = F.log_softmax(logits, axis=-1)
     nll = -F.pick(logp, labels, axis=-1)
-    return nll.mean()
+    loss = nll.mean()
+    for aux in pop_aux_losses():
+        loss = loss + aux * aux_weight
+    return loss
 
 
 def get_gpt2(name="gpt2_124m", **kwargs):

@@ -1,0 +1,205 @@
+"""Mixture-of-Experts layers with expert parallelism over the ``ep`` mesh
+axis.
+
+Capability add over the reference (SURVEY.md §2.4: "EP/MoE: none" in
+MXNet).  TPU-first design: experts live as stacked (E, ...) parameters
+annotated with the "expert" logical axis (sharded over ``ep`` by the
+default rules), and routing is the dense GShard/Switch dispatch — one-hot
+dispatch/combine einsums with a fixed per-expert capacity so every shape
+is static and every FLOP lands on the MXU.  XLA turns the expert einsums
+into per-shard grouped matmuls with an all-to-all across ``ep``.
+
+Router aux losses (load-balancing) are recorded into an ambient collector
+during forward; loss functions drain it via :func:`pop_aux_losses`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel as _par
+from ..gluon.block import HybridBlock
+from ..ndarray.ops import invoke
+from ..parallel.sharding import annotate
+
+__all__ = ["MoELayer", "MoETransformerBlock", "pop_aux_losses",
+           "aux_loss_scope"]
+
+from .. import base as _base
+
+_WARNED_CACHED = False
+
+
+def pop_aux_losses():
+    """Drain and return the aux losses recorded since the last pop
+    (scalar NDArrays; empty list if no MoE layer ran)."""
+    return _base.pop_aux_losses()
+
+
+class aux_loss_scope:
+    """Context manager guaranteeing a clean aux-loss slate (used by
+    training loops that may abandon traces)."""
+
+    def __enter__(self):
+        _base.pop_aux_losses()
+        return self
+
+    def __exit__(self, *a):
+        _base.pop_aux_losses()
+
+
+def _moe_ffn(x, wg, w1, b1, w2, b2, *, num_experts, top_k, capacity,
+             activation):
+    """Pure-jax GShard dispatch; x (B, T, D) → (y (B, T, D), aux scalar)."""
+    b, t, d = x.shape
+    e, c = num_experts, capacity
+    n = b * t
+    xf = x.reshape(n, d)
+    logits = jnp.einsum("nd,ed->ne", xf.astype(jnp.float32),
+                        wg.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)              # (N, E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)    # (N, k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    combine = jnp.zeros((n, e, c), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)
+    for j in range(top_k):
+        m = jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.float32)  # (N, E)
+        pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]
+        keep = (pos < c) * m                              # (N, E)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), c,
+                              dtype=jnp.float32)          # (N, E, C)
+        combine = combine + gate_vals[:, j, None, None] * \
+            keep[:, :, None] * slot
+        counts = counts + jnp.sum(m, axis=0)
+    dispatch = (combine > 0).astype(xf.dtype)             # (N, E, C)
+
+    x_e = jnp.einsum("nec,nd->ecd", dispatch, xf)
+    x_e = _par.with_sharding_constraint(x_e, "expert", None, None)
+    h = jnp.einsum("ecd,edh->ech", x_e, w1,
+                   preferred_element_type=jnp.float32) + b1[:, None, :]
+    h = activation(h).astype(xf.dtype)
+    h = _par.with_sharding_constraint(h, "expert", None, "mlp")
+    y_e = jnp.einsum("ech,ehd->ecd", h, w2,
+                     preferred_element_type=jnp.float32) + b2[:, None, :]
+    y_e = _par.with_sharding_constraint(y_e, "expert", None, None)
+    y = jnp.einsum("nec,ecd->nd", combine, y_e.astype(jnp.float32))
+
+    # GShard load-balance loss: E * Σ_e (token fraction)·(mean router prob)
+    top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    frac = jnp.mean(top1, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return y.reshape(b, t, d).astype(x.dtype), aux
+
+
+class MoELayer(HybridBlock):
+    """Top-k routed expert FFN (drop-in for PositionwiseFFN).
+
+    Parameters are stacked over the expert dim and annotated "expert" so
+    the default sharding rules place them over the ``ep`` mesh axis.
+    """
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25, activation="gelu", dropout=0.0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        from ..gluon.nn import Dropout
+        self.dropout = Dropout(dropout) if dropout else None
+        self._units = units
+        self._hidden = hidden_size
+        self._num_experts = num_experts
+        self._top_k = min(top_k, num_experts)
+        self._capacity_factor = capacity_factor
+        self._act_name = activation
+        self.gate = self.params.get(
+            "gate", shape=(num_experts, units), dtype=dtype,
+            init="xavier", allow_deferred_init=True)
+        annotate(self.gate, None, "embed")
+        self.w1 = self.params.get(
+            "w1", shape=(num_experts, units, hidden_size), dtype=dtype,
+            init="xavier", allow_deferred_init=True)
+        annotate(self.w1, "expert", "embed", "mlp")
+        self.b1 = self.params.get(
+            "b1", shape=(num_experts, hidden_size), dtype=dtype,
+            init="zeros", allow_deferred_init=True)
+        annotate(self.b1, "expert", "mlp")
+        self.w2 = self.params.get(
+            "w2", shape=(num_experts, hidden_size, units), dtype=dtype,
+            init="xavier", allow_deferred_init=True)
+        annotate(self.w2, "expert", "mlp", "embed")
+        self.b2 = self.params.get(
+            "b2", shape=(num_experts, units), dtype=dtype,
+            init="zeros", allow_deferred_init=True)
+        annotate(self.b2, "expert", "embed")
+
+    def capacity(self, n_tokens: int) -> int:
+        cap = int(math.ceil(self._top_k * n_tokens / self._num_experts
+                            * self._capacity_factor))
+        return max(cap, self._top_k)
+
+    def forward(self, x):
+        b, t = x.shape[0], x.shape[1]
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[self._act_name]
+
+        def f(xv, wg, w1, b1, w2, b2):
+            return _moe_ffn(
+                xv, wg, w1, b1, w2, b2, num_experts=self._num_experts,
+                top_k=self._top_k, capacity=self.capacity(b * t),
+                activation=act)
+
+        y, aux = invoke("moe_ffn", f,
+                        [x, self.gate.data(), self.w1.data(),
+                         self.b1.data(), self.w2.data(), self.b2.data()])
+        # record only when a loss will drain it within the same tape/trace:
+        # eager autograd recording, or a trace whose owner opened an
+        # aux-collection scope (ShardedTrainer).  Tracers outside such a
+        # scope (e.g. a CachedOp forward whose loss runs eagerly) must NOT
+        # be recorded — they would leak out of their trace.
+        traced = isinstance(aux.jax, jax.core.Tracer)
+        if traced and _base.aux_collection_active():
+            _base.record_aux_loss(aux)
+        elif not traced and _base.is_recording():
+            _base.record_aux_loss(aux)   # NDArray, autograd node intact
+        elif traced:
+            global _WARNED_CACHED
+            if not _WARNED_CACHED:
+                import logging
+                logging.warning(
+                    "MoE router aux loss is dropped under hybridize()/"
+                    "CachedOp (the loss runs outside the cached trace); "
+                    "train MoE models imperatively or with "
+                    "parallel.ShardedTrainer to include it")
+                _WARNED_CACHED = True
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return y
+
+
+class MoETransformerBlock(HybridBlock):
+    """Pre-LN transformer layer whose FFN is a routed MoE."""
+
+    def __init__(self, units, hidden_size, num_heads, num_experts,
+                 top_k=2, capacity_factor=1.25, dropout=0.0,
+                 attention_dropout=0.0, causal=True, layer_norm_eps=1e-5,
+                 **kwargs):
+        super().__init__(**kwargs)
+        from ..gluon.nn import LayerNorm
+        from .transformer import MultiHeadAttention
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.attn = MultiHeadAttention(
+            units, num_heads, dropout=dropout,
+            attention_dropout=attention_dropout, causal=causal)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.moe = MoELayer(units, hidden_size, num_experts, top_k=top_k,
+                            capacity_factor=capacity_factor,
+                            dropout=dropout)
+
+    def forward(self, x, mask=None):
+        x = x + self.attn(self.ln1(x), mask)
+        x = _par.with_sharding_constraint(x, "batch", "seq", None)
+        x = x + self.moe(self.ln2(x))
+        return _par.with_sharding_constraint(x, "batch", "seq", None)

@@ -1,0 +1,164 @@
+"""Stacked-layer GPT-2 for pipeline parallelism and O(1)-depth compiles.
+
+TPU-first trunk representation (no reference analogue — MXNet has no PP,
+SURVEY.md §2.4): all transformer layers live as ONE set of parameters
+with a leading ``layers`` dim.  Single-stage execution is a
+``lax.scan`` over layers (compile time independent of depth, with
+``jax.checkpoint`` rematerialization per layer); under a mesh with
+``pp > 1`` the stack splits into contiguous stages and runs the GPipe
+schedule from :mod:`mxnet_tpu.parallel.pipeline` (microbatches ride the
+ICI ring between stages).  Composes with dp (batch) sharding; tensor/
+sequence parallelism use the per-layer (non-stacked) GPT2Model, whose
+GSPMD path shards heads/sequence.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import parallel as _par
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Embedding
+from ..ndarray.ops import invoke
+from ..parallel.sharding import annotate
+
+__all__ = ["StackedGPT2Model", "get_stacked_gpt2"]
+
+
+def _ln(x, g, b, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + eps) * g + b
+
+
+class StackedGPT2Model(HybridBlock):
+    """Decoder-only LM with a scanned/pipelined trunk.
+
+    tokens (B, T) int32 → logits (B, T, vocab).  Weights are stacked
+    (num_layers, ...) and annotated with the "layers" logical axis
+    ("layers" → pp in the default sharding rules).
+    """
+
+    def __init__(self, vocab_size=50257, units=768, num_layers=12,
+                 num_heads=12, max_length=1024, layer_norm_eps=1e-5,
+                 num_microbatches=None, remat=True, dtype="float32",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError("units % num_heads != 0")
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self._units = units
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._eps = layer_norm_eps
+        self._num_microbatches = num_microbatches
+        self._remat = remat
+        self.wte = Embedding(vocab_size, units, dtype=dtype)
+        annotate(self.wte.weight, "vocab", "embed")
+        self.wpe = Embedding(max_length, units, dtype=dtype)
+        annotate(self.wpe.weight, "seq", "embed")
+
+        l, d, h4 = num_layers, units, 4 * units
+        g = self.params.get
+
+        def p(name, shape, init):
+            prm = g(name, shape=shape, dtype=dtype, init=init,
+                    allow_deferred_init=True)
+            annotate(prm, *( ("layers",) + (None,) * (len(shape) - 1) ))
+            return prm
+
+        self.ln1_g = p("ln1_gamma", (l, d), "ones")
+        self.ln1_b = p("ln1_beta", (l, d), "zeros")
+        self.wqkv = p("wqkv", (l, d, 3 * d), "xavier")
+        self.bqkv = p("bqkv", (l, 3 * d), "zeros")
+        self.wo = p("wo", (l, d, d), "xavier")
+        self.bo = p("bo", (l, d), "zeros")
+        self.ln2_g = p("ln2_gamma", (l, d), "ones")
+        self.ln2_b = p("ln2_beta", (l, d), "zeros")
+        self.w1 = p("w1", (l, d, h4), "xavier")
+        self.b1 = p("b1", (l, h4), "zeros")
+        self.w2 = p("w2", (l, h4, d), "xavier")
+        self.b2 = p("b2", (l, d), "zeros")
+        self.lnf_g = g("lnf_gamma", shape=(d,), dtype=dtype, init="ones")
+        self.lnf_b = g("lnf_beta", shape=(d,), dtype=dtype, init="zeros")
+        self._stacked = [self.ln1_g, self.ln1_b, self.wqkv, self.bqkv,
+                         self.wo, self.bo, self.ln2_g, self.ln2_b,
+                         self.w1, self.b1, self.w2, self.b2]
+
+    # ------------------------------------------------------------------
+    def _layer(self, p, x):
+        from ..ops.attention import flash_attention
+        (l1g, l1b, wqkv, bqkv, wo, bo, l2g, l2b, w1, b1, w2, b2) = p
+        bsz, t, d = x.shape
+        h = self._num_heads
+        hn = _ln(x, l1g, l1b, self._eps)
+        qkv = jnp.einsum("btd,de->bte", hn, wqkv) + bqkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(bsz, t, h, d // h)
+        k = k.reshape(bsz, t, h, d // h)
+        v = v.reshape(bsz, t, h, d // h)
+        a = flash_attention(q, k, v, causal=True).reshape(bsz, t, d)
+        x = x + jnp.einsum("btd,de->bte", a, wo) + bo
+        hn = _ln(x, l2g, l2b, self._eps)
+        ff = jax.nn.gelu(jnp.einsum("btd,dh->bth", hn, w1) + b1)
+        x = x + jnp.einsum("bth,hd->btd", ff, w2) + b2
+        return _par.with_sharding_constraint(x, "batch", None, None)
+
+    def forward(self, tokens):
+        from ..ndarray import ops as F
+        mesh = _par.current_mesh()
+        pp = _par.axis_size(mesh, "pp") if mesh is not None else 1
+        layer = self._layer
+        if self._remat:
+            layer = jax.checkpoint(layer)
+        nl = self._num_layers
+        if nl % max(pp, 1):
+            raise ValueError(f"{nl} layers not divisible by pp={pp}")
+
+        pos = F.arange_like(tokens, axis=1).astype("int32")
+        x_nd = self.wte(tokens) + self.wpe(pos)
+
+        def trunk(xv, *leaves):
+            def scan_layers(stack, xx):
+                def body(carry, sl):
+                    return layer(sl, carry), None
+                out, _ = jax.lax.scan(body, xx, stack)
+                return out
+
+            if pp > 1:
+                from ..parallel.pipeline import gpipe
+                stages = tuple(
+                    lv.reshape(pp, nl // pp, *lv.shape[1:])
+                    for lv in leaves)
+                local_b = xv.shape[0] // max(_par.axis_size(mesh, "dp"), 1)
+                if self._num_microbatches is not None:
+                    # explicit request is honored verbatim — gpipe raises
+                    # if it doesn't divide the per-dp-shard batch
+                    m = self._num_microbatches
+                else:
+                    m = max(2 * pp, 2)
+                    while local_b % m:  # largest feasible default
+                        m -= 1
+                return gpipe(scan_layers, stages, xv,
+                             num_microbatches=m, mesh=mesh)
+            return scan_layers(tuple(leaves), xv)
+
+        x_nd = invoke("stacked_gpt2_trunk", trunk,
+                      [x_nd] + [s.data() for s in self._stacked])
+        x_nd = invoke(
+            "final_ln",
+            lambda xv, gv, bv: _ln(xv, gv, bv, self._eps),
+            [x_nd, self.lnf_g.data(), self.lnf_b.data()])
+        logits = F.FullyConnected(x_nd, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return _par.with_sharding_constraint(logits, "batch", None, "vocab")
+
+
+def get_stacked_gpt2(name="gpt2_124m", **kwargs):
+    from .gpt2 import _CONFIGS
+    layers, units, heads = _CONFIGS[name]
+    cfg = dict(units=units, num_layers=layers, num_heads=heads)
+    cfg.update(kwargs)
+    return StackedGPT2Model(**cfg)

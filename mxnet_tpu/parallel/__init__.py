@@ -8,6 +8,7 @@ XLA collectives inserted by GSPMD; plus the strategies MXNet never had
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .mesh import AXES, axis_size, current_mesh, make_mesh, use_mesh
+from .pipeline import gpipe
 from .sharding import (DEFAULT_RULES, ShardingRules, annotate, batch_spec,
                        logical_axes_of, param_sharding, shard_params)
 from .trainer import ShardedTrainer
@@ -15,8 +16,8 @@ from .trainer import ShardedTrainer
 __all__ = [
     "AXES", "Mesh", "NamedSharding", "PartitionSpec", "ShardingRules",
     "ShardedTrainer", "annotate", "axis_size", "batch_spec", "current_mesh",
-    "logical_axes_of", "make_mesh", "param_sharding", "shard_params",
-    "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",
+    "gpipe", "logical_axes_of", "make_mesh", "param_sharding",
+    "shard_params", "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",
 ]
 
 
@@ -38,6 +39,17 @@ def with_sharding_constraint(x, *logical_axes, mesh=None, rules=None):
         return x  # eager: layout hints only matter under GSPMD tracing
     rules = rules or ShardingRules()
     spec = rules.spec(logical_axes)
+    # inside a shard_map body the manual axes are already local — drop
+    # them from the constraint (constraining on a manual axis is an error)
+    try:
+        manual = set(_jax.sharding.get_abstract_mesh().manual_axes)
+    except Exception:
+        manual = set()
+    if manual:
+        spec = PartitionSpec(
+            *[None if (a in manual) else a for a in spec])
+        if all(a is None for a in spec):
+            return x
     out = _jax.lax.with_sharding_constraint(
         val, NamedSharding(mesh, spec))
     return _ND(out) if isinstance(x, _ND) else out

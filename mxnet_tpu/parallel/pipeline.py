@@ -1,0 +1,101 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+Capability add over the reference (SURVEY.md §2.4: "PP: none" — MXNet's
+only model parallelism was manual ``group2ctx`` device placement with
+executor-inserted copies).  TPU-first design: the model's repeated trunk
+is expressed as *stacked* per-layer parameters (leading dim = layers);
+under ``pp`` the stack splits into contiguous stages, each device runs its
+stage inside ``shard_map``, and microbatches flow stage-to-stage through
+``jax.lax.ppermute`` (XLA lowers to ICI neighbor sends).  The schedule is
+a ``lax.scan`` over ``M + P - 1`` ticks — one stage application per tick
+per device — so utilization is the standard GPipe M/(M+P-1) and the
+backward pass (derived by AD through scan+ppermute) is the reverse
+pipeline.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .mesh import axis_size, current_mesh
+
+__all__ = ["gpipe"]
+
+
+def _stage_slice(tree):
+    return jax.tree_util.tree_map(lambda a: a[0], tree)
+
+
+def gpipe(stage_fn: Callable[[Any, jax.Array], jax.Array], params, x,
+          *, num_microbatches: int, mesh=None, axis: str = "pp",
+          batch_axis: str = "dp"):
+    """Run ``x`` through ``P`` pipeline stages with GPipe microbatching.
+
+    stage_fn(stage_params, x_mb) -> y_mb, same shape as ``x_mb``.
+    ``params``: pytree whose leaves all have leading dim ``P`` (stage
+    count = size of the ``axis`` mesh axis); stage ``i`` uses leaf[i].
+    ``x``: (B, ...) with B divisible by num_microbatches (and the
+    microbatch count should be >= P for reasonable utilization).
+    Batch stays sharded over ``batch_axis`` so dp x pp compose.
+    """
+    mesh = mesh or current_mesh()
+    p = axis_size(mesh, axis) if mesh is not None else 1
+    if p == 1:
+        return stage_fn(_stage_slice(params), x)
+    m = num_microbatches
+    b = x.shape[0]
+    dpn = axis_size(mesh, batch_axis)
+    if b % dpn or (b // dpn) % m:
+        raise ValueError(
+            f"per-{batch_axis}-shard batch {b}//{dpn} must be divisible "
+            f"by num_microbatches={m}")
+
+    def body(params, xl):
+        stage = jax.lax.axis_index(axis)
+        local = _stage_slice(params)
+        bl = xl.shape[0]
+        micro = xl.reshape(m, bl // m, *xl.shape[1:])
+        outs0 = jnp.zeros_like(micro)
+        recv0 = jnp.zeros_like(micro[0])
+        perm = [(i, i + 1) for i in range(p - 1)]
+
+        def tick(carry, step):
+            recv, outs = carry
+            mb = jnp.clip(step, 0, m - 1)
+            x_in = jnp.where(
+                stage == 0,
+                jax.lax.dynamic_index_in_dim(micro, mb, 0, keepdims=False),
+                recv)
+            y = stage_fn(local, x_in)
+            out_idx = jnp.clip(step - (p - 1), 0, m - 1)
+            valid = (stage == p - 1) & (step >= p - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, out_idx, 0,
+                                               keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), out_idx, 0)
+            send = jax.lax.ppermute(y, axis, perm)
+            return (send, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (recv0, outs0),
+                                    jnp.arange(m + p - 1))
+        # only the last stage holds real outputs; broadcast over the ring
+        outs = jax.lax.psum(
+            jnp.where(stage == p - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs.reshape(xl.shape)
+
+    in_spec_p = jax.tree_util.tree_map(lambda _: P(axis), params)
+    x_spec = P(batch_axis, *([None] * (x.ndim - 1)))
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=(in_spec_p, x_spec), out_specs=x_spec,
+                      check_vma=False)
+    if not isinstance(x, jax.core.Tracer):
+        from jax.sharding import NamedSharding
+        params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))),
+            params)
+        x = jax.device_put(x, NamedSharding(mesh, x_spec))
+    return f(params, x)

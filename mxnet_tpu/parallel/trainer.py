@@ -214,22 +214,34 @@ class ShardedTrainer:
                 labels = [NDArray(v) for v in batch_vals[n_data:]]
 
                 def forward(pvals):
-                    with swap_values([p._data for _, p in trainable], pvals):
-                        with _base.training_mode(True):
-                            rec = _base.set_recording(False)
-                            try:
-                                out = net.forward(*data)
-                            finally:
-                                _base.set_recording(rec)
-                        if loss_fn is not None:
-                            l = loss_fn(out, *labels)
-                        else:
-                            l = out
-                        lval = l.jax if isinstance(l, NDArray) else l
-                        lval = jnp.mean(lval)
-                        new_aux = tuple(
-                            p._data._data for _, p in aux)
-                        return lval, new_aux
+                    _base.pop_aux_losses()   # discard stale entries (e.g.
+                    # from the eager shape-settling forward) so the loss
+                    # only sums aux losses of THIS trace
+                    # loss runs inside this same trace → tracers may be
+                    # collected (MoE router aux losses)
+                    aux_prev = _base.set_aux_collection(True)
+                    try:
+                        with swap_values([p._data for _, p in trainable],
+                                         pvals):
+                            with _base.training_mode(True):
+                                rec = _base.set_recording(False)
+                                try:
+                                    out = net.forward(*data)
+                                finally:
+                                    _base.set_recording(rec)
+                            if loss_fn is not None:
+                                l = loss_fn(out, *labels)
+                            else:
+                                l = out
+                            lval = l.jax if isinstance(l, NDArray) else l
+                            lval = jnp.mean(lval)
+                            new_aux = tuple(
+                                p._data._data for _, p in aux)
+                            return lval, new_aux
+                    finally:
+                        _base.set_aux_collection(aux_prev)
+                        _base.pop_aux_losses()  # nothing may outlive the
+                        # trace, drained or not
 
                 (loss_val, new_aux), grads = jax.value_and_grad(
                     forward, has_aux=True)(tuple(param_vals))

@@ -1,0 +1,165 @@
+"""MoE (expert parallelism) and GPipe (pipeline parallelism) tests.
+
+Both are capability adds over the reference (SURVEY.md §2.4: "PP: none.
+EP/MoE: none" in MXNet).  Runs on the 8-virtual-device CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, parallel as par
+from mxnet_tpu.models import (MoELayer, get_gpt2, get_stacked_gpt2,
+                              gpt2_lm_loss, pop_aux_losses)
+from mxnet_tpu.parallel.pipeline import gpipe
+
+
+# ------------------------------------------------------------------- MoE
+
+def test_moe_full_topk_equals_dense_mixture():
+    """top_k == E with ample capacity reduces exactly to the softmax-
+    weighted mixture of all experts — closed-form check of the dispatch/
+    combine einsum machinery."""
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.randn(2, 8, 16).astype("float32"))
+    moe = MoELayer(16, 32, num_experts=4, top_k=4, capacity_factor=8.0)
+    moe.initialize()
+    y = moe(x).asnumpy()
+
+    wg = moe.gate.data().asnumpy()
+    w1, b1 = moe.w1.data().asnumpy(), moe.b1.data().asnumpy()
+    w2, b2 = moe.w2.data().asnumpy(), moe.b2.data().asnumpy()
+    xf = x.asnumpy().reshape(-1, 16)
+    logits = xf @ wg.T
+    probs = onp.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    h = onp.asarray(jax.nn.gelu(
+        jnp.asarray(onp.einsum("nd,edh->neh", xf, w1) + b1[None])))
+    ye = onp.einsum("neh,ehd->ned", h, w2) + b2[None]
+    ref = onp.einsum("ne,ned->nd", probs, ye).reshape(2, 8, 16)
+    onp.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    """With capacity far below demand some tokens get zero expert output
+    (the GShard drop semantics) — outputs stay finite."""
+    rs = onp.random.RandomState(1)
+    x = nd.array(rs.randn(1, 32, 8).astype("float32"))
+    moe = MoELayer(8, 16, num_experts=2, top_k=1, capacity_factor=0.25)
+    moe.initialize()
+    y = moe(x).asnumpy()
+    assert onp.isfinite(y).all()
+    # at least one token row must be exactly zero (dropped)
+    assert (onp.abs(y.reshape(32, 8)).sum(-1) == 0).any()
+
+
+def test_moe_eager_autograd_router_grads():
+    rs = onp.random.RandomState(2)
+    x = nd.array(rs.randn(2, 8, 16).astype("float32"))
+    moe = MoELayer(16, 32, num_experts=4, top_k=2)
+    moe.initialize()
+    with autograd.record():
+        out = moe(x)
+        aux = pop_aux_losses()
+        loss = (out ** 2).mean() + 0.01 * aux[0]
+    loss.backward()
+    assert onp.abs(moe.gate.grad().asnumpy()).sum() > 0
+    assert onp.abs(moe.w1.grad().asnumpy()).sum() > 0
+
+
+def test_moe_gpt2_ep_sharded_training():
+    mesh = par.make_mesh(dp=2, ep=2, tp=2)
+    net = get_gpt2("gpt2_124m", vocab_size=128, units=32, num_layers=2,
+                   num_heads=4, max_length=64, dropout=0.0,
+                   num_experts=4, moe_every=2, moe_top_k=2)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    toks = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    labels = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                optimizer_params={"learning_rate": 1e-2},
+                                mesh=mesh)
+        first = float(tr.step(toks, labels).asnumpy())
+        for _ in range(8):
+            last = float(tr.step(toks, labels).asnumpy())
+    assert last < first
+    assert "ep" in str(net.blocks[1].moe.w1.data().jax.sharding.spec)
+
+
+# ---------------------------------------------------------------- pipeline
+
+def _mlp_stage(p, x):
+    w, b = p
+    return jnp.tanh(x @ w + b)
+
+
+def test_gpipe_matches_sequential():
+    rs = onp.random.RandomState(0)
+    p_, d = 4, 16
+    ws = jnp.asarray(rs.randn(p_, d, d) * 0.3, jnp.float32)
+    bs = jnp.asarray(rs.randn(p_, d) * 0.1, jnp.float32)
+    x = jnp.asarray(rs.randn(8, d), jnp.float32)
+
+    def ref(ws, bs, x):
+        for i in range(p_):
+            x = _mlp_stage((ws[i], bs[i]), x)
+        return x
+
+    mesh = par.make_mesh(dp=2, pp=4)
+    with par.use_mesh(mesh):
+        out = gpipe(_mlp_stage, (ws, bs), x, num_microbatches=4)
+        onp.testing.assert_allclose(onp.asarray(out),
+                                    onp.asarray(ref(ws, bs, x)),
+                                    rtol=1e-5, atol=1e-5)
+        gp = jax.grad(lambda w, b, x: jnp.sum(
+            gpipe(_mlp_stage, (w, b), x, num_microbatches=4) ** 2),
+            argnums=(0, 1, 2))(ws, bs, x)
+    gr = jax.grad(lambda w, b, x: jnp.sum(ref(w, b, x) ** 2),
+                  argnums=(0, 1, 2))(ws, bs, x)
+    for a, r in zip(gp, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(r),
+                                    rtol=1e-4, atol=1e-4)
+
+
+def test_gpipe_rejects_bad_microbatching():
+    mesh = par.make_mesh(dp=2, pp=4)
+    ws = jnp.zeros((4, 4, 4))
+    bs = jnp.zeros((4, 4))
+    x = jnp.zeros((6, 4))
+    with par.use_mesh(mesh):
+        with pytest.raises(ValueError):
+            gpipe(_mlp_stage, (ws, bs), x, num_microbatches=4)
+
+
+def test_stacked_gpt2_pp_forward_matches_single_device():
+    rs = onp.random.RandomState(0)
+    net = get_stacked_gpt2("gpt2_124m", vocab_size=128, units=32,
+                           num_layers=4, num_heads=4, max_length=64)
+    net.initialize()
+    toks = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    base = net(toks).asnumpy()
+    mesh = par.make_mesh(dp=2, pp=4)
+    with par.use_mesh(mesh):
+        piped = net(toks).asnumpy()
+    onp.testing.assert_allclose(piped, base, rtol=1e-4, atol=1e-4)
+
+
+def test_stacked_gpt2_pp_sharded_training():
+    rs = onp.random.RandomState(0)
+    net = get_stacked_gpt2("gpt2_124m", vocab_size=128, units=32,
+                           num_layers=4, num_heads=4, max_length=64)
+    net.initialize()
+    toks = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    labels = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    mesh = par.make_mesh(dp=2, pp=4)
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                optimizer_params={"learning_rate": 1e-2},
+                                mesh=mesh)
+        first = float(tr.step(toks, labels).asnumpy())
+        for _ in range(6):
+            last = float(tr.step(toks, labels).asnumpy())
+    assert last < first
+    assert "pp" in str(net.wqkv.data().jax.sharding.spec)
