@@ -34,6 +34,11 @@ class _RecordingStateScope:
     def __enter__(self):
         if self._enter_record is not None:
             self._prev_record = _base.set_recording(self._enter_record)
+            if self._enter_record and not self._prev_record:
+                # a fresh tape begins: drop aux losses (MoE router etc.)
+                # left by an abandoned earlier step so they can't leak
+                # into this step's loss
+                _base.pop_aux_losses()
         if self._enter_train is not None:
             self._prev_train = _base.set_training(self._enter_train)
         return self

@@ -304,11 +304,30 @@ class ImageRecordIter(DataIter):
         self.shuffle = shuffle
         self.rng = onp.random.RandomState(seed)
         self.n_threads = max(1, preprocess_threads)
-        # read the record offsets once
-        if path_imgidx and os.path.exists(path_imgidx):
+        self._path = path_imgrec
+        # native C++ fast path: offset scan + threaded pread/decode/augment
+        # pipeline (parity: src/io/iter_image_recordio_2.cc); Python-side
+        # records stay unloaded.  Falls back to the pure-Python pool.
+        self._native = None
+        self._offsets = self._lengths = None
+        from .utils import native as _native_mod
+        scan = None
+        if self.data_shape[0] == 3 and _native_mod.available():
+            scan = _native_mod.scan_record_offsets(path_imgrec)
+        if scan is not None:
+            self._offsets, self._lengths = scan
+            self._native = _native_mod.NativeImagePipeline(
+                path_imgrec, self._offsets, self._lengths, self.data_shape,
+                resize=resize, rand_crop=rand_crop, rand_mirror=rand_mirror,
+                mean=self.mean.ravel(), std=self.std.ravel(), seed=seed,
+                label_width=label_width, threads=self.n_threads)
+            self._records = None
+            self._n = len(self._offsets)
+        elif path_imgidx and os.path.exists(path_imgidx):
             rec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             self._records = [rec.read_idx(k) for k in rec.keys]
             rec.close()
+            self._n = len(self._records)
         else:
             rec = MXRecordIO(path_imgrec, "r")
             self._records = []
@@ -318,7 +337,8 @@ class ImageRecordIter(DataIter):
                     break
                 self._records.append(r)
             rec.close()
-        self._order = onp.arange(len(self._records))
+            self._n = len(self._records)
+        self._order = onp.arange(self._n)
         self.reset()
 
     @property
@@ -335,6 +355,15 @@ class ImageRecordIter(DataIter):
         if self.shuffle:
             self.rng.shuffle(self._order)
         self._pos = 0
+        if self._native is not None:
+            self._native.schedule(self._order)
+
+    def _read_raw(self, i):
+        if self._records is not None:
+            return self._records[i]
+        with open(self._path, "rb") as f:
+            f.seek(self._offsets[i])
+            return f.read(int(self._lengths[i]))
 
     def _process_one(self, raw):
         header, img = self._unpack_img(raw, iscolor=1)
@@ -371,15 +400,30 @@ class ImageRecordIter(DataIter):
         return arr.astype(onp.float32), label
 
     def next(self):
-        if self._pos + self.batch_size > len(self._records):
+        if self._pos + self.batch_size > self._n:
             raise StopIteration
         idxs = self._order[self._pos:self._pos + self.batch_size]
         self._pos += self.batch_size
+        if self._native is not None:
+            data, labels, ok, n = self._native.next_batch(self.batch_size)
+            assert n == self.batch_size
+            if not ok.all():
+                # rare non-JPEG/corrupt records: re-decode in Python
+                for j in onp.nonzero(~ok)[0]:
+                    arr, lab = self._process_one(self._read_raw(idxs[j]))
+                    data[j] = arr
+                    labels[j, 0] = lab if onp.isscalar(lab) else \
+                        onp.asarray(lab).ravel()[0]
+            label = labels[:, 0] if self.label_width == 1 else labels
+            return DataBatch([nd_array(data)],
+                             [nd_array(label.astype(onp.float32))],
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
         from concurrent.futures import ThreadPoolExecutor
         if not hasattr(self, "_pool"):
             self._pool = ThreadPoolExecutor(self.n_threads)
         results = list(self._pool.map(
-            lambda i: self._process_one(self._records[i]), idxs))
+            lambda i: self._process_one(self._read_raw(i)), idxs))
         data = onp.stack([r[0] for r in results])
         label = onp.asarray([r[1] for r in results], dtype=onp.float32)
         return DataBatch([nd_array(data)], [nd_array(label)],
