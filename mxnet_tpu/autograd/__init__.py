@@ -32,13 +32,13 @@ class _RecordingStateScope:
         self._prev_train = None
 
     def __enter__(self):
+        # NOTE: entering record() must NOT clear ambient aux losses — the
+        # tape is node-based and persists across scopes, so computing the
+        # forward and the loss in separate record() blocks is legal and
+        # the MoE aux entries must survive between them.  Training loops
+        # that abandon steps should use models.moe.aux_loss_scope.
         if self._enter_record is not None:
             self._prev_record = _base.set_recording(self._enter_record)
-            if self._enter_record and not self._prev_record:
-                # a fresh tape begins: drop aux losses (MoE router etc.)
-                # left by an abandoned earlier step so they can't leak
-                # into this step's loss
-                _base.pop_aux_losses()
         if self._enter_train is not None:
             self._prev_train = _base.set_training(self._enter_train)
         return self

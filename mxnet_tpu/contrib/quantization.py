@@ -1,0 +1,326 @@
+"""INT8 quantization (parity: src/operator/quantization/*.{cc,cu} +
+python/mxnet/contrib/quantization.py, SURVEY.md §2.3).
+
+TPU-first design: int8 matmuls run on the MXU via
+``lax.dot_general(..., preferred_element_type=int32)`` — the TPU analogue
+of the oneDNN/cuDNN int8 paths — with per-tensor scales applied as cheap
+f32 epilogues that XLA fuses.  The op surface keeps MXNet's contract
+(quantize / quantize_v2 / dequantize / requantize returning (data, min,
+max) triples), and ``quantize_net`` mirrors ``quantize_model``:
+calibrate activation ranges over a dataset (naive min/max or entropy/KL
+histogram), then swap Dense layers for int8-weight equivalents.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import base as _base
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense
+from ..ndarray import NDArray
+from ..ndarray.ops import _as_nd, invoke
+
+__all__ = ["quantize", "quantize_v2", "dequantize", "requantize",
+           "calib_entropy_threshold", "quantize_net", "QuantizedDense"]
+
+
+# ------------------------------------------------------------------- ops
+
+def _q_params(mn, mx, dtype):
+    """Symmetric int8 / affine uint8 scale-zero from a float range."""
+    if dtype == "int8":
+        scale = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)),
+                            1e-8) / 127.0
+        zero = jnp.zeros_like(scale)
+    elif dtype == "uint8":
+        scale = jnp.maximum(mx - mn, 1e-8) / 255.0
+        zero = jnp.round(-mn / scale)
+    else:
+        raise _base.MXNetError(f"unsupported quantized dtype {dtype}")
+    return scale, zero
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    """(qdata, min, max): affine-quantize with an explicit range
+    (parity: _contrib_quantize)."""
+    data, min_range, max_range = (_as_nd(x) for x in
+                                  (data, min_range, max_range))
+
+    def f(d, mn, mx):
+        scale, zero = _q_params(mn, mx, out_type)
+        lo, hi = (-127, 127) if out_type == "int8" else (0, 255)
+        q = jnp.clip(jnp.round(d / scale) + zero, lo, hi)
+        return (q.astype(jnp.int8 if out_type == "int8" else jnp.uint8),
+                mn, mx)
+
+    return invoke("quantize", f, [data, min_range, max_range],
+                  differentiable=False)
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Auto-ranging quantize (parity: _contrib_quantize_v2)."""
+    data = _as_nd(data)
+
+    def f(d):
+        if min_calib_range is not None and max_calib_range is not None:
+            mn = jnp.asarray(min_calib_range, jnp.float32)
+            mx = jnp.asarray(max_calib_range, jnp.float32)
+        else:
+            mn = jnp.min(d).astype(jnp.float32)
+            mx = jnp.max(d).astype(jnp.float32)
+        scale, zero = _q_params(mn, mx, out_type)
+        lo, hi = (-127, 127) if out_type == "int8" else (0, 255)
+        q = jnp.clip(jnp.round(d / scale) + zero, lo, hi)
+        return (q.astype(jnp.int8 if out_type == "int8" else jnp.uint8),
+                mn, mx)
+
+    return invoke("quantize_v2", f, [data], differentiable=False)
+
+
+def dequantize(qdata, min_range, max_range, out_type="float32"):
+    """Inverse of :func:`quantize` (parity: _contrib_dequantize)."""
+    qdata, min_range, max_range = (_as_nd(x) for x in
+                                   (qdata, min_range, max_range))
+    in_int8 = str(qdata.dtype) == "int8"
+
+    def f(q, mn, mx):
+        scale, zero = _q_params(mn, mx, "int8" if in_int8 else "uint8")
+        return ((q.astype(jnp.float32) - zero) * scale).astype(out_type)
+
+    return invoke("dequantize", f, [qdata, min_range, max_range],
+                  differentiable=False)
+
+
+def requantize(qdata, min_range, max_range, min_calib_range,
+               max_calib_range):
+    """int32 accum → int8 with a narrower calibrated range (parity:
+    _contrib_requantize)."""
+    qdata, min_range, max_range = (_as_nd(x) for x in
+                                   (qdata, min_range, max_range))
+
+    def f(q, mn, mx):
+        in_scale = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)),
+                               1e-8) / (2.0 ** 31 - 1)
+        cm = jnp.asarray(min_calib_range, jnp.float32)
+        cx = jnp.asarray(max_calib_range, jnp.float32)
+        out_scale, _ = _q_params(cm, cx, "int8")
+        val = q.astype(jnp.float32) * in_scale
+        out = jnp.clip(jnp.round(val / out_scale), -127, 127)
+        return out.astype(jnp.int8), cm, cx
+
+    return invoke("requantize", f, [qdata, min_range, max_range],
+                  differentiable=False)
+
+
+# ------------------------------------------------------------ calibration
+
+def calib_entropy_threshold(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| from an absolute-value histogram
+    (parity: the entropy mode of quantization.py's _LayerHistogramCollector
+    / get_optimal_threshold)."""
+    hist = onp.asarray(hist, onp.float64)
+    edges = onp.asarray(hist_edges)
+    nbins = len(hist)
+    best_kl, best_t = onp.inf, edges[-1]
+    start = max(num_quantized_bins // 2, 1)
+    for i in range(start, nbins + 1):
+        p = hist[:i].copy()
+        outliers = hist[i:].sum()
+        if p.sum() + outliers == 0:
+            continue
+        p[-1] += outliers
+        # quantize p into num_quantized_bins, then expand back
+        idx = onp.linspace(0, i, num_quantized_bins + 1).astype(int)
+        q = onp.zeros(i)
+        for j in range(num_quantized_bins):
+            lo, hi = idx[j], max(idx[j + 1], idx[j] + 1)
+            seg = hist[lo:hi]
+            nz = (seg > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(seg > 0, seg.sum() / nz, 0)
+        pm = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qm = q / qs
+        mask = pm > 0
+        kl = float((pm[mask] * onp.log(
+            pm[mask] / onp.maximum(qm[mask], 1e-12))).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, edges[i]
+    return float(best_t)
+
+
+class _Collector:
+    """Records per-layer |activation| statistics during calibration."""
+
+    def __init__(self, mode, num_bins=8001):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.minmax: Dict[str, List[float]] = {}
+        self.hists: Dict[str, onp.ndarray] = {}
+        self.hist_max: Dict[str, float] = {}
+
+    def collect(self, name, arr):
+        a = onp.asarray(arr)
+        mn, mx = float(a.min()), float(a.max())
+        if name in self.minmax:
+            self.minmax[name][0] = min(self.minmax[name][0], mn)
+            self.minmax[name][1] = max(self.minmax[name][1], mx)
+        else:
+            self.minmax[name] = [mn, mx]
+        if self.mode == "entropy":
+            amax = max(abs(mn), abs(mx), 1e-8)
+            if name not in self.hists or amax > self.hist_max[name]:
+                # re-bin on range growth (coarse but faithful)
+                old_h = self.hists.get(name)
+                old_m = self.hist_max.get(name, amax)
+                self.hist_max[name] = amax = max(amax, old_m)
+                self.hists[name] = onp.zeros(self.num_bins)
+                if old_h is not None:
+                    centers = (onp.arange(self.num_bins) + 0.5) * \
+                        old_m / self.num_bins
+                    reb, _ = onp.histogram(centers, bins=self.num_bins,
+                                           range=(0, amax), weights=old_h)
+                    self.hists[name] += reb
+            h, _ = onp.histogram(onp.abs(a), bins=self.num_bins,
+                                 range=(0, self.hist_max[name]))
+            self.hists[name] += h
+
+    def ranges(self):
+        out = {}
+        for name, (mn, mx) in self.minmax.items():
+            if self.mode == "entropy" and name in self.hists:
+                edges = onp.linspace(0, self.hist_max[name],
+                                     self.num_bins + 1)
+                t = calib_entropy_threshold(self.hists[name], edges)
+                out[name] = (-t if mn < 0 else 0.0, t)
+            else:
+                out[name] = (mn, mx)
+        return out
+
+
+# ------------------------------------------------------------ layers/net
+
+class QuantizedDense(HybridBlock):
+    """int8-weight Dense: activations quantize dynamically (or with a
+    calibrated range), the matmul accumulates in int32 on the MXU, and
+    the f32 epilogue applies scales + bias (parity:
+    _contrib_quantized_fully_connected)."""
+
+    def __init__(self, dense: Dense, min_calib=None, max_calib=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data()
+        wnp = w.asnumpy()
+        self._w_scale = float(max(abs(wnp.min()), abs(wnp.max()), 1e-8)) \
+            / 127.0
+        self._wq = onp.clip(onp.round(wnp / self._w_scale), -127,
+                            127).astype(onp.int8)
+        self._bias = dense.bias.data().asnumpy() if dense.bias is not None \
+            else None
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._activation = dense._activation
+        self._min_calib = min_calib
+        self._max_calib = max_calib
+
+    def forward(self, x):
+        x = _as_nd(x)
+        wq = jnp.asarray(self._wq)
+        w_scale = self._w_scale
+        bias = None if self._bias is None else jnp.asarray(self._bias)
+        mn, mx = self._min_calib, self._max_calib
+
+        def f(xv):
+            shape = xv.shape
+            if self._flatten and xv.ndim > 2:
+                xv = xv.reshape(shape[0], -1)
+            if mn is not None and mx is not None:
+                amax = jnp.maximum(abs(mn), abs(mx))
+            else:
+                amax = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8)
+            x_scale = amax / 127.0
+            xq = jnp.clip(jnp.round(xv / x_scale), -127, 127).astype(
+                jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wq, (((xv.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (x_scale * w_scale)
+            if bias is not None:
+                out = out + bias
+            if self._activation is not None:
+                from ..ndarray.ops import ACTIVATION_FNS
+                out = ACTIVATION_FNS[self._activation](out)
+            return out
+
+        return invoke("quantized_dense", f, [x], differentiable=False)
+
+    def __repr__(self):
+        return f"QuantizedDense({self._wq.shape[1]} -> {self._units}, int8)"
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None):
+    """Swap Dense layers of a Gluon net for int8 equivalents (parity:
+    contrib.quantization.quantize_net).
+
+    calib_mode: 'none' (dynamic activation ranges), 'naive' (min/max over
+    calib_data), 'entropy' (KL-optimal thresholds).  calib_data yields
+    input batches (NDArray or DataBatch).
+    """
+    if quantized_dtype != "int8":
+        raise _base.MXNetError("TPU build quantizes to int8 (MXU-native)")
+    exclude = set(exclude_layers or ())
+
+    targets = []   # (parent, attr_name, child_name, dense)
+
+    def walk(block, prefix=""):
+        for name, child in list(block._children.items()):
+            path = f"{prefix}{name}"
+            if isinstance(child, Dense) and path not in exclude and \
+                    child.weight._data is not None:
+                targets.append((block, name, path, child))
+            else:
+                walk(child, path + ".")
+
+    walk(net)
+
+    ranges: Dict[str, tuple] = {}
+    if calib_data is not None and calib_mode in ("naive", "entropy"):
+        collector = _Collector(calib_mode)
+        hooked = []
+        for _, _, path, dense in targets:
+            def mk(path):
+                def hook(block, inputs):
+                    collector.collect(path, inputs[0].asnumpy())
+                return hook
+            hooked.append((dense, dense.register_forward_pre_hook(mk(path))))
+        try:
+            n = 0
+            for batch in calib_data:
+                data = batch.data[0] if hasattr(batch, "data") else batch
+                net(data)
+                n += 1
+                if num_calib_batches is not None and \
+                        n >= num_calib_batches:
+                    break
+        finally:
+            for dense, h in hooked:
+                dense._forward_pre_hooks.remove(h)
+        ranges = collector.ranges()
+
+    for parent, attr, path, dense in targets:
+        r = ranges.get(path)
+        q = QuantizedDense(dense, min_calib=r[0] if r else None,
+                           max_calib=r[1] if r else None)
+        parent.register_child(q, attr)
+        if getattr(parent, attr, None) is dense:
+            setattr(parent, attr, q)
+    return net

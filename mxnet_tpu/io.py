@@ -314,6 +314,26 @@ class ImageRecordIter(DataIter):
         scan = None
         if self.data_shape[0] == 3 and _native_mod.available():
             scan = _native_mod.scan_record_offsets(path_imgrec)
+        if scan is not None and path_imgidx and os.path.exists(path_imgidx):
+            # honor the .idx sidecar (it may subset/reorder records):
+            # map each idx record-start offset to its scanned payload slot
+            offs, lens = scan
+            by_payload = {int(o): int(l) for o, l in zip(offs, lens)}
+            sel_offs, sel_lens = [], []
+            ok = True
+            with open(path_imgidx) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    payload = int(parts[1]) + 8   # skip magic+lrec header
+                    if payload not in by_payload:
+                        ok = False
+                        break
+                    sel_offs.append(payload)
+                    sel_lens.append(by_payload[payload])
+            scan = (onp.asarray(sel_offs, onp.uint64),
+                    onp.asarray(sel_lens, onp.uint64)) if ok else None
         if scan is not None:
             self._offsets, self._lengths = scan
             self._native = _native_mod.NativeImagePipeline(

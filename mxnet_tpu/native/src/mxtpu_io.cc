@@ -263,6 +263,7 @@ int64_t mxio_writer_tell(void* h) {
 
 int mxio_writer_write(void* h, const uint8_t* data, uint64_t len) {
   FILE* f = static_cast<Writer*>(h)->f;
+  if (len > kLenMask) return -1;   // 29-bit length field; never truncate
   uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
   if (fwrite(hdr, 4, 2, f) != 2) return -1;
   if (len && fwrite(data, 1, len, f) != len) return -1;

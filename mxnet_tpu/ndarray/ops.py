@@ -830,15 +830,19 @@ def softmax_cross_entropy(data, label):
     return invoke("softmax_cross_entropy", f, [data, label])
 
 
+ACTIVATION_FNS = {
+    "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign, "log_sigmoid": jax.nn.log_sigmoid,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "gelu": jax.nn.gelu, "silu": jax.nn.silu}
+
+
 @_export
 def Activation(data, act_type="relu", **kw):
     data = _as_nd(data)
-    fn = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
-          "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
-          "softsign": jax.nn.soft_sign, "log_sigmoid": jax.nn.log_sigmoid,
-          "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
-          "gelu": jax.nn.gelu, "silu": jax.nn.silu}[act_type]
-    return invoke(f"activation_{act_type}", fn, [data])
+    return invoke(f"activation_{act_type}", ACTIVATION_FNS[act_type],
+                  [data])
 
 
 @_export

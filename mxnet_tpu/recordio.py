@@ -81,6 +81,10 @@ class MXRecordIO:
         if not self.writable:
             raise _base.MXNetError("not opened for writing")
         n = len(buf)
+        if n > _LEN_MASK:
+            raise _base.MXNetError(
+                f"record of {n} bytes exceeds the 29-bit RecordIO length "
+                "field (dmlc framing)")
         self.handle.write(struct.pack("<II", _kMagic, n & _LEN_MASK))
         self.handle.write(buf)
         pad = (4 - (n & 3)) & 3

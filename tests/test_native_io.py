@@ -142,3 +142,31 @@ def test_native_pipeline_flags_bad_records(tmp_path):
     assert ok[0] and not ok[1]          # png is python-fallback territory
     assert labels[0, 0] == 1.0
     pipe.close()
+
+
+def test_image_record_iter_honors_idx_subset(tmp_path):
+    """A .idx sidecar that subsets/reorders records must be honored by the
+    native path exactly as by the fallback."""
+    p = str(tmp_path / "s.rec")
+    pidx = str(tmp_path / "s.idx")
+    rs = onp.random.RandomState(0)
+    wr = rio.MXIndexedRecordIO(pidx, p, "w")
+    for i in range(12):
+        img = rs.randint(0, 255, (40, 40, 3), dtype=onp.uint8)
+        wr.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img))
+    wr.close()
+    # keep only every third record, reversed
+    keys = list(range(0, 12, 3))[::-1]
+    idx_map = {}
+    with open(pidx) as f:
+        for line in f:
+            k, o = line.split("\t")
+            idx_map[int(k)] = int(o)
+    with open(pidx, "w") as f:
+        for k in keys:
+            f.write(f"{k}\t{idx_map[k]}\n")
+    it = ImageRecordIter(path_imgrec=p, path_imgidx=pidx,
+                         data_shape=(3, 32, 32), batch_size=4)
+    assert it._native is not None
+    b = next(iter(it))
+    assert b.label[0].asnumpy().tolist() == [9.0, 6.0, 3.0, 0.0]

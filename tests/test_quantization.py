@@ -1,0 +1,115 @@
+"""INT8 quantization (parity: src/operator/quantization/* +
+python/mxnet/contrib/quantization.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.contrib.quantization import (QuantizedDense,
+                                            calib_entropy_threshold,
+                                            dequantize, quantize,
+                                            quantize_net, quantize_v2,
+                                            requantize)
+from mxnet_tpu.gluon import nn
+
+
+def test_quantize_dequantize_roundtrip():
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.randn(4, 8).astype("float32") * 3)
+    q, mn, mx_ = quantize(x, nd.array([-10.0]), nd.array([10.0]))
+    assert str(q.dtype) == "int8"
+    back = dequantize(q, mn, mx_)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=10.0 / 127 + 1e-6)
+
+
+def test_quantize_v2_auto_range():
+    rs = onp.random.RandomState(1)
+    x = nd.array(rs.uniform(-2, 5, (16,)).astype("float32"))
+    q, mn, mx_ = quantize_v2(x)
+    assert float(mn.asnumpy()) == pytest.approx(float(x.asnumpy().min()))
+    assert float(mx_.asnumpy()) == pytest.approx(float(x.asnumpy().max()))
+    back = dequantize(q, mn, mx_)
+    scale = max(abs(float(mn.asnumpy())), abs(float(mx_.asnumpy()))) / 127
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=scale + 1e-6)
+
+
+def test_quantize_uint8():
+    x = nd.array(onp.linspace(0, 4, 9).astype("float32"))
+    q, mn, mx_ = quantize_v2(x, out_type="uint8")
+    assert str(q.dtype) == "uint8"
+    back = dequantize(q, mn, mx_)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=4 / 255)
+
+
+def test_requantize_int32_to_int8():
+    # int32 accumulators with a wide nominal range, recalibrated narrow
+    acc = nd.array(onp.array([1 << 20, -(1 << 21), 1 << 19]), dtype="int32")
+    full = float(1 << 22)
+    q, mn, mx_ = requantize(acc, nd.array([-full]), nd.array([full]),
+                            min_calib_range=-(full / (1 << 10)),
+                            max_calib_range=full / (1 << 10))
+    assert str(q.dtype) == "int8"
+    vals = q.asnumpy().astype(float)
+    assert vals[0] > 0 and vals[1] == -127 and vals[2] > 0
+
+
+def test_entropy_threshold_clips_outliers():
+    rs = onp.random.RandomState(0)
+    a = onp.abs(onp.concatenate([rs.randn(100000) * 0.5, [50.0]]))
+    hist, edges = onp.histogram(a, bins=2001, range=(0, 50.0))
+    t = calib_entropy_threshold(hist, edges)
+    assert t < 10.0  # the lone 50.0 outlier must not dominate the range
+
+
+def test_quantized_dense_matches_fp32():
+    rs = onp.random.RandomState(2)
+    dense = nn.Dense(32, in_units=64, use_bias=True)
+    dense.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(8, 64).astype("float32"))
+    ref = dense(x).asnumpy()
+    qd = QuantizedDense(dense)
+    out = qd(x).asnumpy()
+    # int8 matmul: relative error bounded by quantization steps
+    denom = onp.abs(ref).max()
+    assert onp.abs(out - ref).max() / denom < 0.05
+
+
+def test_quantize_net_swaps_dense_and_stays_accurate():
+    rs = onp.random.RandomState(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu", in_units=32),
+            nn.Dense(10, in_units=64))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(16, 32).astype("float32"))
+    ref = net(x).asnumpy()
+
+    calib = [nd.array(rs.randn(16, 32).astype("float32")) for _ in range(4)]
+    qnet = quantize_net(net, calib_data=calib + [x], calib_mode="naive")
+    reprs = [repr(c) for c in qnet]
+    assert all("QuantizedDense" in r for r in reprs), reprs
+    out = qnet(x).asnumpy()
+    denom = onp.abs(ref).max()
+    assert onp.abs(out - ref).max() / denom < 0.1
+
+
+def test_quantize_net_entropy_mode():
+    rs = onp.random.RandomState(4)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.array(rs.randn(32, 8).astype("float32")) for _ in range(3)]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="entropy")
+    x = nd.array(rs.randn(4, 8).astype("float32"))
+    ref_scale = onp.abs(qnet(x).asnumpy())
+    assert onp.isfinite(ref_scale).all()
+
+
+def test_quantize_net_exclude_layers():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    qnet = quantize_net(net, exclude_layers=["0"], calib_mode="none")
+    kinds = [type(c).__name__ for c in qnet]
+    assert kinds == ["Dense", "QuantizedDense"], kinds
