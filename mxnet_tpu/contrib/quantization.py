@@ -44,6 +44,14 @@ def _q_params(mn, mx, dtype):
     return scale, zero
 
 
+def _affine_quantize(d, mn, mx, out_type):
+    """Shared quantization kernel: scale/round/clip/cast."""
+    scale, zero = _q_params(mn, mx, out_type)
+    lo, hi = (-127, 127) if out_type == "int8" else (0, 255)
+    q = jnp.clip(jnp.round(d / scale) + zero, lo, hi)
+    return q.astype(jnp.int8 if out_type == "int8" else jnp.uint8)
+
+
 def quantize(data, min_range, max_range, out_type="int8"):
     """(qdata, min, max): affine-quantize with an explicit range
     (parity: _contrib_quantize)."""
@@ -51,11 +59,7 @@ def quantize(data, min_range, max_range, out_type="int8"):
                                   (data, min_range, max_range))
 
     def f(d, mn, mx):
-        scale, zero = _q_params(mn, mx, out_type)
-        lo, hi = (-127, 127) if out_type == "int8" else (0, 255)
-        q = jnp.clip(jnp.round(d / scale) + zero, lo, hi)
-        return (q.astype(jnp.int8 if out_type == "int8" else jnp.uint8),
-                mn, mx)
+        return _affine_quantize(d, mn, mx, out_type), mn, mx
 
     return invoke("quantize", f, [data, min_range, max_range],
                   differentiable=False)
@@ -73,11 +77,7 @@ def quantize_v2(data, min_calib_range=None, max_calib_range=None,
         else:
             mn = jnp.min(d).astype(jnp.float32)
             mx = jnp.max(d).astype(jnp.float32)
-        scale, zero = _q_params(mn, mx, out_type)
-        lo, hi = (-127, 127) if out_type == "int8" else (0, 255)
-        q = jnp.clip(jnp.round(d / scale) + zero, lo, hi)
-        return (q.astype(jnp.int8 if out_type == "int8" else jnp.uint8),
-                mn, mx)
+        return _affine_quantize(d, mn, mx, out_type), mn, mx
 
     return invoke("quantize_v2", f, [data], differentiable=False)
 
@@ -126,31 +126,44 @@ def calib_entropy_threshold(hist, hist_edges, num_quantized_bins=255):
     hist = onp.asarray(hist, onp.float64)
     edges = onp.asarray(hist_edges)
     nbins = len(hist)
+    csum = onp.concatenate([[0.0], onp.cumsum(hist)])
+    total = csum[-1]
     best_kl, best_t = onp.inf, edges[-1]
     start = max(num_quantized_bins // 2, 1)
     for i in range(start, nbins + 1):
-        p = hist[:i].copy()
-        outliers = hist[i:].sum()
-        if p.sum() + outliers == 0:
+        if total == 0:
             continue
-        p[-1] += outliers
-        # quantize p into num_quantized_bins, then expand back
+        # candidate distribution p: hist[:i] with the tail folded into the
+        # last bin
+        p_last = hist[i - 1] + (total - csum[i])
+        p_sum = total
+        # quantize hist[:i] into num_quantized_bins segments (vectorized
+        # via cumsum over segment boundaries)
         idx = onp.linspace(0, i, num_quantized_bins + 1).astype(int)
-        q = onp.zeros(i)
-        for j in range(num_quantized_bins):
-            lo, hi = idx[j], max(idx[j + 1], idx[j] + 1)
-            seg = hist[lo:hi]
-            nz = (seg > 0).sum()
-            if nz:
-                q[lo:hi] = onp.where(seg > 0, seg.sum() / nz, 0)
-        pm = p / p.sum()
+        idx_hi = onp.maximum(idx[1:], idx[:-1] + 1)
+        seg_sum = csum[onp.minimum(idx_hi, i)] - csum[idx[:-1]]
+        nz_csum = onp.concatenate([[0], onp.cumsum(hist[:i] > 0)])
+        seg_nz = nz_csum[onp.minimum(idx_hi, i)] - nz_csum[idx[:-1]]
+        # expand back: bin j of segment s gets seg_sum[s]/seg_nz[s] where
+        # hist[j] > 0
+        seg_of = onp.searchsorted(idx[1:], onp.arange(i), side="right")
+        seg_of = onp.minimum(seg_of, num_quantized_bins - 1)
+        with onp.errstate(divide="ignore", invalid="ignore"):
+            fill = onp.where(seg_nz > 0, seg_sum / onp.maximum(seg_nz, 1),
+                             0.0)
+        q = onp.where(hist[:i] > 0, fill[seg_of], 0.0)
         qs = q.sum()
         if qs == 0:
             continue
+        pm = hist[:i] / p_sum
+        pm_last = p_last / p_sum
         qm = q / qs
-        mask = pm > 0
-        kl = float((pm[mask] * onp.log(
-            pm[mask] / onp.maximum(qm[mask], 1e-12))).sum())
+        mask = hist[:i] > 0
+        pm_eff = pm.copy()
+        pm_eff[-1] = pm_last
+        mask[-1] = pm_last > 0
+        kl = float((pm_eff[mask] * onp.log(
+            pm_eff[mask] / onp.maximum(qm[mask], 1e-12))).sum())
         if kl < best_kl:
             best_kl, best_t = kl, edges[i]
     return float(best_t)
@@ -216,14 +229,31 @@ class QuantizedDense(HybridBlock):
     def __init__(self, dense: Dense, min_calib=None, max_calib=None,
                  **kwargs):
         super().__init__(**kwargs)
-        w = dense.weight.data()
-        wnp = w.asnumpy()
-        self._w_scale = float(max(abs(wnp.min()), abs(wnp.max()), 1e-8)) \
-            / 127.0
-        self._wq = onp.clip(onp.round(wnp / self._w_scale), -127,
-                            127).astype(onp.int8)
-        self._bias = dense.bias.data().asnumpy() if dense.bias is not None \
-            else None
+        from ..ndarray import array as nd_array
+        wnp = dense.weight.data().asnumpy()
+        w_scale = float(max(abs(wnp.min()), abs(wnp.max()), 1e-8)) / 127.0
+        wq = onp.clip(onp.round(wnp / w_scale), -127, 127).astype(onp.int8)
+        # int8 weights + scale are real Parameters so the quantized net
+        # checkpoints through save_parameters/load_parameters
+        self.qweight = self.params.get(
+            "qweight", shape=wq.shape, dtype="int8", init="zeros",
+            grad_req="null")
+        self.qweight.initialize()
+        self.qweight.set_data(nd_array(wq, dtype="int8"))
+        self.wscale = self.params.get(
+            "wscale", shape=(1,), dtype="float32", init="zeros",
+            grad_req="null")
+        self.wscale.initialize()
+        self.wscale.set_data(nd_array([w_scale]))
+        if dense.bias is not None:
+            bnp = dense.bias.data().asnumpy()
+            self.bias = self.params.get(
+                "bias", shape=bnp.shape, dtype="float32", init="zeros",
+                grad_req="null")
+            self.bias.initialize()
+            self.bias.set_data(nd_array(bnp))
+        else:
+            self.bias = None
         self._units = dense._units
         self._flatten = dense._flatten
         self._activation = dense._activation
@@ -232,9 +262,9 @@ class QuantizedDense(HybridBlock):
 
     def forward(self, x):
         x = _as_nd(x)
-        wq = jnp.asarray(self._wq)
-        w_scale = self._w_scale
-        bias = None if self._bias is None else jnp.asarray(self._bias)
+        wq = self.qweight.data().jax
+        w_scale = self.wscale.data().jax[0]
+        bias = None if self.bias is None else self.bias.data().jax
         mn, mx = self._min_calib, self._max_calib
 
         def f(xv):
@@ -262,7 +292,8 @@ class QuantizedDense(HybridBlock):
         return invoke("quantized_dense", f, [x], differentiable=False)
 
     def __repr__(self):
-        return f"QuantizedDense({self._wq.shape[1]} -> {self._units}, int8)"
+        return (f"QuantizedDense({self.qweight.shape[1]} -> "
+                f"{self._units}, int8)")
 
 
 def quantize_net(net, calib_data=None, calib_mode="naive",
@@ -279,18 +310,34 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
         raise _base.MXNetError("TPU build quantizes to int8 (MXU-native)")
     exclude = set(exclude_layers or ())
 
+    calib_iter = iter(calib_data) if calib_data is not None else None
+    first_batch = next(calib_iter, None) if calib_iter is not None else None
+    if first_batch is not None:
+        # settle deferred-init Dense shapes so walk() sees their weights
+        data = first_batch.data[0] if hasattr(first_batch, "data") \
+            else first_batch
+        net(data)
+
     targets = []   # (parent, attr_name, child_name, dense)
+    deferred = []
 
     def walk(block, prefix=""):
         for name, child in list(block._children.items()):
             path = f"{prefix}{name}"
-            if isinstance(child, Dense) and path not in exclude and \
-                    child.weight._data is not None:
-                targets.append((block, name, path, child))
+            if isinstance(child, Dense) and path not in exclude:
+                if child.weight._data is not None:
+                    targets.append((block, name, path, child))
+                else:
+                    deferred.append(path)
             else:
                 walk(child, path + ".")
 
     walk(net)
+    if deferred:
+        raise _base.MXNetError(
+            f"Dense layers {deferred} have uninitialized (deferred) "
+            "weights — run a forward pass or pass calib_data so "
+            "quantize_net can see their shapes")
 
     ranges: Dict[str, tuple] = {}
     if calib_data is not None and calib_mode in ("naive", "entropy"):
@@ -304,7 +351,10 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
             hooked.append((dense, dense.register_forward_pre_hook(mk(path))))
         try:
             n = 0
-            for batch in calib_data:
+            import itertools
+            for batch in itertools.chain(
+                    [first_batch] if first_batch is not None else [],
+                    calib_iter):
                 data = batch.data[0] if hasattr(batch, "data") else batch
                 net(data)
                 n += 1

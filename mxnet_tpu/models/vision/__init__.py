@@ -1,15 +1,33 @@
 """Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision)."""
+from .alexnet import AlexNet, alexnet
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201)
+from .mlp import MLP
+from .mobilenet import (MobileNet, MobileNetV2, mobilenet0_25, mobilenet0_5,
+                        mobilenet0_75, mobilenet1_0, mobilenet_v2_0_25,
+                        mobilenet_v2_0_5, mobilenet_v2_0_75,
+                        mobilenet_v2_1_0)
 from .resnet import (BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
                      ResNetV1, ResNetV2, get_resnet, resnet18_v1,
                      resnet18_v2, resnet34_v1, resnet34_v2, resnet50_v1,
                      resnet50_v2, resnet101_v1, resnet101_v2, resnet152_v1,
                      resnet152_v2)
-from .mlp import MLP
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .vgg import (VGG, get_vgg, vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16,
+                  vgg16_bn, vgg19, vgg19_bn)
 
 _models = {name: globals()[name] for name in (
     "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
     "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
-    "resnet101_v2", "resnet152_v2")}
+    "resnet101_v2", "resnet152_v2",
+    "alexnet",
+    "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+    "squeezenet1_0", "squeezenet1_1",
+    "densenet121", "densenet161", "densenet169", "densenet201",
+    "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+    "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+    "mobilenet_v2_0_25")}
 
 
 def get_model(name, **kwargs):

@@ -1,0 +1,77 @@
+"""VGG 11/13/16/19 (+BN variants) (parity:
+python/mxnet/gluon/model_zoo/vision/vgg.py — same spec tables and
+features/output split)."""
+from __future__ import annotations
+
+from ...gluon import nn
+from ...gluon.block import HybridBlock
+
+__all__ = ["VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn"]
+
+_SPEC = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        for n, f in zip(layers, filters):
+            for _ in range(n):
+                self.features.add(nn.Conv2D(f, kernel_size=3, padding=1))
+                if batch_norm:
+                    self.features.add(nn.BatchNorm())
+                self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(strides=2))
+        self.features.add(nn.Flatten(),
+                          nn.Dense(4096, activation="relu"),
+                          nn.Dropout(0.5),
+                          nn.Dense(4096, activation="relu"),
+                          nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers, **kwargs):
+    layers, filters = _SPEC[num_layers]
+    return VGG(layers, filters, **kwargs)
+
+
+def vgg11(**kw):
+    return get_vgg(11, **kw)
+
+
+def vgg13(**kw):
+    return get_vgg(13, **kw)
+
+
+def vgg16(**kw):
+    return get_vgg(16, **kw)
+
+
+def vgg19(**kw):
+    return get_vgg(19, **kw)
+
+
+def vgg11_bn(**kw):
+    return get_vgg(11, batch_norm=True, **kw)
+
+
+def vgg13_bn(**kw):
+    return get_vgg(13, batch_norm=True, **kw)
+
+
+def vgg16_bn(**kw):
+    return get_vgg(16, batch_norm=True, **kw)
+
+
+def vgg19_bn(**kw):
+    return get_vgg(19, batch_norm=True, **kw)

@@ -1,0 +1,68 @@
+"""Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision +
+tests/python/unittest/test_gluon_model_zoo.py — build every model, run a
+forward pass, check the output head).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.models import get_model
+
+# small spatial input keeps CPU runtime sane; AlexNet/VGG need >= 224-ish
+# strides, so give each family an adequate size
+_CASES = [
+    ("resnet18_v1", 64), ("resnet50_v2", 64),
+    ("alexnet", 224),
+    ("vgg11", 64), ("vgg13_bn", 64),
+    ("squeezenet1_0", 224), ("squeezenet1_1", 224),
+    ("densenet121", 64),
+    ("mobilenet1_0", 64), ("mobilenet0_25", 64),
+    ("mobilenet_v2_1_0", 64), ("mobilenet_v2_0_5", 64),
+]
+
+
+@pytest.mark.parametrize("name,size", _CASES)
+def test_model_forward(name, size):
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(0).randn(2, 3, size, size)
+                 .astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_model_zoo_registry_complete():
+    from mxnet_tpu.models.vision import _models
+    for family in ("alexnet", "vgg16", "vgg19_bn", "squeezenet1_1",
+                   "densenet201", "mobilenet0_5", "mobilenet_v2_0_75",
+                   "resnet152_v2"):
+        assert family in _models
+    with pytest.raises(ValueError):
+        get_model("resnet20_v9")
+
+
+def test_model_zoo_hybridize_matches_eager():
+    net = get_model("mobilenet_v2_0_25", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(1).randn(2, 3, 64, 64)
+                 .astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(hybrid, eager, rtol=1e-4, atol=1e-4)
+
+
+def test_model_zoo_save_load_roundtrip(tmp_path):
+    net = get_model("squeezenet1_1", classes=5)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(onp.random.RandomState(2).randn(1, 3, 224, 224)
+                 .astype("float32"))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "m.params")
+    net.save_parameters(f)
+    net2 = get_model("squeezenet1_1", classes=5)
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-5)

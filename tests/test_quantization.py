@@ -113,3 +113,46 @@ def test_quantize_net_exclude_layers():
     qnet = quantize_net(net, exclude_layers=["0"], calib_mode="none")
     kinds = [type(c).__name__ for c in qnet]
     assert kinds == ["Dense", "QuantizedDense"], kinds
+
+
+def test_quantize_net_deferred_init_with_calib():
+    """Deferred-shape Dense layers (no in_units) must still be quantized
+    when calib_data provides shapes."""
+    rs = onp.random.RandomState(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    calib = [nd.array(rs.randn(8, 12).astype("float32"))]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+    kinds = [type(c).__name__ for c in qnet]
+    assert kinds == ["QuantizedDense", "QuantizedDense"], kinds
+
+
+def test_quantize_net_deferred_init_without_calib_raises():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16))
+    net.initialize()
+    with pytest.raises(Exception):
+        quantize_net(net, calib_mode="none")
+
+
+def test_quantized_net_checkpoints(tmp_path):
+    rs = onp.random.RandomState(6)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rs.randn(4, 8).astype("float32"))
+    qnet = quantize_net(net, calib_mode="none")
+    ref = qnet(x).asnumpy()
+    f = str(tmp_path / "q.params")
+    qnet.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, in_units=8, activation="relu"),
+             nn.Dense(4, in_units=16))
+    net2.initialize()
+    qnet2 = quantize_net(net2, calib_mode="none")
+    qnet2.load_parameters(f)
+    onp.testing.assert_allclose(qnet2(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-5)
