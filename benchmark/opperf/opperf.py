@@ -1,0 +1,171 @@
+"""Op-level performance harness (parity: benchmark/opperf/* in the
+reference — run_performance_test over categories of registered ops).
+
+Times mxnet_tpu ops through the SAME public dispatch users hit
+(mx.nd.*), with warmup + device sync per measurement, and emits a JSON
+report.  Categories mirror the reference's opperf groupings.
+
+Usage:
+    python -m benchmark.opperf.opperf [--category all] [--runs 20]
+        [--warmup 5] [--json out.json] [--large]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as onp
+
+
+def _shapes(large: bool):
+    b = 2048 if large else 256
+    return {
+        "vec": (b * 128,),
+        "mat": (b, 512),
+        "sq": (512, 512),
+        "img": (max(b // 8, 8), 3, 224, 224) if large else (8, 3, 64, 64),
+        "emb_rows": 50000,
+    }
+
+
+def _build_cases(large: bool):
+    import mxnet_tpu as mx
+    nd = mx.nd
+    s = _shapes(large)
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.randn(*s["mat"]).astype("float32"))
+    y = nd.array(rs.randn(*s["mat"]).astype("float32"))
+    sq = nd.array(rs.randn(*s["sq"]).astype("float32"))
+    sq2 = nd.array(rs.randn(*s["sq"]).astype("float32"))
+    img = nd.array(rs.randn(*s["img"]).astype("float32"))
+    idx = nd.array(rs.randint(0, s["emb_rows"], (s["mat"][0],)),
+                   dtype="int32")
+    emb = nd.array(rs.randn(s["emb_rows"], 128).astype("float32"))
+    w = nd.array(rs.randn(16, s["img"][1], 3, 3).astype("float32"))
+
+    cases: Dict[str, List[Tuple[str, Callable]]] = {
+        "unary": [
+            ("exp", lambda: nd.exp(x)),
+            ("sqrt", lambda: nd.sqrt(nd.abs(x))),
+            ("relu", lambda: nd.relu(x)),
+            ("sigmoid", lambda: nd.sigmoid(x)),
+            ("log_softmax", lambda: nd.log_softmax(x)),
+        ],
+        "binary_broadcast": [
+            ("add", lambda: x + y),
+            ("mul", lambda: x * y),
+            ("broadcast_add", lambda: nd.broadcast_add(
+                x, x.sum(axis=0, keepdims=True))),
+            ("maximum", lambda: nd.maximum(x, y)),
+        ],
+        "reduce": [
+            ("sum", lambda: x.sum()),
+            ("sum_axis", lambda: x.sum(axis=1)),
+            ("mean", lambda: x.mean(axis=0)),
+            ("argmax", lambda: nd.argmax(x, axis=1)),
+        ],
+        "gemm": [
+            ("dot", lambda: nd.dot(sq, sq2)),
+            ("batch_dot", lambda: nd.batch_dot(
+                sq.reshape((8, 64, 512)), sq2.reshape((8, 512, 64)))),
+            ("fully_connected", lambda: nd.FullyConnected(
+                x, sq, None, num_hidden=512, no_bias=True)),
+        ],
+        "nn": [
+            ("conv2d_3x3", lambda: nd.Convolution(
+                img, w, None, kernel=(3, 3), num_filter=16, no_bias=True,
+                pad=(1, 1))),
+            ("pooling_max", lambda: nd.Pooling(
+                img, kernel=(2, 2), pool_type="max", stride=(2, 2))),
+            ("batch_norm_inf", lambda: nd.Activation(img, act_type="relu")),
+            ("softmax", lambda: nd.softmax(x, axis=-1)),
+            ("embedding", lambda: nd.Embedding(
+                idx, emb, input_dim=s["emb_rows"], output_dim=128)),
+        ],
+        "random": [
+            ("uniform", lambda: nd.random.uniform(shape=s["mat"])),
+            ("normal", lambda: nd.random.normal(shape=s["mat"])),
+        ],
+        "attention": [],
+    }
+    try:
+        from mxnet_tpu.ops import dot_product_attention
+        t = 512 if large else 128
+        q = nd.array(rs.randn(2, t, 8, 64).astype("float32"))
+        cases["attention"] = [
+            ("dot_product_attention",
+             lambda: dot_product_attention(q, q, q, causal=True)),
+        ]
+    except ImportError:
+        pass
+    return cases
+
+
+def _time_one(fn: Callable, runs: int, warmup: int) -> Dict[str, float]:
+    import mxnet_tpu as mx
+    for _ in range(warmup):
+        out = fn()
+    mx.nd.waitall()
+    ts = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        out.wait_to_read() if hasattr(out, "wait_to_read") else None
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts = onp.asarray(ts)
+    return {"avg_ms": float(ts.mean()), "p50_ms": float(onp.median(ts)),
+            "p90_ms": float(onp.percentile(ts, 90)),
+            "min_ms": float(ts.min())}
+
+
+def run_benchmark(category="all", runs=20, warmup=5, large=False):
+    """Programmatic entry: returns {category: {op: stats}}."""
+    cases = _build_cases(large)
+    picked = cases if category == "all" else {category: cases[category]}
+    report = {}
+    for cat, ops in picked.items():
+        report[cat] = {}
+        for name, fn in ops:
+            report[cat][name] = _time_one(fn, runs, warmup)
+    return report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--category", default="all",
+                   help="all | unary | binary_broadcast | reduce | gemm | "
+                        "nn | random | attention")
+    p.add_argument("--runs", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--large", action="store_true",
+                   help="TPU-scale shapes (default: CPU-friendly)")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (cpu/tpu); some TPU plugins "
+                        "ignore the JAX_PLATFORMS env var, so we apply it "
+                        "through jax.config")
+    p.add_argument("--json", default=None, help="write report to file")
+    args = p.parse_args(argv)
+
+    import os
+
+    import jax
+    platform = args.platform or os.environ.get("JAX_PLATFORMS") or None
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    report = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "results": run_benchmark(args.category, args.runs, args.warmup,
+                                 args.large),
+    }
+    text = json.dumps(report, indent=2)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -233,48 +233,47 @@ class QuantizedDense(HybridBlock):
         wnp = dense.weight.data().asnumpy()
         w_scale = float(max(abs(wnp.min()), abs(wnp.max()), 1e-8)) / 127.0
         wq = onp.clip(onp.round(wnp / w_scale), -127, 127).astype(onp.int8)
-        # int8 weights + scale are real Parameters so the quantized net
-        # checkpoints through save_parameters/load_parameters
-        self.qweight = self.params.get(
-            "qweight", shape=wq.shape, dtype="int8", init="zeros",
-            grad_req="null")
-        self.qweight.initialize()
+        # int8 weights, scale, bias AND the calibrated activation range
+        # are real Parameters so the quantized net checkpoints fully
+        # through save_parameters/load_parameters (set_data on a fresh
+        # Parameter establishes shape+value directly)
+        self.qweight = self.params.get("qweight", shape=wq.shape,
+                                       dtype="int8", grad_req="null")
         self.qweight.set_data(nd_array(wq, dtype="int8"))
-        self.wscale = self.params.get(
-            "wscale", shape=(1,), dtype="float32", init="zeros",
-            grad_req="null")
-        self.wscale.initialize()
+        self.wscale = self.params.get("wscale", shape=(1,),
+                                      dtype="float32", grad_req="null")
         self.wscale.set_data(nd_array([w_scale]))
+        # nan means "no calibration: quantize activations dynamically"
+        self.acts_range = self.params.get("acts_range", shape=(2,),
+                                          dtype="float32", grad_req="null")
+        self.acts_range.set_data(nd_array(
+            [float("nan") if min_calib is None else min_calib,
+             float("nan") if max_calib is None else max_calib]))
         if dense.bias is not None:
             bnp = dense.bias.data().asnumpy()
-            self.bias = self.params.get(
-                "bias", shape=bnp.shape, dtype="float32", init="zeros",
-                grad_req="null")
-            self.bias.initialize()
+            self.bias = self.params.get("bias", shape=bnp.shape,
+                                        dtype="float32", grad_req="null")
             self.bias.set_data(nd_array(bnp))
         else:
             self.bias = None
         self._units = dense._units
         self._flatten = dense._flatten
         self._activation = dense._activation
-        self._min_calib = min_calib
-        self._max_calib = max_calib
 
     def forward(self, x):
         x = _as_nd(x)
         wq = self.qweight.data().jax
         w_scale = self.wscale.data().jax[0]
         bias = None if self.bias is None else self.bias.data().jax
-        mn, mx = self._min_calib, self._max_calib
+        crange = self.acts_range.data().jax
 
         def f(xv):
             shape = xv.shape
             if self._flatten and xv.ndim > 2:
                 xv = xv.reshape(shape[0], -1)
-            if mn is not None and mx is not None:
-                amax = jnp.maximum(abs(mn), abs(mx))
-            else:
-                amax = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8)
+            dyn = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8)
+            calib = jnp.maximum(jnp.abs(crange[0]), jnp.abs(crange[1]))
+            amax = jnp.where(jnp.isnan(crange[0]), dyn, calib)
             x_scale = amax / 127.0
             xq = jnp.clip(jnp.round(xv / x_scale), -127, 127).astype(
                 jnp.int8)
@@ -312,14 +311,6 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
 
     calib_iter = iter(calib_data) if calib_data is not None else None
     first_batch = next(calib_iter, None) if calib_iter is not None else None
-    if first_batch is not None:
-        # settle deferred-init Dense shapes so walk() sees their weights
-        data = first_batch.data[0] if hasattr(first_batch, "data") \
-            else first_batch
-        net(data)
-
-    targets = []   # (parent, attr_name, child_name, dense)
-    deferred = []
 
     def walk(block, prefix=""):
         for name, child in list(block._children.items()):
@@ -332,7 +323,15 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
             else:
                 walk(child, path + ".")
 
+    targets, deferred = [], []   # (parent, attr_name, child_name, dense)
     walk(net)
+    if deferred and first_batch is not None:
+        # settle deferred-init Dense shapes with one forward, then re-walk
+        data = first_batch.data[0] if hasattr(first_batch, "data") \
+            else first_batch
+        net(data)
+        targets, deferred = [], []
+        walk(net)
     if deferred:
         raise _base.MXNetError(
             f"Dense layers {deferred} have uninitialized (deferred) "

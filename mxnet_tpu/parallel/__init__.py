@@ -7,6 +7,7 @@ XLA collectives inserted by GSPMD; plus the strategies MXNet never had
 """
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .distributed import barrier, init_distributed, num_workers, rank
 from .mesh import AXES, axis_size, current_mesh, make_mesh, use_mesh
 from .pipeline import gpipe
 from .sharding import (DEFAULT_RULES, ShardingRules, annotate, batch_spec,
@@ -15,9 +16,10 @@ from .trainer import ShardedTrainer
 
 __all__ = [
     "AXES", "Mesh", "NamedSharding", "PartitionSpec", "ShardingRules",
-    "ShardedTrainer", "annotate", "axis_size", "batch_spec", "current_mesh",
-    "gpipe", "logical_axes_of", "make_mesh", "param_sharding",
-    "shard_params", "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",
+    "ShardedTrainer", "annotate", "axis_size", "barrier", "batch_spec",
+    "current_mesh", "gpipe", "init_distributed", "logical_axes_of",
+    "make_mesh", "num_workers", "param_sharding", "rank", "shard_params",
+    "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",
 ]
 
 

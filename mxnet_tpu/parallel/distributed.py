@@ -1,0 +1,69 @@
+"""Multi-host bring-up (parity: ps-lite Postoffice rendezvous +
+kvstore_dist roles, SURVEY.md §2.4/§3.5).
+
+The reference rendezvouses scheduler/server/worker processes over ZMQ
+with DMLC_* env; here every process is a worker and rendezvous is the
+JAX coordination service — after :func:`init_distributed`,
+``jax.devices()`` spans all hosts and the SAME mesh/psum code paths
+(mxnet_tpu.parallel) scale from one chip to a pod, collectives riding
+ICI within a slice and DCN across slices.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_distributed", "rank", "num_workers", "barrier"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join the job's coordination service (idempotent).
+
+    Arguments default from the env set by tools/launch.py
+    (MXNET_TPU_COORD_ADDR/RANK/NPROCS); on Cloud TPU pods all three stay
+    None and the TPU metadata provides topology.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or \
+        os.environ.get("MXNET_TPU_COORD_ADDR")
+    if num_processes is None and os.environ.get("MXNET_TPU_NPROCS"):
+        num_processes = int(os.environ["MXNET_TPU_NPROCS"])
+    if process_id is None and os.environ.get("MXNET_TPU_RANK"):
+        process_id = int(os.environ["MXNET_TPU_RANK"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    _initialized = True
+
+
+def rank() -> int:
+    """This process's index (parity: kvstore.rank)."""
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def num_workers() -> int:
+    """Total processes (parity: kvstore.num_workers)."""
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+def barrier(name: str = "mxnet_tpu_barrier") -> None:
+    """Block until every process reaches this point (parity: ps-lite
+    Postoffice::Barrier) — a tiny psum across all devices."""
+    import jax.numpy as jnp
+    v = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),)))
+    v.block_until_ready()

@@ -156,3 +156,27 @@ def test_quantized_net_checkpoints(tmp_path):
     qnet2.load_parameters(f)
     onp.testing.assert_allclose(qnet2(x).asnumpy(), ref, rtol=1e-5,
                                 atol=1e-5)
+
+
+def test_quantized_net_checkpoints_calibrated(tmp_path):
+    """Calibrated activation ranges must survive save/load (they live in
+    the acts_range Parameter)."""
+    rs = onp.random.RandomState(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.array(rs.randn(32, 8).astype("float32")) for _ in range(2)]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+    # out-of-calib-range input exercises the calibrated clamp
+    x = nd.array(rs.randn(4, 8).astype("float32") * 10)
+    ref = qnet(x).asnumpy()
+    f = str(tmp_path / "qc.params")
+    qnet.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, in_units=8))
+    net2.initialize()
+    qnet2 = quantize_net(net2, calib_mode="none")  # no calib data needed
+    qnet2.load_parameters(f)
+    onp.testing.assert_allclose(qnet2(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-5)

@@ -1,0 +1,94 @@
+"""tools/ (im2rec, launch, parse_log) + benchmark/opperf harness
+(parity: tools/im2rec.py, tools/launch.py, tools/parse_log.py,
+benchmark/opperf)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_im2rec_list_and_pack_roundtrip(tmp_path):
+    from PIL import Image
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import im2rec
+
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = onp.random.RandomState(i).randint(
+                0, 255, (40, 40, 3), dtype=onp.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.jpg")
+    prefix = str(tmp_path / "data")
+    im2rec.make_list(prefix, str(root))
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    im2rec.pack(prefix, str(root))
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         path_imgidx=prefix + ".idx",
+                         data_shape=(3, 32, 32), batch_size=6)
+    batch = next(iter(it))
+    labels = sorted(batch.label[0].asnumpy().tolist())
+    assert labels == [0.0, 0.0, 0.0, 1.0, 1.0, 1.0]
+
+
+def test_parse_log_speedometer_lines(tmp_path):
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import parse_log
+
+    lines = [
+        "INFO Epoch[0] Batch [50]\tSpeed: 1234.56 samples/sec\t"
+        "accuracy=0.812345",
+        "noise line",
+        "INFO Epoch[1] finished in 12.34s: accuracy: 0.9000, loss: 0.3000",
+    ]
+    rows = parse_log.parse(lines)
+    assert rows[0]["speed"] == pytest.approx(1234.56)
+    assert rows[0]["accuracy"] == pytest.approx(0.812345)
+    assert rows[1]["epoch"] == 1 and rows[1]["time_s"] == pytest.approx(
+        12.34)
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    import launch
+
+    out = tmp_path / "r"
+    code = ("import os,sys; open(os.environ['OUT'] + "
+            "os.environ['MXNET_TPU_RANK'], 'w').write("
+            "os.environ['MXNET_TPU_NPROCS'])")
+    codes = launch.launch_local(3, [sys.executable, "-c", code],
+                                env_extra={"OUT": str(out)})
+    assert codes == [0, 0, 0]
+    for r in range(3):
+        assert open(str(out) + str(r)).read() == "3"
+
+
+def test_opperf_runs_and_reports():
+    from benchmark.opperf.opperf import run_benchmark
+    rep = run_benchmark(category="unary", runs=2, warmup=1)
+    assert "unary" in rep and "exp" in rep["unary"]
+    stats = rep["unary"]["exp"]
+    assert stats["avg_ms"] > 0 and stats["min_ms"] <= stats["avg_ms"]
+
+
+def test_opperf_cli(tmp_path):
+    out = tmp_path / "r.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "benchmark.opperf.opperf", "--category",
+         "reduce", "--runs", "2", "--warmup", "1", "--platform", "cpu",
+         "--json", str(out)],
+        cwd=_ROOT, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-500:]
+    rep = json.loads(out.read_text())
+    assert rep["backend"] == "cpu"
+    assert "sum" in rep["results"]["reduce"]

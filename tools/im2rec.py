@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""im2rec: pack images into RecordIO (parity: tools/im2rec.py +
+tools/im2rec.cc in the reference — same .lst format and .rec/.idx
+output so datasets interchange).
+
+Two modes, matching upstream:
+  --list : walk an image directory and write a .lst file
+           (index \\t label \\t relpath)
+  (default) : read a .lst file and pack records (native C++ writer when
+           built; JPEG re-encode via PIL)
+
+Usage:
+    python tools/im2rec.py --list prefix image_dir
+    python tools/im2rec.py prefix image_dir [--resize N] [--quality Q]
+        [--num-thread T]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, recursive=True):
+    paths = []
+    if recursive:
+        labels = {}
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                if os.path.splitext(fn)[1].lower() in _EXTS:
+                    lab = os.path.relpath(dirpath, root)
+                    if lab not in labels:
+                        labels[lab] = len(labels)
+                    paths.append((os.path.relpath(
+                        os.path.join(dirpath, fn), root), labels[lab]))
+    with open(prefix + ".lst", "w") as f:
+        for i, (rel, lab) in enumerate(paths):
+            f.write(f"{i}\t{lab}\t{rel}\n")
+    print(f"wrote {len(paths)} entries to {prefix}.lst")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, color=1):
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+    from PIL import Image
+    import numpy as onp
+
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        p = os.path.join(root, rel)
+        try:
+            img = Image.open(p)
+            img = img.convert("RGB" if color else "L")
+            if resize:
+                w, h = img.size
+                s = resize / min(w, h)
+                img = img.resize((max(1, int(w * s)), max(1, int(h * s))),
+                                 Image.BILINEAR)
+            label = labels[0] if len(labels) == 1 else \
+                onp.asarray(labels, onp.float32)
+            hdr = IRHeader(0 if len(labels) == 1 else len(labels),
+                           label, idx, 0)
+            rec.write_idx(idx, pack_img(hdr, onp.asarray(img),
+                                        quality=quality))
+            n += 1
+        except Exception as e:  # noqa: BLE001 — skip bad images like upstream
+            print(f"skipping {p}: {e}", file=sys.stderr)
+    rec.close()
+    print(f"packed {n} records into {prefix}.rec (+.idx)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst instead of packing")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--color", type=int, default=1, choices=[0, 1])
+    args = ap.parse_args(argv)
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, color=args.color)
+
+
+if __name__ == "__main__":
+    main()

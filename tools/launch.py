@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Distributed job launcher (parity: tools/launch.py + the dmlc tracker).
+
+The reference spawns scheduler/server/worker processes with DMLC_* env;
+the TPU-native equivalent launches N worker processes that rendezvous
+through the JAX coordination service (``jax.distributed.initialize``):
+no server role exists — gradient exchange is XLA collectives over
+ICI/DCN (SURVEY.md §2.4 TPU mapping).
+
+Local launcher (functional, the reference's `--launcher local`):
+    python tools/launch.py -n 4 python train.py  → spawns 4 processes
+    with MXNET_TPU_COORD/RANK/NPROCS set; scripts call
+    mxnet_tpu.parallel.init_distributed() (or jax.distributed.initialize
+    directly — the env vars match its defaults).
+
+Pod launcher: on Cloud TPU pods the runtime already provides topology;
+`-n` is ignored and init_distributed() picks up the TPU metadata —
+this tool just prints the gcloud invocation it would use.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(n, cmd, env_extra=None):
+    """Spawn n copies of cmd with coordination env; returns exit codes."""
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "MXNET_TPU_COORD_ADDR": coord,
+            "MXNET_TPU_RANK": str(rank),
+            "MXNET_TPU_NPROCS": str(n),
+            # worker processes of a CPU-hosted test cluster each see the
+            # host platform; real pods ignore these
+            "JAX_COORDINATOR_ADDRESS": coord,
+            "JAX_PROCESS_ID": str(rank),
+            "JAX_NUM_PROCESSES": str(n),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    codes = [p.wait() for p in procs]
+    return codes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, default=1)
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "gcloud"])
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "gcloud":
+        print("# run on every pod worker (the TPU runtime provides "
+              "topology; jax.distributed.initialize() needs no args):")
+        print(f"gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all "
+              f"--command {' '.join(args.command)!r}")
+        return 0
+    codes = launch_local(args.num_workers, args.command)
+    bad = [i for i, c in enumerate(codes) if c]
+    if bad:
+        print(f"workers {bad} failed: {codes}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

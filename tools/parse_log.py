@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Scrape training logs for throughput + metrics (parity:
+tools/parse_log.py — understands the Speedometer line format emitted by
+mxnet_tpu.callback.Speedometer and the Estimator LoggingHandler)."""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\] Batch \[(\d+)\]\s+Speed: ([\d.]+) samples/sec"
+    r"((?:\s+\S+=[\d.eE+-]+)*)")
+_METRIC = re.compile(r"(\S+)=([\d.eE+-]+)")
+_EPOCH = re.compile(
+    r"Epoch\[(\d+)\] finished in ([\d.]+)s: (.+)")
+
+
+def parse(lines):
+    rows = []
+    for line in lines:
+        m = _SPEED.search(line)
+        if m:
+            row = {"epoch": int(m.group(1)), "batch": int(m.group(2)),
+                   "speed": float(m.group(3))}
+            for k, v in _METRIC.findall(m.group(4) or ""):
+                row[k] = float(v)
+            rows.append(row)
+            continue
+        m = _EPOCH.search(line)
+        if m:
+            row = {"epoch": int(m.group(1)), "time_s": float(m.group(2))}
+            for part in m.group(3).split(","):
+                if ":" in part:
+                    k, v = part.rsplit(":", 1)
+                    try:
+                        row[k.strip()] = float(v)
+                    except ValueError:
+                        pass
+            rows.append(row)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", default="json", choices=["json", "csv"])
+    args = ap.parse_args(argv)
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(lines)
+    if args.format == "json":
+        print(json.dumps(rows, indent=2))
+    else:
+        if rows:
+            keys = sorted({k for r in rows for k in r})
+            print(",".join(keys))
+            for r in rows:
+                print(",".join(str(r.get(k, "")) for k in keys))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
