@@ -1,15 +1,43 @@
-"""Benchmark: GPT-2 124M training throughput on the available accelerator.
+"""Benchmark harness: training throughput on the available accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured MFU / 0.45 (the BASELINE.json north-star target of
-≥45% MFU; the reference tree shipped no published numbers — see BASELINE.md).
+Prints ONE JSON line for the primary workload (GPT-2 124M):
+    {"metric", "value", "unit", "vs_baseline"}
+vs_baseline is measured MFU / 0.45 — the BASELINE.json north-star target of
+>=45% MFU (the reference tree shipped no published numbers, see BASELINE.md).
+
+Extra workloads from BASELINE.md (ResNet-50 images/sec, BERT-large
+samples/sec) run with --workload resnet50|bert|all; each prints its own JSON
+line in the same schema (primary line last so drivers that read one line get
+GPT-2).
+
+If the TPU backend fails to initialize (the axon plugin raises instead of
+falling back), the bench retries on CPU and says so on stderr — a number
+always beats an rc=1 (round-1 failure mode).
 """
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
 
 import numpy as onp
+
+
+def _init_platform() -> str:
+    """Bring up TPU if reachable, else CPU (a number always beats rc=1).
+
+    Delegates to mxnet_tpu.utils.platform: the axon plugin both raises AND
+    hangs inside jax.devices() depending on failure mode, so reachability
+    is probed in a subprocess first.
+    """
+    from mxnet_tpu.utils.platform import init_backend
+
+    platform = init_backend()
+    if platform != "tpu":
+        print(f"bench: accelerator unavailable; running on {platform}",
+              file=sys.stderr)
+    return platform
 
 
 def peak_flops_per_device() -> float:
@@ -27,26 +55,43 @@ def peak_flops_per_device() -> float:
     return 50e12 if d.platform == "cpu" else 200e12
 
 
-def main():
-    import jax
+def _run_steps(trainer, batches, warmup: int, steps: int) -> float:
+    """Warm up (each step synced, so lazy compile/upload never leaks into
+    the timed region), then time `steps` async-dispatched steps with one
+    final sync.  Returns seconds."""
+    for i in range(warmup):
+        loss = trainer.step(*batches[i % len(batches)])
+        float(loss.asnumpy())     # hard sync — waitall is not enough
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(steps):
+        loss = trainer.step(*batches[i % len(batches)])
+    float(loss.asnumpy())
+    return time.perf_counter() - t0
 
+
+def _record(metric: str, value: float, unit: str, mfu: float) -> dict:
+    return {"metric": metric, "value": round(value, 1), "unit": unit,
+            "vs_baseline": round(mfu / 0.45, 4)}
+
+
+# ------------------------------------------------------------------ GPT-2
+
+def bench_gpt2(on_tpu: bool) -> dict:
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
 
-    on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        batch, seq = 8, 1024
+        batch, seq, steps, warmup = 16, 1024, 20, 3
+        layers, units, vocab = 12, 768, 50257
         net = get_gpt2("gpt2_124m", max_length=seq, dropout=0.0)
-        n_params = 124e6
-        steps = 20
-    else:  # CPU sanity mode
-        batch, seq = 4, 128
-        net = get_gpt2("gpt2_124m", vocab_size=1024, units=256,
-                       num_layers=4, num_heads=8, max_length=seq,
+    else:  # CPU sanity mode: tiny variant, same code path
+        batch, seq, steps, warmup = 4, 128, 3, 1
+        layers, units, vocab = 4, 256, 1024
+        net = get_gpt2("gpt2_124m", vocab_size=vocab, units=units,
+                       num_layers=layers, num_heads=8, max_length=seq,
                        dropout=0.0)
-        n_params = 4 * 12 * 256 * 256 + 1024 * 256
-        steps = 5
     net.initialize()
     mesh = par.make_mesh()
     with par.use_mesh(mesh):
@@ -54,31 +99,145 @@ def main():
             net, "adam", loss=gpt2_lm_loss,
             optimizer_params={"learning_rate": 1e-4}, mesh=mesh)
         toks = mx.nd.array(
-            onp.random.randint(0, net.vocab_size, (batch, seq)),
-            dtype="int32")
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
         labels = mx.nd.array(
-            onp.random.randint(0, net.vocab_size, (batch, seq)),
-            dtype="int32")
-        for _ in range(3):  # compile + warmup
-            trainer.step(toks, labels)
-        mx.nd.waitall()
-        t0 = time.perf_counter()
-        loss = None
-        for _ in range(steps):
-            loss = trainer.step(toks, labels)
-        float(loss.asnumpy())
-        dt = time.perf_counter() - t0
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+        dt = _run_steps(trainer, [(toks, labels)], warmup, steps)
 
     tokens_per_sec = batch * seq * steps / dt
-    flops_per_token = 6.0 * n_params  # fwd+bwd dense training flops
+    # matmul flops per token: 6*(block params + tied lm head) + attention
+    # (2 score + 2 value matmuls per layer, fwd; x3 for training)
+    flops_per_token = (6.0 * (12 * layers * units * units + units * vocab)
+                       + 12.0 * layers * units * seq)
     mfu = tokens_per_sec * flops_per_token / (
-        peak_flops_per_device() * len(mesh.devices.flat))
-    print(json.dumps({
-        "metric": "gpt2_124m_train_throughput",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+        peak_flops_per_device() * len(jax_devices()))
+    return _record("gpt2_124m_train_throughput", tokens_per_sec,
+                   "tokens/sec", mfu)
+
+
+# --------------------------------------------------------------- ResNet-50
+
+def bench_resnet50(on_tpu: bool) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models.vision import get_resnet
+    from mxnet_tpu.ndarray import ops as F
+
+    def ce_loss(logits, labels):
+        lse = F.logsumexp(logits, axis=-1)
+        return (lse - F.pick(logits, labels, axis=-1)).mean()
+
+    if on_tpu:
+        batch, steps, warmup, size = 64, 20, 3, 224
+        net = get_resnet(1, 50, classes=1000)
+        train_flops_per_img = 3 * 4.1e9   # fwd conv+fc flops, ResNet-50 v1
+    else:
+        batch, steps, warmup, size = 8, 2, 1, 64
+        net = get_resnet(1, 18, classes=100)
+        train_flops_per_img = 3 * 1.8e9 * (64 / 224) ** 2
+    net.initialize()
+    mesh = par.make_mesh()
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "sgd", loss=ce_loss,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            mesh=mesh)
+        imgs = mx.nd.array(
+            onp.random.uniform(-1, 1, (batch, 3, size, size)).astype("float32"))
+        labels = mx.nd.array(
+            onp.random.randint(0, 100, (batch,)), dtype="int32")
+        dt = _run_steps(trainer, [(imgs, labels)], warmup, steps)
+
+    imgs_per_sec = batch * steps / dt
+    mfu = imgs_per_sec * train_flops_per_img / (
+        peak_flops_per_device() * len(jax_devices()))
+    return _record("resnet50_train_throughput", imgs_per_sec,
+                   "images/sec", mfu)
+
+
+# -------------------------------------------------------------- BERT-large
+
+def bench_bert(on_tpu: bool) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_bert
+    from mxnet_tpu.models.bert import BERTForPretrain
+    from mxnet_tpu.ndarray import ops as F
+
+    if on_tpu:
+        batch, seq, steps, warmup = 8, 512, 10, 3
+        layers, units, vocab, name = 24, 1024, 30522, "bert_large"
+    else:
+        batch, seq, steps, warmup = 2, 128, 2, 1
+        layers, units, vocab, name = 2, 128, 1000, "bert_base"
+    n_masked = max(1, seq // 8)
+    net = BERTForPretrain(get_bert(
+        name, vocab_size=vocab, max_length=seq,
+        **({} if on_tpu else
+           {"units": units, "num_layers": layers, "num_heads": 2})))
+
+    def mlm_loss(outs, mlm_labels, nsp_labels):
+        mlm_logits, nsp_logits = outs
+        lse = F.logsumexp(mlm_logits, axis=-1)
+        mlm = (lse - F.pick(mlm_logits, mlm_labels, axis=-1)).mean()
+        nlse = F.logsumexp(nsp_logits, axis=-1)
+        nsp = (nlse - F.pick(nsp_logits, nsp_labels, axis=-1)).mean()
+        return mlm + nsp
+
+    net.initialize()
+    mesh = par.make_mesh()
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "adam", loss=mlm_loss,
+            optimizer_params={"learning_rate": 1e-4}, mesh=mesh)
+        toks = mx.nd.array(
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+        types = mx.nd.array(onp.zeros((batch, seq)), dtype="int32")
+        vlen = mx.nd.array(onp.full((batch,), seq), dtype="int32")
+        pos = mx.nd.array(
+            onp.sort(onp.random.choice(seq, (batch, n_masked),
+                                       replace=False)), dtype="int32")
+        mlm_lab = mx.nd.array(
+            onp.random.randint(0, vocab, (batch, n_masked)), dtype="int32")
+        nsp_lab = mx.nd.array(onp.random.randint(0, 2, (batch,)),
+                              dtype="int32")
+        data = (toks, types, vlen, pos)
+        dt = _run_steps(trainer, [(data, (mlm_lab, nsp_lab))], warmup, steps)
+
+    samples_per_sec = batch * steps / dt
+    flops_per_sample = seq * (6.0 * 12 * layers * units * units
+                              + 12.0 * layers * units * seq) \
+        + 6.0 * n_masked * units * vocab
+    mfu = samples_per_sec * flops_per_sample / (
+        peak_flops_per_device() * len(jax_devices()))
+    return _record("bert_large_pretrain_throughput", samples_per_sec,
+                   "samples/sec", mfu)
+
+
+def jax_devices():
+    import jax
+    return jax.devices()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", default="gpt2",
+                    choices=["gpt2", "resnet50", "bert", "all"])
+    args = ap.parse_args()
+
+    platform = _init_platform()
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        from mxnet_tpu import amp
+        amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
+
+    names = (["resnet50", "bert", "gpt2"] if args.workload == "all"
+             else [args.workload])
+    table = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
+             "bert": bench_bert}
+    for name in names:
+        rec = table[name](on_tpu)
+        print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,10 @@ import numpy as onp
 from . import base as _base
 from .ndarray import NDArray, array as nd_array
 
+# native scan marks multipart logical records with the top bit of the length
+# (mxtpu_io.cc kMultipartBit)
+_MULTIPART_BIT = 1 << 63
+
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
 
@@ -316,9 +320,15 @@ class ImageRecordIter(DataIter):
             scan = _native_mod.scan_record_offsets(path_imgrec)
         if scan is not None and path_imgidx and os.path.exists(path_imgidx):
             # honor the .idx sidecar (it may subset/reorder records):
-            # map each idx record-start offset to its scanned payload slot
+            # map each idx record-start offset to its scanned slot.  Scanned
+            # single-part entries hold the PAYLOAD offset (start + 8);
+            # multipart entries (high bit of len set) hold the record start
+            # itself, so key both forms by record start.
             offs, lens = scan
-            by_payload = {int(o): int(l) for o, l in zip(offs, lens)}
+            by_start = {}
+            for o, l in zip(offs, lens):
+                start = int(o) if int(l) & _MULTIPART_BIT else int(o) - 8
+                by_start[start] = (int(o), int(l))
             sel_offs, sel_lens = [], []
             ok = True
             with open(path_imgidx) as f:
@@ -326,12 +336,13 @@ class ImageRecordIter(DataIter):
                     parts = line.strip().split("\t")
                     if len(parts) < 2:
                         continue
-                    payload = int(parts[1]) + 8   # skip magic+lrec header
-                    if payload not in by_payload:
+                    start = int(parts[1])
+                    if start not in by_start:
                         ok = False
                         break
-                    sel_offs.append(payload)
-                    sel_lens.append(by_payload[payload])
+                    o, l = by_start[start]
+                    sel_offs.append(o)
+                    sel_lens.append(l)
             scan = (onp.asarray(sel_offs, onp.uint64),
                     onp.asarray(sel_lens, onp.uint64)) if ok else None
         if scan is not None:
@@ -381,9 +392,16 @@ class ImageRecordIter(DataIter):
     def _read_raw(self, i):
         if self._records is not None:
             return self._records[i]
+        length = int(self._lengths[i])
         with open(self._path, "rb") as f:
-            f.seek(self._offsets[i])
-            return f.read(int(self._lengths[i]))
+            f.seek(int(self._offsets[i]))
+            raw = f.read(length & ~_MULTIPART_BIT)
+        if length & _MULTIPART_BIT:
+            # span starts at the first frame HEADER: reassemble parts
+            # (magic re-inserted between them, dmlc semantics)
+            from .recordio import reassemble_span
+            raw = reassemble_span(raw)
+        return raw
 
     def _process_one(self, raw):
         header, img = self._unpack_img(raw, iscolor=1)
@@ -432,8 +450,15 @@ class ImageRecordIter(DataIter):
                 for j in onp.nonzero(~ok)[0]:
                     arr, lab = self._process_one(self._read_raw(idxs[j]))
                     data[j] = arr
-                    labels[j, 0] = lab if onp.isscalar(lab) else \
-                        onp.asarray(lab).ravel()[0]
+                    # restore the FULL label vector (a failed native read
+                    # leaves columns 1+ zeroed when label_width > 1); a
+                    # record's label may be shorter than label_width —
+                    # fill what exists, zero the rest
+                    lw = labels.shape[1]
+                    vec = onp.asarray(lab, dtype=onp.float32).ravel()
+                    n = min(vec.size, lw)
+                    labels[j, :n] = vec[:n]
+                    labels[j, n:] = 0.0
             label = labels[:, 0] if self.label_width == 1 else labels
             return DataBatch([nd_array(data)],
                              [nd_array(label.astype(onp.float32))],

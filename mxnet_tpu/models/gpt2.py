@@ -82,8 +82,12 @@ def gpt2_lm_loss(logits, labels, aux_weight=0.01):
     router aux losses recorded during the forward are drained and added
     (weight 0 cost for dense models — the collector is simply empty)."""
     from .moe import pop_aux_losses
-    logp = F.log_softmax(logits, axis=-1)
-    nll = -F.pick(logp, labels, axis=-1)
+    # nll = logsumexp(logits) - logits[label]: skips materializing the full
+    # (B, T, V) log_softmax in f32 — the logsumexp reduction reads logits
+    # once and the gather is O(B*T) (HBM matters: V=50k dominates activations)
+    lse = F.logsumexp(logits, axis=-1)
+    picked = F.pick(logits, labels, axis=-1)
+    nll = lse - picked
     loss = nll.mean()
     for aux in pop_aux_losses():
         loss = loss + aux * aux_weight

@@ -23,13 +23,15 @@ class RELU6(HybridBlock):
         return F.clip(x, 0.0, 6.0)
 
 
-def _conv_block(out, kernel, stride, pad, groups=1, act=True):
+def _conv_block(out, kernel, stride, pad, groups=1, act=True, relu6=False):
+    # upstream model_zoo uses plain ReLU for v1 and relu6 only for v2 —
+    # ported v1 checkpoints diverge wherever activations exceed 6 otherwise
     seq = nn.HybridSequential()
     seq.add(nn.Conv2D(out, kernel_size=kernel, strides=stride, padding=pad,
                       groups=groups, use_bias=False))
     seq.add(nn.BatchNorm())
     if act:
-        seq.add(RELU6())
+        seq.add(RELU6() if relu6 else nn.Activation("relu"))
     return seq
 
 
@@ -65,8 +67,9 @@ class _InvertedResidual(HybridBlock):
         mid = in_ch * expansion
         self.body = nn.HybridSequential()
         if expansion != 1:
-            self.body.add(_conv_block(mid, 1, 1, 0))
-        self.body.add(_conv_block(mid, 3, stride, 1, groups=mid))
+            self.body.add(_conv_block(mid, 1, 1, 0, relu6=True))
+        self.body.add(_conv_block(mid, 3, stride, 1, groups=mid,
+                                  relu6=True))
         self.body.add(_conv_block(out_ch, 1, 1, 0, act=False))
 
     def forward(self, x):
@@ -84,7 +87,7 @@ class MobileNetV2(HybridBlock):
                 (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
                 (6, 320, 1, 1)]
         self.features = nn.HybridSequential()
-        self.features.add(_conv_block(c(32), 3, 2, 1))
+        self.features.add(_conv_block(c(32), 3, 2, 1, relu6=True))
         in_ch = c(32)
         for t, ch, n, s in spec:
             for i in range(n):
@@ -92,7 +95,7 @@ class MobileNetV2(HybridBlock):
                     in_ch, c(ch), s if i == 0 else 1, t))
                 in_ch = c(ch)
         last = 1280 if multiplier <= 1.0 else c(1280)
-        self.features.add(_conv_block(last, 1, 1, 0))
+        self.features.add(_conv_block(last, 1, 1, 0, relu6=True))
         self.features.add(nn.GlobalAvgPool2D(), nn.Flatten())
         self.output = nn.Dense(classes)
 

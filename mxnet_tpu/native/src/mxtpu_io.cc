@@ -131,7 +131,14 @@ struct Result {
 
 struct Task {
   int64_t epoch, seq, rec;
+  uint64_t seed;   // captured at schedule time — workers of an abandoned
+                   // epoch must never race the live epoch's seed
 };
+
+// high bit of a stored length marks a multipart logical record whose
+// offset points at the FIRST FRAME HEADER and whose length spans every
+// frame (headers included) through the last frame's payload
+constexpr uint64_t kMultipartBit = 1ull << 63;
 
 struct Pipe {
   int fd = -1;
@@ -165,7 +172,7 @@ struct Pipe {
         t = tasks.front();
         tasks.pop_front();
       }
-      Result r = process(t.rec, t.seq);
+      Result r = process(t.rec, t.seq, t.seed);
       {
         std::lock_guard<std::mutex> lk(mu);
         if (t.epoch == epoch)        // drop results of abandoned epochs
@@ -175,15 +182,55 @@ struct Pipe {
     }
   }
 
-  Result process(int64_t rec, int64_t seq) {
+  // Reassemble a multipart logical record from its raw frame span: parts
+  // are rejoined with the magic word re-inserted (dmlc RecordIOReader).
+  static bool reassemble(const std::vector<uint8_t>& span,
+                         std::vector<uint8_t>* out) {
+    out->clear();
+    size_t p = 0;
+    bool started = false;
+    while (p + 8 <= span.size()) {
+      uint32_t magic, lrec;
+      std::memcpy(&magic, span.data() + p, 4);
+      std::memcpy(&lrec, span.data() + p + 4, 4);
+      if (magic != kMagic) return false;
+      uint32_t cflag = lrec >> 29;
+      size_t len = lrec & kLenMask;
+      p += 8;
+      if (p + len > span.size()) return false;
+      if (cflag == 1) {
+        started = true;
+        out->assign(span.begin() + p, span.begin() + p + len);
+      } else if (cflag == 2 || cflag == 3) {
+        if (!started) return false;
+        const uint8_t* m = reinterpret_cast<const uint8_t*>(&kMagic);
+        out->insert(out->end(), m, m + 4);
+        out->insert(out->end(), span.begin() + p, span.begin() + p + len);
+        if (cflag == 3) return true;
+      } else {
+        return false;
+      }
+      p += len + ((4 - (len & 3)) & 3);
+    }
+    return false;
+  }
+
+  Result process(int64_t rec, int64_t seq, uint64_t seed) {
     Result r;
     r.ok = 0;
     r.data.assign(static_cast<size_t>(3) * H * W, 0.f);
     r.label.assign(label_width, 0.f);
-    std::vector<uint8_t> raw(lens[rec]);
-    ssize_t got = pread(fd, raw.data(), lens[rec],
+    uint64_t rlen = lens[rec] & ~kMultipartBit;
+    std::vector<uint8_t> raw(rlen);
+    ssize_t got = pread(fd, raw.data(), rlen,
                         static_cast<off_t>(offs[rec]));
-    if (got != static_cast<ssize_t>(lens[rec]) || raw.size() < 24) return r;
+    if (got != static_cast<ssize_t>(rlen)) return r;
+    if (lens[rec] & kMultipartBit) {
+      std::vector<uint8_t> whole;
+      if (!reassemble(raw, &whole)) return r;
+      raw.swap(whole);
+    }
+    if (raw.size() < 24) return r;
     // IRHeader: <IfQQ> flag, label, id, id2 (+ flag floats when flag > 0)
     uint32_t flag;
     float lab;
@@ -264,9 +311,28 @@ int64_t mxio_writer_tell(void* h) {
 int mxio_writer_write(void* h, const uint8_t* data, uint64_t len) {
   FILE* f = static_cast<Writer*>(h)->f;
   if (len > kLenMask) return -1;   // 29-bit length field; never truncate
-  uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+  // dmlc multipart splitting: every 4-byte-aligned magic word inside the
+  // payload becomes the next part's frame delimiter (cflag 1/2/3), so
+  // upstream dmlc readers reassemble bit-for-bit
+  const uint8_t* m = reinterpret_cast<const uint8_t*>(&kMagic);
+  uint64_t dptr = 0;
+  for (uint64_t i = 0; i + 4 <= len; i += 4) {
+    if (std::memcmp(data + i, m, 4) == 0) {
+      uint32_t lrec = ((dptr == 0 ? 1u : 2u) << 29) |
+                      static_cast<uint32_t>(i - dptr);
+      uint32_t hdr[2] = {kMagic, lrec};
+      if (fwrite(hdr, 4, 2, f) != 2) return -1;
+      if (i != dptr && fwrite(data + dptr, 1, i - dptr, f) != i - dptr)
+        return -1;
+      dptr = i + 4;
+    }
+  }
+  uint32_t lrec = ((dptr != 0 ? 3u : 0u) << 29) |
+                  static_cast<uint32_t>(len - dptr);
+  uint32_t hdr[2] = {kMagic, lrec};
   if (fwrite(hdr, 4, 2, f) != 2) return -1;
-  if (len && fwrite(data, 1, len, f) != len) return -1;
+  if (len != dptr && fwrite(data + dptr, 1, len - dptr, f) != len - dptr)
+    return -1;
   static const char zeros[4] = {0, 0, 0, 0};
   size_t pad = (4 - (len & 3)) & 3;
   if (pad && fwrite(zeros, 1, pad, f) != pad) return -1;
@@ -281,25 +347,45 @@ void mxio_writer_close(void* h) {
 
 // ------------------------------------------------- offset table scan
 
-// Scans a RecordIO file; fills malloc'd offset/length arrays (of the
-// PAYLOAD, header excluded).  Returns record count, -1 on error.
+// Scans a RecordIO file; fills malloc'd offset/length arrays of LOGICAL
+// records.  Single-frame records store (payload offset, payload length);
+// multipart records (cflag 1/2/3 chains) store (first-frame HEADER offset,
+// full span length) with the kMultipartBit marker — the pipeline worker
+// reassembles them.  Returns record count, -1 on error/malformed chain.
 int64_t mxio_scan(const char* path, uint64_t** offs_out,
                   uint64_t** lens_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
   std::vector<uint64_t> offs, lens;
   uint32_t hdr[2];
+  long chain_start = -1;   // header pos of the open multipart chain
   for (;;) {
     long pos = ftell(f);
     if (fread(hdr, 4, 2, f) != 2) break;
     if (hdr[0] != kMagic) { fclose(f); return -1; }
+    uint32_t cflag = hdr[1] >> 29;
     uint64_t len = hdr[1] & kLenMask;
-    offs.push_back(static_cast<uint64_t>(pos) + 8);
-    lens.push_back(len);
+    if (cflag == 0) {
+      if (chain_start != -1) { fclose(f); return -1; }
+      offs.push_back(static_cast<uint64_t>(pos) + 8);
+      lens.push_back(len);
+    } else if (cflag == 1) {
+      if (chain_start != -1) { fclose(f); return -1; }
+      chain_start = pos;
+    } else {
+      if (chain_start == -1) { fclose(f); return -1; }
+      if (cflag == 3) {
+        offs.push_back(static_cast<uint64_t>(chain_start));
+        lens.push_back((static_cast<uint64_t>(pos) + 8 + len -
+                        static_cast<uint64_t>(chain_start)) | kMultipartBit);
+        chain_start = -1;
+      }
+    }
     uint64_t skip = len + ((4 - (len & 3)) & 3);
     if (fseek(f, static_cast<long>(skip), SEEK_CUR) != 0) break;
   }
   fclose(f);
+  if (chain_start != -1) return -1;   // truncated multipart chain
   int64_t n = static_cast<int64_t>(offs.size());
   *offs_out = static_cast<uint64_t*>(malloc(n * 8));
   *lens_out = static_cast<uint64_t*>(malloc(n * 8));
@@ -350,7 +436,7 @@ void mxio_pipe_schedule(void* h, const int64_t* order, int64_t n,
     p->next_out = 0;
     p->seed = seed;
     for (int64_t i = 0; i < n; ++i)
-      p->tasks.push_back(Task{p->epoch, i, order[i]});
+      p->tasks.push_back(Task{p->epoch, i, order[i], seed});
   }
   p->cv_task.notify_all();
 }

@@ -818,6 +818,20 @@ def log_softmax(data, axis=-1, temperature=None):
 
 
 @_export
+def logsumexp(data, axis=-1, keepdims=False):
+    """log(sum(exp(x))) along `axis`, computed stably in f32 (the reduction
+    that lets losses avoid materializing a full log_softmax)."""
+    data = _as_nd(data)
+
+    def f(x):
+        r = jax.scipy.special.logsumexp(
+            x.astype(jnp.float32), axis=axis, keepdims=keepdims)
+        return r
+
+    return invoke("logsumexp", f, [data])
+
+
+@_export
 def softmax_cross_entropy(data, label):
     data, label = _as_nd(data), _as_nd(label)
 
@@ -937,14 +951,14 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
     dn = _conv_dim_numbers(data.ndim)
 
     def f(x, w, *b):
+        # no preferred_element_type: the MXU accumulates bf16 convs in f32
+        # internally already, and lax's conv transpose-rhs rule rejects
+        # mixed (bf16 operand, f32 cotangent) pairs it would produce
         y = lax.conv_general_dilated(
             x, w, window_strides=stride,
             padding=tuple((p, p) for p in pad_),
             rhs_dilation=dilate, dimension_numbers=dn,
-            feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16
-            else None)
-        y = y.astype(x.dtype)
+            feature_group_count=num_group)
         if b:
             bshape = (1, -1) + (1,) * nd_spatial
             y = y + b[0].reshape(bshape)

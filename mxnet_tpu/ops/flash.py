@@ -28,8 +28,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Measured on TPU v5e (B16 T1024 H12 D64, causal): 128x128 blocks run the
+# fwd kernel at 16.7 ms vs 1.6 ms at 1024x1024 — big tiles keep the MXU fed
+# (d=64 contractions are half-width already) and amortize grid/DMA overhead.
+# 2048x2048 exceeds VMEM (the (bq, bk) f32 score tile alone is 16 MB).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _MASK = -1e30
 _LANES = 128
 
@@ -333,6 +337,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
+    # halve until the block divides the sequence (any T that is a multiple
+    # of 128 lands on a legal block by 128 at the latest)
+    while block_q > 128 and tq % block_q:
+        block_q //= 2
+    while block_k > 128 and tk % block_k:
+        block_k //= 2
     if tq % block_q or tk % block_k:
         raise ValueError(
             f"seq lens ({tq}, {tk}) must divide by blocks "

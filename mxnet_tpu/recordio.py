@@ -22,7 +22,7 @@ import numpy as onp
 from . import base as _base
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader",
-           "pack", "unpack", "pack_img", "unpack_img"]
+           "pack", "unpack", "pack_img", "unpack_img", "reassemble_span"]
 
 _kMagic = 0xced7230a
 _LEN_MASK = (1 << 29) - 1
@@ -78,6 +78,11 @@ class MXRecordIO:
         self.open()
 
     def write(self, buf: bytes):
+        """dmlc framing incl. multipart splitting: any 4-byte-aligned magic
+        word inside the payload becomes the frame delimiter of the next
+        part (cflag 1=start, 2=middle, 3=end), exactly like
+        dmlc::RecordIOWriter::WriteRecord — so upstream readers reassemble
+        our files bit-for-bit."""
         if not self.writable:
             raise _base.MXNetError("not opened for writing")
         n = len(buf)
@@ -85,28 +90,59 @@ class MXRecordIO:
             raise _base.MXNetError(
                 f"record of {n} bytes exceeds the 29-bit RecordIO length "
                 "field (dmlc framing)")
-        self.handle.write(struct.pack("<II", _kMagic, n & _LEN_MASK))
-        self.handle.write(buf)
+        magic_bytes = struct.pack("<I", _kMagic)
+        parts = []
+        dptr = 0
+        for i in range(0, n & ~3, 4):
+            if buf[i:i + 4] == magic_bytes:
+                parts.append((1 if dptr == 0 else 2, buf[dptr:i]))
+                dptr = i + 4
+        parts.append((3 if dptr else 0, buf[dptr:]))
+        for cflag, part in parts:
+            lrec = (cflag << 29) | len(part)
+            self.handle.write(struct.pack("<II", _kMagic, lrec))
+            self.handle.write(part)
         pad = (4 - (n & 3)) & 3
         if pad:
             self.handle.write(b"\x00" * pad)
 
     def read(self) -> Optional[bytes]:
+        """Read one logical record, reassembling multipart frames (cflag
+        1/2/3) with the magic word re-inserted between parts — the inverse
+        of write()'s splitting (dmlc::RecordIOReader::NextRecord)."""
         if self.writable:
             raise _base.MXNetError("not opened for reading")
-        hdr = self.handle.read(8)
-        if len(hdr) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", hdr)
-        if magic != _kMagic:
-            raise _base.MXNetError(
-                f"invalid RecordIO magic {magic:#x} in {self.uri}")
-        n = lrec & _LEN_MASK
-        data = self.handle.read(n)
-        pad = (4 - (n & 3)) & 3
-        if pad:
-            self.handle.read(pad)
-        return data
+        out = None
+        while True:
+            hdr = self.handle.read(8)
+            if len(hdr) < 8:
+                if out is not None:
+                    raise _base.MXNetError(
+                        f"truncated multipart record at EOF in {self.uri}")
+                return None
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != _kMagic:
+                raise _base.MXNetError(
+                    f"invalid RecordIO magic {magic:#x} in {self.uri}")
+            cflag = lrec >> 29
+            n = lrec & _LEN_MASK
+            data = self.handle.read(n)
+            pad = (4 - (n & 3)) & 3
+            if pad:
+                self.handle.read(pad)
+            if cflag == 0:
+                return data
+            if cflag == 1:
+                out = bytearray(data)
+            else:
+                if out is None:
+                    raise _base.MXNetError(
+                        f"multipart record continuation (cflag={cflag}) "
+                        f"without a start frame in {self.uri}")
+                out += struct.pack("<I", _kMagic)
+                out += data
+                if cflag == 3:
+                    return bytes(out)
 
     def tell(self) -> int:
         return self.handle.tell()
@@ -155,6 +191,38 @@ class MXIndexedRecordIO(MXRecordIO):
 
 # MXNet's Python alias used by gluon RecordFileDataset
 IndexedRecordIO = MXIndexedRecordIO
+
+
+def reassemble_span(span: bytes) -> bytes:
+    """Reassemble one multipart logical record from its raw frame span
+    (starting at the first frame's header): parts are rejoined with the
+    magic word re-inserted between them (dmlc::RecordIOReader semantics)."""
+    out = bytearray()
+    p = 0
+    started = False
+    while p + 8 <= len(span):
+        magic, lrec = struct.unpack_from("<II", span, p)
+        if magic != _kMagic:
+            raise _base.MXNetError(
+                f"invalid RecordIO magic {magic:#x} in multipart span")
+        cflag = lrec >> 29
+        n = lrec & _LEN_MASK
+        p += 8
+        if p + n > len(span):
+            break
+        if cflag == 1:
+            started = True
+            out = bytearray(span[p:p + n])
+        elif cflag in (2, 3) and started:
+            out += struct.pack("<I", _kMagic)
+            out += span[p:p + n]
+            if cflag == 3:
+                return bytes(out)
+        else:
+            raise _base.MXNetError(
+                f"malformed multipart chain (cflag={cflag})")
+        p += n + ((4 - (n & 3)) & 3)
+    raise _base.MXNetError("truncated multipart record span")
 
 # ---------------------------------------------------------------- IRHeader
 

@@ -108,8 +108,15 @@ def available() -> bool:
 
 
 def scan_record_offsets(path):
-    """(payload_offsets, payload_lengths) uint64 arrays for a RecordIO
-    file, scanned natively; None if the library is unavailable."""
+    """(offsets, lengths) uint64 arrays of LOGICAL records, natively
+    scanned; None if the library is unavailable.
+
+    Single-frame records: (payload offset, payload length).  Multipart
+    records (dmlc cflag chains): bit 63 of the length is set, the offset
+    points at the FIRST FRAME HEADER and the length (bit 63 masked off)
+    spans every frame through the last frame's payload — reassemble with
+    mxnet_tpu.recordio.reassemble_span.
+    """
     lib = get_lib()
     if lib is None:
         return None

@@ -1,0 +1,68 @@
+"""Platform bring-up helpers for the axon TPU plugin's sharp edges.
+
+The axon plugin ignores the ``JAX_PLATFORMS`` env var, raises from inside
+``jax.devices()`` when the tunnel is down, and *hangs* there when the chip
+is held by another process.  Every entry point that must not die on those
+(tests, driver dryruns, bench) funnels through here instead of each keeping
+its own copy of the workaround.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Force the CPU XLA backend with ``n_devices`` virtual devices.
+
+    Must run BEFORE any jax device is touched; if a backend was already
+    initialized, it is cleared so the config takes effect.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % max(n_devices, 1))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:  # drop any backend initialized before the platform was forced
+        from jax._src import xla_bridge as _xb
+        if _xb._backends:  # noqa: SLF001 — bring-up only, no public API
+            jax.clear_caches()
+            _xb._clear_backends()
+    except Exception:
+        pass
+
+
+def probe_accelerator(timeout: float = 120.0) -> bool:
+    """True iff ``jax.devices()`` succeeds in a SUBPROCESS within timeout.
+
+    The probe must be out-of-process: once the in-process ``jax.devices()``
+    blocks on a busy chip there is no safe way to abandon it.
+    """
+    code = "import jax; jax.devices()"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def init_backend(n_cpu_devices: int = 8, probe_timeout: float = 120.0) -> str:
+    """Bring up the accelerator if reachable, else force CPU.  Returns the
+    active platform name ("tpu"/"cpu")."""
+    import jax
+
+    if probe_accelerator(probe_timeout):
+        try:
+            jax.devices()
+            return jax.default_backend()
+        except RuntimeError:
+            pass
+    force_cpu(n_cpu_devices)
+    jax.devices()
+    return "cpu"
