@@ -7,6 +7,29 @@ Import style parity:  ``import mxnet_tpu as mx`` then ``mx.nd``, ``mx.gluon``,
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+# ---- env knobs honored at import (documented in docs/env_vars.md; the
+# MXNET_* runtime-knob surface of SURVEY.md §5.6.2, TPU-relevant subset) --
+if _os.environ.get("MXNET_TPU_PLATFORM"):
+    # force a jax platform before any device touch (the axon TPU plugin
+    # ignores JAX_PLATFORMS, so offer a knob that actually works)
+    import jax as _jax
+    _jax.config.update("jax_platforms", _os.environ["MXNET_TPU_PLATFORM"])
+if _os.environ.get("MXNET_TPU_COMPILE_CACHE"):
+    import jax as _jax
+    try:
+        _jax.config.update("jax_compilation_cache_dir",
+                           _os.environ["MXNET_TPU_COMPILE_CACHE"])
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.5)
+    except Exception:
+        pass  # older jax: cache knobs absent — degrade to no cache
+if _os.environ.get("MXNET_ENGINE_TYPE", "").lower() == "naiveengine":
+    # SURVEY.md §5.2: the fully synchronous debug engine ≡ no XLA staging
+    import jax as _jax
+    _jax.config.update("jax_disable_jit", True)
+
 from . import base
 from .base import MXNetError
 from .context import Context, Device, cpu, gpu, tpu, num_gpus, num_tpus, \

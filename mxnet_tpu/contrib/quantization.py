@@ -25,7 +25,8 @@ from ..ndarray import NDArray
 from ..ndarray.ops import _as_nd, invoke
 
 __all__ = ["quantize", "quantize_v2", "dequantize", "requantize",
-           "calib_entropy_threshold", "quantize_net", "QuantizedDense"]
+           "calib_entropy_threshold", "quantize_net", "QuantizedDense",
+           "QuantizedConv2D", "quantized_pooling"]
 
 
 # ------------------------------------------------------------------- ops
@@ -295,6 +296,111 @@ class QuantizedDense(HybridBlock):
                 f"{self._units}, int8)")
 
 
+class QuantizedConv2D(HybridBlock):
+    """int8-weight NCHW convolution: per-output-channel weight scales,
+    int32 MXU accumulation via ``lax.conv_general_dilated(...,
+    preferred_element_type=int32)``, f32 scale+bias epilogue (parity:
+    _contrib_quantized_conv / src/operator/quantization/quantized_conv.cc —
+    upstream runs these through cuDNN/oneDNN int8; the MXU int8 path is
+    the TPU-native analogue)."""
+
+    def __init__(self, conv, min_calib=None, max_calib=None, **kwargs):
+        super().__init__(**kwargs)
+        from ..ndarray import array as nd_array
+        wnp = conv.weight.data().asnumpy()          # (O, I/g, kH, kW)
+        # per-output-channel symmetric scales: tighter than the per-tensor
+        # range upstream uses, still a broadcast f32 epilogue on TPU
+        absmax = onp.maximum(onp.abs(wnp).reshape(wnp.shape[0], -1)
+                             .max(axis=1), 1e-8)
+        w_scale = (absmax / 127.0).astype(onp.float32)
+        wq = onp.clip(onp.round(wnp / w_scale[:, None, None, None]),
+                      -127, 127).astype(onp.int8)
+        self.qweight = self.params.get("qweight", shape=wq.shape,
+                                       dtype="int8", grad_req="null")
+        self.qweight.set_data(nd_array(wq, dtype="int8"))
+        self.wscale = self.params.get("wscale", shape=w_scale.shape,
+                                      dtype="float32", grad_req="null")
+        self.wscale.set_data(nd_array(w_scale))
+        self.acts_range = self.params.get("acts_range", shape=(2,),
+                                          dtype="float32", grad_req="null")
+        self.acts_range.set_data(nd_array(
+            [float("nan") if min_calib is None else min_calib,
+             float("nan") if max_calib is None else max_calib]))
+        if conv.bias is not None:
+            bnp = conv.bias.data().asnumpy()
+            self.bias = self.params.get("bias", shape=bnp.shape,
+                                        dtype="float32", grad_req="null")
+            self.bias.set_data(nd_array(bnp))
+        else:
+            self.bias = None
+        self._strides = conv._strides
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._channels = conv._channels
+        self._activation = conv._activation
+
+    def forward(self, x):
+        x = _as_nd(x)
+        wq = self.qweight.data().jax
+        w_scale = self.wscale.data().jax
+        bias = None if self.bias is None else self.bias.data().jax
+        crange = self.acts_range.data().jax
+        stride, pad, dil, groups = (self._strides, self._padding,
+                                    self._dilation, self._groups)
+
+        def f(xv):
+            dyn = jnp.maximum(jnp.max(jnp.abs(xv)), 1e-8)
+            calib = jnp.maximum(jnp.abs(crange[0]), jnp.abs(crange[1]))
+            amax = jnp.where(jnp.isnan(crange[0]), dyn, calib)
+            x_scale = amax / 127.0
+            xq = jnp.clip(jnp.round(xv / x_scale), -127, 127).astype(
+                jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, wq, window_strides=stride,
+                padding=tuple((p, p) for p in pad), rhs_dilation=dil,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * \
+                (x_scale * w_scale)[None, :, None, None]
+            if bias is not None:
+                out = out + bias[None, :, None, None]
+            if self._activation is not None:
+                from ..ndarray.ops import ACTIVATION_FNS
+                out = ACTIVATION_FNS[self._activation](out)
+            return out
+
+        return invoke("quantized_conv", f, [x], differentiable=False)
+
+    def __repr__(self):
+        return f"QuantizedConv2D({self._channels} ch, int8)"
+
+
+def quantized_pooling(qdata, min_range, max_range, kernel=None, stride=None,
+                      pad=None, pool_type="max", global_pool=False):
+    """Pooling on int8 data keeping the (q, min, max) triple (parity:
+    _contrib_quantized_pooling / quantized_pooling.cc).  Max pooling is
+    order-preserving so it runs directly on int8; avg pooling accumulates
+    in int32 and rounds back to the same scale."""
+    from ..ndarray.ops import Pooling
+    qdata, min_range, max_range = (_as_nd(x) for x in
+                                   (qdata, min_range, max_range))
+    if pool_type == "max":
+        out = Pooling(qdata.astype("int32"), kernel=kernel, stride=stride,
+                      pad=pad, pool_type="max", global_pool=global_pool)
+        out = out.astype("int8")
+    elif pool_type == "avg":
+        acc = Pooling(qdata.astype("float32"), kernel=kernel, stride=stride,
+                      pad=pad, pool_type="avg", global_pool=global_pool)
+        out = invoke("quantized_avg_round",
+                     lambda a: jnp.clip(jnp.round(a), -127, 127)
+                     .astype(jnp.int8), [acc], differentiable=False)
+    else:
+        raise _base.MXNetError(f"unsupported pool_type {pool_type}")
+    return out, min_range, max_range
+
+
 def quantize_net(net, calib_data=None, calib_mode="naive",
                  quantized_dtype="int8", exclude_layers=None,
                  num_calib_batches=None):
@@ -312,10 +418,12 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     calib_iter = iter(calib_data) if calib_data is not None else None
     first_batch = next(calib_iter, None) if calib_iter is not None else None
 
+    from ..gluon.nn import Conv2D
+
     def walk(block, prefix=""):
         for name, child in list(block._children.items()):
             path = f"{prefix}{name}"
-            if isinstance(child, Dense) and path not in exclude:
+            if isinstance(child, (Dense, Conv2D)) and path not in exclude:
                 if child.weight._data is not None:
                     targets.append((block, name, path, child))
                 else:
@@ -334,7 +442,7 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
         walk(net)
     if deferred:
         raise _base.MXNetError(
-            f"Dense layers {deferred} have uninitialized (deferred) "
+            f"Dense/Conv2D layers {deferred} have uninitialized (deferred) "
             "weights — run a forward pass or pass calib_data so "
             "quantize_net can see their shapes")
 
@@ -365,11 +473,12 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
                 dense._forward_pre_hooks.remove(h)
         ranges = collector.ranges()
 
-    for parent, attr, path, dense in targets:
+    for parent, attr, path, layer in targets:
         r = ranges.get(path)
-        q = QuantizedDense(dense, min_calib=r[0] if r else None,
-                           max_calib=r[1] if r else None)
+        cls = QuantizedDense if isinstance(layer, Dense) else QuantizedConv2D
+        q = cls(layer, min_calib=r[0] if r else None,
+                max_calib=r[1] if r else None)
         parent.register_child(q, attr)
-        if getattr(parent, attr, None) is dense:
+        if getattr(parent, attr, None) is layer:
             setattr(parent, attr, q)
     return net

@@ -315,3 +315,14 @@ class ImageIter(DataIter):
         self._pos += self.batch_size
         return DataBatch([nd_array(onp.stack(datas))],
                          [nd_array(onp.asarray(labels, onp.float32))])
+
+
+# detection pipeline (parity: python/mxnet/image/detection.py) — imported
+# last so it can reuse the augmenter/iterator machinery above
+from .detection import (CreateDetAugmenter, DetAugmenter,  # noqa: E402
+                        DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug,
+                        DetRandomSelectAug, ImageDetIter)
+__all__ += ["CreateDetAugmenter", "DetAugmenter", "DetBorrowAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "DetRandomSelectAug", "ImageDetIter"]

@@ -292,7 +292,7 @@ class ImageRecordIter(DataIter):
                  path_imgidx=None, shuffle=False, rand_crop=False,
                  rand_mirror=False, mean_r=0., mean_g=0., mean_b=0.,
                  std_r=1., std_g=1., std_b=1., resize=-1,
-                 label_width=1, preprocess_threads=4, seed=0, **kwargs):
+                 label_width=1, preprocess_threads=None, seed=0, **kwargs):
         super().__init__(batch_size)
         from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
         self._unpack_img = unpack_img
@@ -307,6 +307,15 @@ class ImageRecordIter(DataIter):
                              dtype=onp.float32).reshape(3, 1, 1)
         self.shuffle = shuffle
         self.rng = onp.random.RandomState(seed)
+        # MXNET_CPU_WORKER_NTHREADS keeps the upstream knob name
+        # (SURVEY.md §5.6.2): a DEFAULT for the decode pool size — an
+        # explicit preprocess_threads argument wins
+        if preprocess_threads is None:
+            try:
+                preprocess_threads = int(
+                    os.environ.get("MXNET_CPU_WORKER_NTHREADS", 4))
+            except ValueError:
+                preprocess_threads = 4
         self.n_threads = max(1, preprocess_threads)
         self._path = path_imgrec
         # native C++ fast path: offset scan + threaded pread/decode/augment

@@ -2,6 +2,7 @@
 from .alexnet import AlexNet, alexnet
 from .densenet import (DenseNet, densenet121, densenet161, densenet169,
                        densenet201)
+from .inception import Inception3, inception_v3
 from .mlp import MLP
 from .mobilenet import (MobileNet, MobileNetV2, mobilenet0_25, mobilenet0_5,
                         mobilenet0_75, mobilenet1_0, mobilenet_v2_0_25,
@@ -27,7 +28,8 @@ _models = {name: globals()[name] for name in (
     "densenet121", "densenet161", "densenet169", "densenet201",
     "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
     "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
-    "mobilenet_v2_0_25")}
+    "mobilenet_v2_0_25",
+    "inception_v3")}
 
 
 def get_model(name, **kwargs):

@@ -12,17 +12,31 @@ from .ndarray import NDArray, array
 
 __all__ = ["default_rtol", "default_atol", "assert_almost_equal",
            "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
-           "with_seed", "same"]
+           "with_seed", "same", "check_consistency"]
+
+
+def _as_dtype(dtype):
+    """np.dtype that also understands 'bfloat16' (via ml_dtypes)."""
+    if str(dtype) == "bfloat16":
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(dtype)
 
 
 def default_rtol(dtype=onp.float32):
+    dtype = _as_dtype(dtype)
+    if dtype.name == "bfloat16":
+        return 2e-2
     return {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
-            onp.dtype(onp.float64): 1e-6}.get(onp.dtype(dtype), 1e-4)
+            onp.dtype(onp.float64): 1e-6}.get(dtype, 1e-4)
 
 
 def default_atol(dtype=onp.float32):
+    dtype = _as_dtype(dtype)
+    if dtype.name == "bfloat16":
+        return 2e-2
     return {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-5,
-            onp.dtype(onp.float64): 1e-7}.get(onp.dtype(dtype), 1e-5)
+            onp.dtype(onp.float64): 1e-7}.get(dtype, 1e-5)
 
 
 def _np(x):
@@ -86,6 +100,79 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
         onp.testing.assert_allclose(analytic[k], num_grad, rtol=rtol,
                                     atol=atol,
                                     err_msg=f"gradient of input {k}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, dtypes=None, rtol=None,
+                      atol=None, grad=True):
+    """Cross-backend/dtype consistency check (parity:
+    python/mxnet/test_utils.py check_consistency + the GPU-suite pattern of
+    tests/python/gpu/test_operator_gpu.py, SURVEY.md §4).
+
+    Runs ``fn`` (NDArrays in → NDArray out) under every (context, dtype)
+    configuration and cross-compares outputs — and, when ``grad``, input
+    gradients — against the first configuration.  On a TPU host the default
+    ctx_list is [cpu, tpu(0)], i.e. the same op executes on both XLA
+    backends in ONE process (JAX keeps both live — no suite re-import
+    needed, unlike the reference's re-run-under-GPU-scope trick).  On a
+    CPU-only host it degrades to a dtype-consistency check.
+
+    Returns the list of (ctx, dtype, outputs, grads) tuples for callers
+    that want to inspect further.
+    """
+    from . import autograd, context as ctx_mod
+
+    if ctx_list is None:
+        ctx_list = [ctx_mod.cpu()]
+        if ctx_mod.num_tpus():
+            ctx_list.append(ctx_mod.tpu(0))
+    dtypes = list(dtypes or ["float32"])
+    inputs = [_np(x) for x in inputs]
+
+    results = []
+    for ctx in ctx_list:
+        for dt in dtypes:
+            xs = []
+            for a in inputs:
+                cast = a.astype(_as_dtype(dt)) if onp.issubdtype(
+                    a.dtype, onp.floating) else a
+                xs.append(array(cast, ctx=ctx))
+            if grad:
+                for x in xs:
+                    x.attach_grad()
+                with autograd.record():
+                    out = fn(*xs)
+                    outs = list(out) if isinstance(out, (tuple, list)) \
+                        else [out]
+                    head = outs[0].sum() if outs[0].size > 1 else outs[0]
+                head.backward()
+                grads = [x.grad.asnumpy().astype(onp.float64)
+                         if x.grad is not None else None for x in xs]
+            else:
+                out = fn(*xs)
+                outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                grads = None
+            results.append((ctx, dt,
+                            [o.asnumpy().astype(onp.float64) for o in outs],
+                            grads))
+
+    ref_ctx, ref_dt, ref_outs, ref_grads = results[0]
+    for ctx, dt, outs, grads in results[1:]:
+        rt = rtol if rtol is not None else max(default_rtol(dt),
+                                               default_rtol(ref_dt))
+        at = atol if atol is not None else max(default_atol(dt),
+                                               default_atol(ref_dt))
+        for i, (a, b) in enumerate(zip(ref_outs, outs)):
+            onp.testing.assert_allclose(
+                b, a, rtol=rt, atol=at,
+                err_msg=f"output {i}: {ctx}/{dt} vs {ref_ctx}/{ref_dt}")
+        if grad and ref_grads is not None:
+            for i, (a, b) in enumerate(zip(ref_grads, grads)):
+                if a is None or b is None:
+                    continue
+                onp.testing.assert_allclose(
+                    b, a, rtol=rt, atol=at,
+                    err_msg=f"grad {i}: {ctx}/{dt} vs {ref_ctx}/{ref_dt}")
+    return results
 
 
 def with_seed(seed=None):

@@ -6,7 +6,47 @@ import inspect
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "use_np",
            "np_array", "np_shape", "get_gpu_count", "get_gpu_memory",
-           "getenv", "setenv", "default_array"]
+           "getenv", "setenv", "default_array", "disable_jit",
+           "enable_jit"]
+
+
+class _JitOffScope:
+    def __init__(self, prior: bool):
+        self._prior = prior
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        # restore the PRIOR state (an env-configured NaiveEngine process
+        # stays jit-disabled after an inner scope exits)
+        import jax
+        jax.config.update("jax_disable_jit", self._prior)
+        return False
+
+
+def disable_jit():
+    """Debug lever ≈ ``MXNET_ENGINE_TYPE=NaiveEngine`` (SURVEY.md §5.2):
+    run everything op-by-op with no XLA staging — the first switch to flip
+    when isolating a scheduling/tracing bug.  Acts immediately; use as a
+    context manager to restore on exit, or call :func:`enable_jit` later.
+
+        with mx.util.disable_jit():
+            net(x)      # eager, debuggable, prints work
+
+    Also settable at import time via ``MXNET_ENGINE_TYPE=NaiveEngine``.
+    """
+    import jax
+    prior = bool(jax.config.jax_disable_jit)
+    jax.config.update("jax_disable_jit", True)
+    return _JitOffScope(prior)
+
+
+def enable_jit():
+    """Undo a non-contextmanager :func:`disable_jit` (clears the global
+    jax_disable_jit flag, e.g. one set via MXNET_ENGINE_TYPE)."""
+    import jax
+    jax.config.update("jax_disable_jit", False)
 
 
 def _npx():

@@ -173,7 +173,9 @@ class NativeImagePipeline:
     def __init__(self, path, offsets, lengths, data_shape, resize=-1,
                  rand_crop=False, rand_mirror=False,
                  mean=(0., 0., 0.), std=(1., 1., 1.), seed=0,
-                 label_width=1, threads=4, capacity=256):
+                 label_width=1, threads=4, capacity=None):
+        if capacity is None:   # MXNET_TPU_PREFETCH: decoded-sample buffer
+            capacity = int(os.environ.get("MXNET_TPU_PREFETCH", 256))
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native IO library unavailable")

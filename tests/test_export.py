@@ -1,0 +1,133 @@
+"""Export surface (VERDICT #7): HybridBlock.export → SymbolBlock.imports
+roundtrip, symbolic-batch reload, and jit-cache discipline (CachedOp per-
+signature entries = the per-bucket bound executors of BucketingModule).
+
+Parity: HybridBlock.export / SymbolBlock.imports
+(python/mxnet/gluon/block.py) + bucketing_module.py (SURVEY.md §5.4, §2.2).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import SymbolBlock
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize()
+    return net
+
+
+def test_export_imports_roundtrip_mlp(tmp_path):
+    net = _mlp()
+    x = nd.array(onp.random.RandomState(0).uniform(-1, 1, (4, 16))
+                 .astype("f"))
+    ref = net(x)                       # fixes the export signature
+    sym_f, par_f = net.export(str(tmp_path / "mlp"))
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    out = blk(x)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_export_symbolic_batch_other_batch_size(tmp_path):
+    net = _mlp()
+    rs = onp.random.RandomState(1)
+    net(nd.array(rs.uniform(-1, 1, (4, 16)).astype("f")))
+    sym_f, par_f = net.export(str(tmp_path / "mlp"))
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    x9 = nd.array(rs.uniform(-1, 1, (9, 16)).astype("f"))
+    onp.testing.assert_allclose(blk(x9).asnumpy(), net(x9).asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_export_imports_conv_net(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(5))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(2).uniform(-1, 1, (2, 3, 16, 16))
+                 .astype("f"))
+    ref = net(x)
+    sym_f, par_f = net.export(str(tmp_path / "cnn"))
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    onp.testing.assert_allclose(blk(x).asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_export_matches_after_reload_into_fresh_process_state(tmp_path):
+    """Imports must not depend on live Python model state: mutate the
+    original net after export and check the import still matches the
+    exported snapshot."""
+    net = _mlp()
+    x = nd.array(onp.random.RandomState(3).uniform(-1, 1, (4, 16))
+                 .astype("f"))
+    ref = net(x).asnumpy()
+    sym_f, par_f = net.export(str(tmp_path / "m"))
+    # perturb the live params
+    for _, p in net.collect_params().items():
+        p.set_data(p.data() * 0.0)
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    onp.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_cached_op_jit_cache_per_shape():
+    """hybridize() compiles once per input signature and reuses it —
+    static_alloc/static_shape economics (parity: CachedOp, SURVEY §2.2)."""
+    net = _mlp()
+    net.hybridize()
+    rs = onp.random.RandomState(4)
+    net(nd.array(rs.uniform(-1, 1, (4, 16)).astype("f")))
+    cop = net._cached_op
+    assert cop is not None and len(cop._jit_cache) == 1
+    # same signature → cache hit, no new entry
+    net(nd.array(rs.uniform(-1, 1, (4, 16)).astype("f")))
+    assert len(cop._jit_cache) == 1
+    # new batch size → one more entry (bucketed-shape discipline)
+    net(nd.array(rs.uniform(-1, 1, (7, 16)).astype("f")))
+    assert len(cop._jit_cache) == 2
+    net(nd.array(rs.uniform(-1, 1, (7, 16)).astype("f")))
+    assert len(cop._jit_cache) == 2
+
+
+def test_bucketing_module_bucket_cache():
+    """BucketingModule keeps ONE bound module per bucket key and reuses it
+    on revisits (parity: bucketing_module.py's per-bucket executors; the
+    values-shared assertion lives in test_io_module.test_bucketing_module)."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+    from mxnet_tpu.module import BucketingModule
+    sym = mx.sym
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        w = sym.Variable("w", shape=(4, 8))
+        fc = sym.FullyConnected(
+            sym.reshape(data, shape=(-1, 8)), w, None, num_hidden=4,
+            no_bias=True)
+        return sym.softmax(fc, axis=-1), ("data",), ()
+
+    mod = BucketingModule(sym_gen, default_bucket_key=8)
+    rs = onp.random.RandomState(5)
+
+    def batch(seq):
+        b = DataBatch([nd.array(rs.uniform(-1, 1, (2, seq)).astype("f"))],
+                      provide_data=[DataDesc("data", (2, seq))],
+                      provide_label=[])
+        b.bucket_key = seq
+        return b
+
+    mod.bind(data_shapes=[DataDesc("data", (2, 8))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.forward(batch(8), is_train=False)
+    assert set(mod._buckets) == {8}
+    mod.forward(batch(16), is_train=False)
+    assert set(mod._buckets) == {8, 16}
+    # revisiting a bucket reuses the bound module (no new entries)
+    m16 = mod._buckets[16]
+    mod.forward(batch(16), is_train=False)
+    assert mod._buckets[16] is m16 and len(mod._buckets) == 2

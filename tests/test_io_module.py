@@ -274,3 +274,31 @@ def test_runtime_features():
     assert feats["CPU"].enabled
     assert "PALLAS" in feats
     assert isinstance(mx.runtime.feature_list(), list)
+
+
+def test_disable_jit_debug_lever():
+    """mx.util.disable_jit ≈ MXNET_ENGINE_TYPE=NaiveEngine (SURVEY §5.2)."""
+    import jax
+    from mxnet_tpu import util
+    net_in = nd.array(onp.ones((2, 3), onp.float32))
+    assert not jax.config.jax_disable_jit
+    with util.disable_jit():
+        assert jax.config.jax_disable_jit
+        out = (net_in * 2).sum()
+        assert float(out.asnumpy()) == 12.0
+    assert not jax.config.jax_disable_jit
+
+
+def test_engine_type_env_knob():
+    """MXNET_ENGINE_TYPE=NaiveEngine disables staging at import time."""
+    import subprocess, sys, os
+    code = ("import jax, mxnet_tpu; "
+            "print(bool(jax.config.jax_disable_jit))")
+    env = dict(os.environ, MXNET_ENGINE_TYPE="NaiveEngine",
+               MXNET_TPU_PLATFORM="cpu")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().endswith("True")

@@ -5,6 +5,8 @@ forward pass, check the output head).
 import numpy as onp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.models import get_model
@@ -19,6 +21,7 @@ _CASES = [
     ("densenet121", 64),
     ("mobilenet1_0", 64), ("mobilenet0_25", 64),
     ("mobilenet_v2_1_0", 64), ("mobilenet_v2_0_5", 64),
+    ("inception_v3", 128),
 ]
 
 
@@ -37,7 +40,7 @@ def test_model_zoo_registry_complete():
     from mxnet_tpu.models.vision import _models
     for family in ("alexnet", "vgg16", "vgg19_bn", "squeezenet1_1",
                    "densenet201", "mobilenet0_5", "mobilenet_v2_0_75",
-                   "resnet152_v2"):
+                   "resnet152_v2", "inception_v3"):
         assert family in _models
     with pytest.raises(ValueError):
         get_model("resnet20_v9")

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as onp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd, parallel as par
 from mxnet_tpu.models import (MoELayer, get_gpt2, get_stacked_gpt2,

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as onp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import mxnet_tpu as mx
 from mxnet_tpu import nd, parallel as par
 from mxnet_tpu.ops.attention import _attention_ref
