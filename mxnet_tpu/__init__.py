@@ -69,6 +69,8 @@ _LAZY = {
     "util": ".util",
     "contrib": ".contrib",
     "operator": ".operator",
+    "onnx": ".onnx",
+    "subgraph": ".subgraph",
     "library": ".library",
 }
 

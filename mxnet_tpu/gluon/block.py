@@ -252,8 +252,17 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._cached_op = None
 
-    def optimize_for(self, x, *args, backend=None, **kwargs):
-        self.hybridize(True, **kwargs)
+    def optimize_for(self, x, *args, backend=None, static_alloc=False,
+                     static_shape=False, **kwargs):
+        """Partition/rewrite via a registered subgraph backend, then
+        hybridize and run one forward (parity: HybridBlock.optimize_for →
+        build_subgraph.cc; backends live in mxnet_tpu.subgraph —
+        'FUSE_BN', 'INT8', or user-registered SubgraphProperty)."""
+        if backend is not None:
+            from .. import subgraph as _subgraph
+            _subgraph.optimize_for(self, backend, **kwargs)
+        self.hybridize(True, static_alloc=static_alloc,
+                       static_shape=static_shape)
         return self(x, *args)
 
     # forward dispatch ------------------------------------------------------

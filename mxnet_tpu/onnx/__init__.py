@@ -1,0 +1,12 @@
+"""``mx.onnx`` — ONNX export/import (parity: python/mxnet/onnx with
+mx2onnx + onnx2mx, SURVEY.md §2.6 misc user surface).
+
+- :func:`export_model` traces a Gluon block to jaxpr and emits standard
+  ONNX (file-format compatible with stock onnx/onnxruntime; this image
+  ships neither, so the wire layer is self-contained in proto.py).
+- :func:`import_model` loads an ONNX file into a jit-executed callable.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import ONNXBlock, import_model
+
+__all__ = ["export_model", "import_model", "ONNXBlock"]

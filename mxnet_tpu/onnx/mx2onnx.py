@@ -1,0 +1,354 @@
+"""mx2onnx: export a Gluon block to ONNX (parity: python/mxnet/onnx
+mx2onnx, SURVEY.md §2.6 misc user surface).
+
+TPU-native route: instead of walking an NNVM symbol graph, the model is
+traced to a **jaxpr** (the same trace hybridize compiles) and each jax
+primitive is emitted as standard ONNX ops — so any forward() code
+exports, not just a fixed layer vocabulary.  Parameters become
+initializers; the batch dimension is exported as written (ONNX reshapes
+are shape-literal).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+
+from .. import base as _base
+from . import proto
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List = []
+        self.initializers: List = []
+        self.names: Dict[int, str] = {}   # id(jax var) -> onnx name
+        self.counter = 0
+
+    # ------------------------------------------------------------ helpers
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor(name, onp.asarray(arr)))
+        return name
+
+    def name_of(self, v):
+        """ONNX name for a jaxpr atom (var or literal)."""
+        from jax._src.core import Literal
+        if isinstance(v, Literal):
+            val = onp.asarray(v.val)
+            return self.const(val, "lit")
+        if id(v) not in self.names:
+            self.names[id(v)] = self.fresh("v")
+        return self.names[id(v)]
+
+    def emit(self, op, ins, n_out=1, **attrs):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node(op, ins, outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+    def bind(self, var, name):
+        self.names[id(var)] = name
+
+    # ------------------------------------------------------------ eqns
+    def convert(self, jaxpr, consts):
+        for cv, cval in zip(jaxpr.constvars, consts):
+            self.bind(cv, self.const(onp.asarray(cval), "w"))
+        for eq in jaxpr.eqns:
+            self.eqn(eq)
+
+    def eqn(self, eq):
+        p = eq.primitive.name
+        ins = [self.name_of(v) for v in eq.invars]
+        params = eq.params
+
+        def out(name):
+            self.bind(eq.outvars[0], name)
+
+        simple = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+                  "max": "Max", "min": "Min", "exp": "Exp", "log": "Log",
+                  "tanh": "Tanh", "logistic": "Sigmoid", "erf": "Erf",
+                  "neg": "Neg", "abs": "Abs", "sqrt": "Sqrt",
+                  "sign": "Sign", "floor": "Floor", "ceil": "Ceil",
+                  "stop_gradient": "Identity", "copy": "Identity",
+                  "gt": "Greater", "lt": "Less", "eq": "Equal",
+                  "pow": "Pow", "and": "And", "or": "Or", "not": "Not"}
+        if p in simple:
+            return out(self.emit(simple[p], ins))
+        if p == "rsqrt":
+            s = self.emit("Sqrt", ins)
+            return out(self.emit("Reciprocal", [s]))
+        if p == "ge":      # Greater || Equal — via Less + Not
+            l = self.emit("Less", ins)
+            return out(self.emit("Not", [l]))
+        if p == "le":
+            g = self.emit("Greater", ins)
+            return out(self.emit("Not", [g]))
+        if p == "integer_pow":
+            y = params["y"]
+            if y == 2:
+                return out(self.emit("Mul", [ins[0], ins[0]]))
+            e = self.const(onp.asarray(float(y), onp.float32))
+            return out(self.emit("Pow", [ins[0], e]))
+        if p == "select_n":
+            # select_n(pred, x0, x1): pred True → x1
+            return out(self.emit("Where", [ins[0], ins[2], ins[1]]))
+        if p == "convert_element_type":
+            to = proto.NP2ONNX[onp.dtype(params["new_dtype"])]
+            return out(self.emit("Cast", ins, to=to))
+        if p == "reshape":
+            shp = self.const(onp.asarray(params["new_sizes"], onp.int64))
+            return out(self.emit("Reshape", [ins[0], shp]))
+        if p == "squeeze":
+            axes = self.const(onp.asarray(params["dimensions"], onp.int64))
+            return out(self.emit("Squeeze", [ins[0], axes]))
+        if p == "expand_dims":
+            axes = self.const(onp.asarray(params["dimensions"], onp.int64))
+            return out(self.emit("Unsqueeze", [ins[0], axes]))
+        if p == "transpose":
+            return out(self.emit("Transpose", ins,
+                                 perm=list(params["permutation"])))
+        if p == "broadcast_in_dim":
+            shape = list(params["shape"])
+            bdims = list(params["broadcast_dimensions"])
+            in_aval = eq.invars[0].aval
+            # align rank: reshape so input dims land on broadcast_dimensions
+            inter = [1] * len(shape)
+            for src, dst in enumerate(bdims):
+                inter[dst] = in_aval.shape[src]
+            cur = ins[0]
+            if list(in_aval.shape) != inter:
+                shp = self.const(onp.asarray(inter, onp.int64))
+                cur = self.emit("Reshape", [cur, shp])
+            if inter != shape:
+                tgt = self.const(onp.asarray(shape, onp.int64))
+                cur = self.emit("Expand", [cur, tgt])
+            return out(cur)
+        if p == "concatenate":
+            return out(self.emit("Concat", ins,
+                                 axis=int(params["dimension"])))
+        if p == "slice":
+            starts = self.const(onp.asarray(params["start_indices"],
+                                            onp.int64))
+            ends = self.const(onp.asarray(params["limit_indices"],
+                                          onp.int64))
+            axes = self.const(onp.arange(len(params["start_indices"]),
+                                         dtype=onp.int64))
+            strides = params.get("strides") or \
+                [1] * len(params["start_indices"])
+            steps = self.const(onp.asarray(strides, onp.int64))
+            return out(self.emit("Slice",
+                                 [ins[0], starts, ends, axes, steps]))
+        if p == "pad":
+            cfg = params["padding_config"]
+            if any(i != 0 for _, _, i in cfg):
+                raise _base.MXNetError("interior pad not exportable")
+            pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+            if min(pads) < 0:
+                raise _base.MXNetError("negative pad not exportable")
+            pv = self.const(onp.asarray(pads, onp.int64))
+            return out(self.emit("Pad", [ins[0], pv, ins[1]]))
+        if p in ("reduce_sum", "reduce_max", "reduce_min", "reduce_mean"):
+            opn = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+                   "reduce_min": "ReduceMin",
+                   "reduce_mean": "ReduceMean"}[p]
+            axes = self.const(onp.asarray(params["axes"], onp.int64))
+            return out(self.emit(opn, [ins[0], axes], keepdims=0))
+        if p == "argmax":
+            return out(self.emit("ArgMax", ins,
+                                 axis=int(params["axes"][0]), keepdims=0))
+        if p == "reduce_window_max":
+            return out(self._pool(eq, ins, "MaxPool"))
+        if p == "reduce_window_sum":
+            # Sum pool = AveragePool * window_size
+            a = self._pool(eq, ins, "AveragePool")
+            wd = params["window_dimensions"]
+            k = float(onp.prod([d for d in wd if d > 1] or [1]))
+            kc = self.const(onp.asarray(k, onp.float32))
+            return out(self.emit("Mul", [a, kc]))
+        if p == "conv_general_dilated":
+            return out(self._conv(eq, ins))
+        if p == "dot_general":
+            return out(self._dot(eq, ins))
+        if p in ("jit", "pjit", "closed_call", "core_call", "remat",
+                 "checkpoint", "custom_jvp_call", "custom_vjp_call",
+                 "custom_vjp_call_jaxpr"):
+            return self._inline(eq, ins)
+        raise _base.MXNetError(
+            f"ONNX export: unsupported jax primitive {p!r}")
+
+    # --------------------------------------------------------- compound
+    def _pool(self, eq, ins, opn):
+        params = eq.params
+        wd = list(params["window_dimensions"])
+        ws = list(params["window_strides"])
+        pad = list(params["padding"])
+        bd = params.get("base_dilation")
+        wdl = params.get("window_dilation")
+        if bd and any(d != 1 for d in bd):
+            raise _base.MXNetError("pool base_dilation not exportable")
+        if wdl and any(d != 1 for d in wdl):
+            raise _base.MXNetError("pool window_dilation not exportable")
+        # window must cover trailing spatial dims only (NCHW)
+        if wd[0] != 1 or wd[1] != 1:
+            raise _base.MXNetError(
+                f"pool window over batch/channel dims not exportable {wd}")
+        kernel = wd[2:]
+        strides = ws[2:]
+        pads = [lo for lo, _ in pad[2:]] + [hi for _, hi in pad[2:]]
+        kw = dict(kernel_shape=kernel, strides=strides, pads=pads)
+        if opn == "AveragePool":
+            kw["count_include_pad"] = 1
+        return self.emit(opn, [ins[0]], **kw)
+
+    def _conv(self, eq, ins):
+        params = eq.params
+        dn = params["dimension_numbers"]
+        lhs_spec, rhs_spec, out_spec = dn
+        nd = len(lhs_spec) - 2
+        want_lhs = tuple([0, 1] + list(range(2, nd + 2)))
+        if (tuple(lhs_spec) != want_lhs or tuple(rhs_spec) != want_lhs or
+                tuple(out_spec) != want_lhs):
+            raise _base.MXNetError(
+                f"conv dimension_numbers {dn} not NCHW/OIHW")
+        if any(d != 1 for d in params["lhs_dilation"]):
+            raise _base.MXNetError("transposed conv not exportable yet")
+        pads = [lo for lo, _ in params["padding"]] + \
+            [hi for _, hi in params["padding"]]
+        return self.emit(
+            "Conv", ins, kernel_shape=list(eq.invars[1].aval.shape[2:]),
+            strides=list(params["window_strides"]),
+            dilations=list(params["rhs_dilation"]), pads=pads,
+            group=int(params["feature_group_count"]))
+
+    def _dot(self, eq, ins):
+        (lc, rc), (lb, rb) = eq.params["dimension_numbers"]
+        ln = len(eq.invars[0].aval.shape)
+        rn = len(eq.invars[1].aval.shape)
+        letters = "abcdefghijklmnopqrstuvwxyz"
+        names = {}
+        idx = 0
+
+        def letter(side, d):
+            nonlocal idx
+            if (side, d) not in names:
+                names[(side, d)] = letters[idx]
+                idx += 1
+            return names[(side, d)]
+
+        for bl, br in zip(lb, rb):
+            names[("r", br)] = letter("l", bl)
+        for cl, cr in zip(lc, rc):
+            names[("r", cr)] = letter("l", cl)
+        lhs = "".join(letter("l", d) for d in range(ln))
+        rhs = "".join(letter("r", d) for d in range(rn))
+        out_l = [letter("l", d) for d in range(ln)
+                 if d not in lc and d not in lb]
+        out_r = [letter("r", d) for d in range(rn)
+                 if d not in rc and d not in rb]
+        batch = [letter("l", d) for d in lb]
+        eqn = f"{lhs},{rhs}->{''.join(batch + out_l + out_r)}"
+        return self.emit("Einsum", ins, equation=eqn)
+
+    def _inline(self, eq, ins):
+        params = eq.params
+        sub = params.get("jaxpr") or params.get("call_jaxpr") or \
+            params.get("fun_jaxpr")
+        if sub is None:
+            raise _base.MXNetError(
+                f"cannot inline call primitive {eq.primitive.name}")
+        consts = ()
+        inner = sub
+        if hasattr(sub, "jaxpr"):       # ClosedJaxpr
+            consts = sub.consts
+            inner = sub.jaxpr
+        for cv, cval in zip(inner.constvars, consts):
+            self.bind(cv, self.const(onp.asarray(cval), "w"))
+        n_in = len(inner.invars)
+        for v, nm in zip(inner.invars, ins[len(ins) - n_in:]):
+            self.bind(v, nm)
+        for e in inner.eqns:
+            self.eqn(e)
+        for ov, outer in zip(inner.outvars, eq.outvars):
+            self.bind(outer, self.name_of(ov))
+
+
+def export_model(net, path, input_shapes, input_dtype="float32",
+                 opset=13):
+    """Export an initialized Gluon block to ``path`` (ONNX file).
+
+    input_shapes: one shape tuple (single input) or a list of them.
+    Returns the path.  Inference semantics (training_mode False: BN uses
+    running stats, dropout is identity) — matching upstream
+    mx2onnx.export_model's export of inference graphs.
+    """
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import swap_values
+
+    if isinstance(input_shapes, tuple):
+        input_shapes = [input_shapes]
+    dt = onp.dtype(input_dtype)
+    xs = [jnp.asarray(onp.zeros(s, dt)) for s in input_shapes]
+
+    # settle deferred shapes
+    with _base.training_mode(False):
+        rec = _base.set_recording(False)
+        try:
+            net(*[NDArray(x) for x in xs])
+        finally:
+            _base.set_recording(rec)
+
+    items, seen = [], set()
+    for name, prm in net.collect_params().items():
+        if id(prm) in seen or prm._data is None:
+            continue
+        seen.add(id(prm))
+        items.append((name, prm))
+    pvals = tuple(prm._data.jax for _, prm in items)
+
+    def fwd(param_vals, *data):
+        with swap_values([prm._data for _, prm in items], param_vals):
+            with _base.training_mode(False):
+                rec = _base.set_recording(False)
+                try:
+                    outn = net.forward(*[NDArray(d) for d in data])
+                finally:
+                    _base.set_recording(rec)
+            outs = outn if isinstance(outn, (tuple, list)) else [outn]
+            return tuple(o.jax for o in outs)
+
+    closed = jax.make_jaxpr(fwd)(pvals, *xs)
+    cv = _Converter()
+    # bind params as named initializers, data as graph inputs
+    jaxpr = closed.jaxpr
+    flat_in = jaxpr.invars
+    n_params = len(pvals)
+    graph_inputs = []
+    for i, (name, prm) in enumerate(items):
+        nm = name.replace(".", "_")
+        cv.initializers.append(
+            proto.tensor(nm, onp.asarray(prm._data.jax)))
+        cv.bind(flat_in[i], nm)
+    for j, x in enumerate(xs):
+        nm = "data" if len(xs) == 1 else f"data{j}"
+        cv.bind(flat_in[n_params + j], nm)
+        graph_inputs.append(proto.value_info(nm, dt, x.shape))
+    cv.convert(jaxpr, closed.consts)
+
+    outputs = []
+    for k, ov in enumerate(jaxpr.outvars):
+        nm = cv.name_of(ov)
+        outputs.append(proto.value_info(
+            nm, onp.dtype(ov.aval.dtype), ov.aval.shape))
+    g = proto.graph(cv.nodes, "mxnet_tpu_export", cv.initializers,
+                    graph_inputs, outputs)
+    data = proto.model(g, opset=opset)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path

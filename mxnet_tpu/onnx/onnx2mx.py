@@ -1,0 +1,218 @@
+"""onnx2mx: import an ONNX model and run it with jax/XLA (parity:
+python/mxnet/onnx onnx2mx import_model, SURVEY.md §2.6).
+
+The imported graph executes as jnp ops (so it runs on TPU like any other
+block) over the op vocabulary mx2onnx emits plus common basics — also the
+in-repo verification path for exports, since the image ships no
+onnxruntime.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import base as _base
+from ..ndarray import NDArray
+from . import proto
+
+
+def _pool_args(attrs, nd_spatial):
+    k = attrs["kernel_shape"]
+    s = attrs.get("strides") or [1] * len(k)
+    pads = attrs.get("pads") or [0] * 2 * len(k)
+    n = len(k)
+    pairs = [(pads[i], pads[n + i]) for i in range(n)]
+    return k, s, pairs
+
+
+class _Evaluator:
+    def __init__(self, model):
+        self.graph = model["graph"]
+        self.opset = model["opset"]
+
+    def run(self, feeds: Dict[str, jnp.ndarray]):
+        # initializers stay CONCRETE numpy: under jit, jnp.asarray of an
+        # int64 array stages the int64→int32 conversion and yields a
+        # tracer, which shape-consuming ops (Reshape/Slice) must not see;
+        # numeric ops coerce numpy operands transparently
+        env: Dict[str, jnp.ndarray] = dict(self.graph["initializers"])
+        env.update({k: jnp.asarray(v) for k, v in feeds.items()})
+        for nd in self.graph["nodes"]:
+            outs = self.op(nd, [env[i] for i in nd["inputs"] if i])
+            if not isinstance(outs, (tuple, list)):
+                outs = [outs]
+            for name, val in zip(nd["outputs"], outs):
+                env[name] = val
+        return [env[name] for name, _, _ in self.graph["outputs"]]
+
+    def op(self, nd, x):
+        op = nd["op"]
+        a = nd["attrs"]
+        ew = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+              "Div": jnp.divide, "Max": jnp.maximum, "Min": jnp.minimum,
+              "Pow": jnp.power, "Exp": jnp.exp, "Log": jnp.log,
+              "Tanh": jnp.tanh, "Sqrt": jnp.sqrt, "Neg": jnp.negative,
+              "Abs": jnp.abs, "Sign": jnp.sign, "Floor": jnp.floor,
+              "Ceil": jnp.ceil, "Reciprocal": lambda v: 1.0 / v,
+              "Sigmoid": jax.nn.sigmoid, "Erf": jax.scipy.special.erf,
+              "Relu": jax.nn.relu, "Identity": lambda v: v,
+              "Greater": jnp.greater, "Less": jnp.less,
+              "Equal": jnp.equal, "Not": jnp.logical_not,
+              "And": jnp.logical_and, "Or": jnp.logical_or}
+        if op in ew:
+            return ew[op](*x)
+        if op == "Where":
+            return jnp.where(x[0], x[1], x[2])
+        if op == "Cast":
+            return x[0].astype(proto.ONNX2NP[int(a["to"])])
+        if op == "Reshape":
+            return jnp.reshape(x[0], [int(v) for v in onp.asarray(x[1])])
+        if op == "Squeeze":
+            axes = tuple(int(v) for v in onp.asarray(x[1])) if len(x) > 1 \
+                else tuple(a.get("axes", []))
+            return jnp.squeeze(x[0], axis=axes or None)
+        if op == "Unsqueeze":
+            axes = tuple(int(v) for v in onp.asarray(x[1])) if len(x) > 1 \
+                else tuple(a.get("axes", []))
+            return jnp.expand_dims(x[0], axis=axes)
+        if op == "Transpose":
+            return jnp.transpose(x[0], a.get("perm"))
+        if op == "Expand":
+            shape = [int(v) for v in onp.asarray(x[1])]
+            return jnp.broadcast_to(
+                x[0], onp.broadcast_shapes(tuple(x[0].shape),
+                                           tuple(shape)))
+        if op == "Concat":
+            return jnp.concatenate(x, axis=int(a["axis"]))
+        if op == "Slice":
+            starts = onp.asarray(x[1]).tolist()
+            ends = onp.asarray(x[2]).tolist()
+            axes = onp.asarray(x[3]).tolist() if len(x) > 3 else \
+                list(range(len(starts)))
+            steps = onp.asarray(x[4]).tolist() if len(x) > 4 else \
+                [1] * len(starts)
+            sl = [slice(None)] * x[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[ax] = slice(st, en, sp)
+            return x[0][tuple(sl)]
+        if op == "Pad":
+            pads = onp.asarray(x[1]).tolist()
+            n = x[0].ndim
+            cfg = [(pads[i], pads[n + i]) for i in range(n)]
+            cval = onp.asarray(x[2]).item() if len(x) > 2 else 0.0
+            return jnp.pad(x[0], cfg, constant_values=cval)
+        if op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceMean"):
+            axes = tuple(int(v) for v in onp.asarray(x[1])) if len(x) > 1 \
+                else tuple(a.get("axes", []))
+            keep = bool(a.get("keepdims", 1))
+            fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
+                  "ReduceMin": jnp.min, "ReduceMean": jnp.mean}[op]
+            return fn(x[0], axis=axes or None, keepdims=keep)
+        if op == "ArgMax":
+            ax = int(a.get("axis", 0))
+            r = jnp.argmax(x[0], axis=ax)
+            if a.get("keepdims", 1):       # ONNX default keepdims=1
+                r = jnp.expand_dims(r, ax)
+            return r
+        if op == "Flatten":
+            ax = int(a.get("axis", 1))
+            return jnp.reshape(x[0], (int(onp.prod(x[0].shape[:ax])), -1))
+        if op == "MatMul":
+            return jnp.matmul(x[0], x[1])
+        if op == "Gemm":
+            y = jnp.matmul(
+                x[0].T if a.get("transA") else x[0],
+                x[1].T if a.get("transB") else x[1])
+            y = y * a.get("alpha", 1.0)
+            if len(x) > 2:
+                y = y + x[2] * a.get("beta", 1.0)
+            return y
+        if op == "Einsum":
+            return jnp.einsum(a["equation"], *x)
+        if op == "Conv":
+            k, s, pairs = _pool_args(
+                {"kernel_shape": a.get("kernel_shape",
+                                       list(x[1].shape[2:])),
+                 "strides": a.get("strides"), "pads": a.get("pads")},
+                x[0].ndim - 2)
+            y = lax.conv_general_dilated(
+                x[0], x[1], window_strides=s, padding=pairs,
+                rhs_dilation=a.get("dilations"),
+                feature_group_count=int(a.get("group", 1)))
+            if len(x) > 2:
+                bshape = (1, -1) + (1,) * (x[0].ndim - 2)
+                y = y + x[2].reshape(bshape)
+            return y
+        if op in ("MaxPool", "AveragePool"):
+            k, s, pairs = _pool_args(a, x[0].ndim - 2)
+            full_k = (1, 1) + tuple(k)
+            full_s = (1, 1) + tuple(s)
+            full_p = [(0, 0), (0, 0)] + pairs
+            if op == "MaxPool":
+                init = -jnp.inf if jnp.issubdtype(
+                    x[0].dtype, jnp.floating) else \
+                    jnp.iinfo(x[0].dtype).min
+                return lax.reduce_window(x[0], init, lax.max, full_k,
+                                         full_s, full_p)
+            ssum = lax.reduce_window(x[0], 0.0, lax.add, full_k, full_s,
+                                     full_p)
+            if a.get("count_include_pad"):
+                return ssum / float(onp.prod(k))
+            ones = jnp.ones_like(x[0])
+            cnt = lax.reduce_window(ones, 0.0, lax.add, full_k, full_s,
+                                    full_p)
+            return ssum / cnt
+        if op == "GlobalAveragePool":
+            return jnp.mean(x[0], axis=tuple(range(2, x[0].ndim)),
+                            keepdims=True)
+        if op == "BatchNormalization":
+            xv, scale, b, mean, var = x[:5]
+            eps = a.get("epsilon", 1e-5)
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+            return (xv - mean.reshape(shape)) / jnp.sqrt(
+                var.reshape(shape) + eps) * scale.reshape(shape) + \
+                b.reshape(shape)
+        if op == "Softmax":
+            return jax.nn.softmax(x[0], axis=int(a.get("axis", -1)))
+        if op == "Constant":
+            return jnp.asarray(a["value"])
+        if op == "Dropout":
+            return x[0]
+        raise _base.MXNetError(f"ONNX import: unsupported op {op!r}")
+
+
+class ONNXBlock:
+    """Callable imported model: NDArray(s) in → NDArray(s) out, jitted."""
+
+    def __init__(self, model):
+        self._ev = _Evaluator(model)
+        self.input_names = [n for n, _, _ in
+                            self._ev.graph["inputs"]]
+        self._jitted = jax.jit(
+            lambda feeds: self._ev.run(feeds))
+
+    def __call__(self, *args):
+        feeds = {}
+        for name, arg in zip(self.input_names, args):
+            feeds[name] = arg.jax if isinstance(arg, NDArray) else \
+                jnp.asarray(arg)
+        outs = self._jitted(feeds)
+        res = [NDArray(o) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+
+def import_model(path):
+    """Load an ONNX file → (ONNXBlock, arg_params, aux_params) — the
+    callable plus the initializer dict, mirroring upstream
+    onnx2mx.import_model's (sym, arg_params, aux_params) contract."""
+    with open(path, "rb") as f:
+        model = proto.parse_model(f.read())
+    blk = ONNXBlock(model)
+    args = {k: NDArray(jnp.asarray(v))
+            for k, v in model["graph"]["initializers"].items()}
+    return blk, args, {}

@@ -1,0 +1,184 @@
+"""Subgraph partitioning API (parity: src/operator/subgraph/
+subgraph_property.h + build_subgraph.cc + the ``optimize_for`` backend
+registry, SURVEY.md §2.3).
+
+Upstream, a registered ``SubgraphProperty`` matches op patterns in the
+NNVM graph and replaces them with fused super-ops (oneDNN conv+bn+relu,
+TensorRT engines).  TPU-native: XLA already performs pointwise/conv
+fusion, so the surviving value of the API is **semantic** graph rewrites
+the compiler cannot do — folding BatchNorm statistics into convolution
+weights for inference, swapping layers for INT8 equivalents — expressed
+as block-tree (and Symbol-DAG) rewriters behind the same
+``SubgraphProperty``/``optimize_for(backend)`` surface.
+
+Built-in backends:
+- ``"FUSE_BN"``: fold inference-mode BatchNorm into the preceding
+  Conv2D/Dense inside HybridSequential chains (conv+bn+relu row of
+  src/operator/subgraph/mkldnn/mkldnn_conv_property.h, done as weight
+  algebra instead of a fused kernel).
+- ``"INT8"``: delegate to contrib.quantization.quantize_net (the
+  quantization subgraph backend).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as onp
+
+from . import base as _base
+
+__all__ = ["SubgraphProperty", "register_backend", "list_backends",
+           "optimize_for"]
+
+_BACKENDS: Dict[str, "SubgraphProperty"] = {}
+
+
+class SubgraphProperty:
+    """A named graph-rewrite backend (parity: SubgraphProperty).
+
+    Subclasses implement :meth:`apply_block` (Gluon block tree rewrite)
+    and/or :meth:`apply_symbol` (Symbol DAG rewrite) and register with
+    :func:`register_backend`.
+    """
+
+    name: str = ""
+
+    def apply_block(self, net, **kwargs):
+        return net
+
+    def apply_symbol(self, sym, **kwargs):
+        raise _base.MXNetError(
+            f"backend {self.name or type(self).__name__!r} implements no "
+            "Symbol rewrite — apply it to the Gluon block instead")
+
+
+def register_backend(prop: SubgraphProperty, name: Optional[str] = None):
+    """Parity: MXNET_REGISTER_SUBGRAPH_BACKEND/PROPERTY."""
+    key = (name or prop.name).upper()
+    if not key:
+        raise _base.MXNetError("subgraph backend needs a name")
+    _BACKENDS[key] = prop
+    return prop
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+def get_backend(name: str) -> SubgraphProperty:
+    key = str(name).upper()
+    if key not in _BACKENDS:
+        raise _base.MXNetError(
+            f"unknown optimize_for backend {name!r}; registered: "
+            f"{list_backends()}")
+    return _BACKENDS[key]
+
+
+def optimize_for(net_or_sym, backend, **kwargs):
+    """Apply a registered backend to a Gluon block or Symbol."""
+    prop = get_backend(backend)
+    from .symbol import Symbol
+    if isinstance(net_or_sym, Symbol):
+        return prop.apply_symbol(net_or_sym, **kwargs)
+    out = prop.apply_block(net_or_sym, **kwargs)
+    _clear_cached_ops(out)
+    return out
+
+
+def _clear_cached_ops(block):
+    """Invalidate every CachedOp in the tree: a rewrite that mutates
+    params/children must not let an already-hybridized net replay its
+    stale pre-rewrite trace."""
+    if hasattr(block, "_clear_cached_op"):
+        block._clear_cached_op()
+    for child in getattr(block, "_children", {}).values():
+        _clear_cached_ops(child)
+
+
+# ------------------------------------------------------------ FUSE_BN
+
+def _fold_conv_bn(conv, bn):
+    """Fold BN inference statistics into conv weight/bias in place."""
+    w = conv.weight.data().asnumpy()
+    gamma = bn.gamma.data().asnumpy() if bn.gamma is not None else \
+        onp.ones(w.shape[0], onp.float32)
+    beta = bn.beta.data().asnumpy() if bn.beta is not None else \
+        onp.zeros(w.shape[0], onp.float32)
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    eps = bn._eps
+    scale = gamma / onp.sqrt(var + eps)
+    w2 = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+    b = conv.bias.data().asnumpy() if conv.bias is not None else \
+        onp.zeros(w.shape[0], onp.float32)
+    b2 = (b - mean) * scale + beta
+    from .ndarray import array as nd_array
+    conv.weight.set_data(nd_array(w2.astype(w.dtype)))
+    if conv.bias is not None:
+        conv.bias.set_data(nd_array(b2.astype(onp.float32)))
+        return conv
+    # conv had no bias: grow one (a fresh Parameter bound to the block)
+    bias = conv.params.get("bias", shape=(w.shape[0],), init="zeros")
+    bias.set_data(nd_array(b2.astype(onp.float32)))
+    conv.bias = bias
+    return conv
+
+
+def _make_identity():
+    """nn.Identity stand-in for a folded-away BatchNorm (keeps
+    collect_params / children walks working)."""
+    from .gluon.nn import Identity
+    return Identity()
+
+
+class FuseBNProperty(SubgraphProperty):
+    """Conv2D/Dense + BatchNorm folding inside HybridSequential chains."""
+
+    name = "FUSE_BN"
+
+    def apply_block(self, net, **kwargs):
+        from .gluon.nn import (BatchNorm, Conv2D, Dense,
+                               HybridSequential)
+
+        def walk(block):
+            if isinstance(block, HybridSequential):
+                kids = list(block._children.items())
+                for (n1, c1), (n2, c2) in zip(kids, kids[1:]):
+                    if isinstance(c1, (Conv2D, Dense)) \
+                            and isinstance(c2, BatchNorm) \
+                            and c1.weight._data is not None \
+                            and getattr(c2, "running_mean", None) is not None \
+                            and c2.running_mean._data is not None:
+                        _fold_conv_bn(c1, c2)
+                        ident = _make_identity()
+                        block._children[n2] = ident
+                        if getattr(block, n2, None) is c2:
+                            setattr(block, n2, ident)
+            for child in list(block._children.values()):
+                if hasattr(child, "_children"):
+                    walk(child)
+            return block
+
+        return walk(net)
+
+
+register_backend(FuseBNProperty())
+
+
+# --------------------------------------------------------------- INT8
+
+class Int8Property(SubgraphProperty):
+    """Quantization as a subgraph backend (parity: the quantization pass
+    run through optimize_for on oneDNN)."""
+
+    name = "INT8"
+
+    def apply_block(self, net, calib_data=None, calib_mode="naive",
+                    exclude_layers=None, **kwargs):
+        from .contrib.quantization import quantize_net
+        return quantize_net(net, calib_data=calib_data,
+                            calib_mode=calib_mode,
+                            exclude_layers=exclude_layers)
+
+
+register_backend(Int8Property())

@@ -190,6 +190,13 @@ class Symbol:
         return Executor(self, ctx, args, grads, grad_req)
 
     # ---------------------------------------------------- serialization
+    def optimize_for(self, backend, args=None, aux=None, **kwargs):
+        """Apply a registered subgraph backend to this Symbol DAG
+        (parity: Symbol.optimize_for → build_subgraph.cc; registry in
+        mxnet_tpu.subgraph)."""
+        from .. import subgraph as _subgraph
+        return _subgraph.optimize_for(self, backend, **kwargs)
+
     def tojson(self) -> str:
         nodes = _topo(self)
         idx = {id(n): i for i, n in enumerate(nodes)}

@@ -1,0 +1,92 @@
+"""ONNX export/import (parity: python/mxnet/onnx mx2onnx + onnx2mx,
+VERDICT #8).  No onnxruntime in the image, so roundtrips are verified by
+the in-repo importer (jit-executed jnp ops over the exported graph)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+
+def _roundtrip(net, x, path, atol=1e-5):
+    ref = net(x).asnumpy()
+    mx.onnx.export_model(net, path, tuple(x.shape))
+    blk, args, aux = mx.onnx.import_model(path)
+    out = blk(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=atol)
+    return args
+
+
+def test_onnx_mlp_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(5))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(0).uniform(-1, 1, (3, 8))
+                 .astype("f"))
+    args = _roundtrip(net, x, str(tmp_path / "mlp.onnx"))
+    # params exported by name as initializers
+    assert any("weight" in k for k in args)
+
+
+def test_onnx_conv_bn_pool_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(4))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(1).uniform(-1, 1, (2, 3, 16, 16))
+                 .astype("f"))
+    _roundtrip(net, x, str(tmp_path / "cnn.onnx"), atol=1e-4)
+
+
+@pytest.mark.slow
+def test_onnx_resnet18_roundtrip(tmp_path):
+    """VERDICT #8 done-criterion: resnet18 exports to ONNX and the
+    imported graph matches forward outputs."""
+    from mxnet_tpu.models.vision import get_model
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = nd.array(onp.random.RandomState(2).uniform(-1, 1, (2, 3, 32, 32))
+                 .astype("f"))
+    _roundtrip(net, x, str(tmp_path / "r18.onnx"), atol=1e-3)
+
+
+def test_onnx_activations_and_broadcast(tmp_path):
+    class Mixed(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(6, flatten=False)
+
+        def forward(self, x):
+            from mxnet_tpu.ndarray import ops as F
+            h = self.d(x)
+            return (F.sigmoid(h) + F.tanh(h)) * F.Activation(
+                h, act_type="gelu") - h.mean()
+
+    net = Mixed()
+    net.initialize()
+    x = nd.array(onp.random.RandomState(3).uniform(-1, 1, (4, 5, 6))
+                 .astype("f"))
+    _roundtrip(net, x, str(tmp_path / "mixed.onnx"), atol=1e-5)
+
+
+def test_onnx_file_structure(tmp_path):
+    """The emitted bytes parse as a well-formed ONNX ModelProto."""
+    from mxnet_tpu.onnx import proto
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.zeros((1, 4), "f"))
+    net(x)
+    p = str(tmp_path / "m.onnx")
+    mx.onnx.export_model(net, p, (1, 4))
+    m = proto.parse_model(open(p, "rb").read())
+    g = m["graph"]
+    assert m["opset"] == 13
+    assert g["inputs"][0][0] == "data"
+    assert len(g["outputs"]) == 1
+    assert g["nodes"], "graph has nodes"
+    out_name = g["outputs"][0][0]
+    produced = {o for n in g["nodes"] for o in n["outputs"]}
+    assert out_name in produced

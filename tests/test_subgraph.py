@@ -1,0 +1,137 @@
+"""Subgraph partitioning API (parity: subgraph_property.h +
+build_subgraph.cc + optimize_for backends; VERDICT missing row #25)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.subgraph import (SubgraphProperty, list_backends,
+                                optimize_for, register_backend)
+
+
+def _conv_bn_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, use_bias=False), nn.BatchNorm(),
+            nn.Activation("relu"), nn.Conv2D(4, 1, use_bias=True),
+            nn.BatchNorm(), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    return net
+
+
+def _train_a_bit(net, x):
+    """Give BN non-trivial running stats."""
+    from mxnet_tpu import autograd
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.01})
+    for _ in range(3):
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        tr.step(x.shape[0])
+
+
+def test_builtin_backends_registered():
+    assert "FUSE_BN" in list_backends()
+    assert "INT8" in list_backends()
+    with pytest.raises(mx.MXNetError):
+        optimize_for(_conv_bn_net(), "NO_SUCH_BACKEND")
+
+
+def test_fuse_bn_preserves_outputs():
+    rs = onp.random.RandomState(0)
+    net = _conv_bn_net()
+    x = nd.array(rs.uniform(-1, 1, (4, 3, 8, 8)).astype("f"))
+    _train_a_bit(net, x)
+    ref = net(x).asnumpy()                 # inference mode: running stats
+    optimize_for(net, "FUSE_BN")
+    # both BatchNorms folded away
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "BatchNorm" not in kinds
+    out = net(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # first conv grew a bias from the fold
+    assert net[0].bias is not None
+
+
+def test_optimize_for_block_api():
+    """HybridBlock.optimize_for(backend=...) rewrites + hybridizes."""
+    rs = onp.random.RandomState(1)
+    net = _conv_bn_net()
+    x = nd.array(rs.uniform(-1, 1, (2, 3, 8, 8)).astype("f"))
+    _train_a_bit(net, x)
+    ref = net(x).asnumpy()
+    out = net.optimize_for(x, backend="FUSE_BN")
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    assert net._active                      # hybridized
+
+
+def test_int8_backend_swaps_layers():
+    rs = onp.random.RandomState(2)
+    net = _conv_bn_net()
+    x = nd.array(rs.uniform(-1, 1, (4, 3, 8, 8)).astype("f"))
+    net(x)
+    optimize_for(net, "INT8", calib_data=[x])
+    kinds = []
+
+    def walk(b):
+        for c in b._children.values():
+            kinds.append(type(c).__name__)
+            walk(c)
+    walk(net)
+    assert "QuantizedConv2D" in kinds and "QuantizedDense" in kinds
+
+
+def test_custom_backend_registration():
+    calls = []
+
+    class Tag(SubgraphProperty):
+        name = "TAGGER"
+
+        def apply_block(self, net, **kw):
+            calls.append(kw)
+            return net
+
+    register_backend(Tag())
+    assert "TAGGER" in list_backends()
+    net = _conv_bn_net()
+    optimize_for(net, "tagger", level=3)    # case-insensitive
+    assert calls == [{"level": 3}]
+
+
+
+
+def test_fuse_bn_dense():
+    rs = onp.random.RandomState(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16), nn.BatchNorm(), nn.Activation("relu"),
+            nn.Dense(4))
+    net.initialize()
+    x = nd.array(rs.uniform(-1, 1, (6, 10)).astype("f"))
+    _train_a_bit(net, x)
+    ref = net(x).asnumpy()
+    optimize_for(net, "FUSE_BN")
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "BatchNorm" not in kinds
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_rewrite_invalidates_cached_op():
+    """A rewrite on an already-hybridized net must not replay the stale
+    pre-rewrite trace."""
+    rs = onp.random.RandomState(4)
+    net = _conv_bn_net()
+    x = nd.array(rs.uniform(-1, 1, (2, 3, 8, 8)).astype("f"))
+    _train_a_bit(net, x)
+    net.hybridize()
+    ref = net(x).asnumpy()                  # builds the CachedOp
+    optimize_for(net, "FUSE_BN")
+    out = net(x).asnumpy()                  # must re-trace, not replay
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_symbol_backend_without_symbol_rewrite_raises():
+    sym = mx.sym.Variable("data")
+    with pytest.raises(mx.MXNetError):
+        sym.optimize_for("FUSE_BN")
