@@ -260,7 +260,13 @@ class HybridBlock(Block):
         'FUSE_BN', 'INT8', or user-registered SubgraphProperty)."""
         if backend is not None:
             from .. import subgraph as _subgraph
-            _subgraph.optimize_for(self, backend, **kwargs)
+            result = _subgraph.optimize_for(self, backend, **kwargs)
+            if result is not self:
+                raise _base.MXNetError(
+                    f"backend {backend!r} returned a new block; the "
+                    "in-place method API cannot adopt it — call "
+                    "mxnet_tpu.subgraph.optimize_for(net, backend) and "
+                    "use its return value instead")
         self.hybridize(True, static_alloc=static_alloc,
                        static_shape=static_shape)
         return self(x, *args)

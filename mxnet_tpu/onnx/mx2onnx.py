@@ -79,17 +79,13 @@ class _Converter:
                   "stop_gradient": "Identity", "copy": "Identity",
                   "gt": "Greater", "lt": "Less", "eq": "Equal",
                   "pow": "Pow", "and": "And", "or": "Or", "not": "Not"}
+        simple["ge"] = "GreaterOrEqual"   # opset 12+: NaN-correct
+        simple["le"] = "LessOrEqual"
         if p in simple:
             return out(self.emit(simple[p], ins))
         if p == "rsqrt":
             s = self.emit("Sqrt", ins)
             return out(self.emit("Reciprocal", [s]))
-        if p == "ge":      # Greater || Equal — via Less + Not
-            l = self.emit("Less", ins)
-            return out(self.emit("Not", [l]))
-        if p == "le":
-            g = self.emit("Greater", ins)
-            return out(self.emit("Not", [g]))
         if p == "integer_pow":
             y = params["y"]
             if y == 2:
@@ -291,6 +287,11 @@ def export_model(net, path, input_shapes, input_dtype="float32",
     from ..ndarray import NDArray
     from ..ndarray.ndarray import swap_values
 
+    if opset < 13:
+        raise _base.MXNetError(
+            "export emits opset-13 node forms (Squeeze/ReduceSum axes as "
+            f"inputs, GreaterOrEqual, ...); opset={opset} < 13 would "
+            "declare a version the nodes violate")
     if isinstance(input_shapes, tuple):
         input_shapes = [input_shapes]
     dt = onp.dtype(input_dtype)

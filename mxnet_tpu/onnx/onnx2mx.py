@@ -62,6 +62,8 @@ class _Evaluator:
               "Sigmoid": jax.nn.sigmoid, "Erf": jax.scipy.special.erf,
               "Relu": jax.nn.relu, "Identity": lambda v: v,
               "Greater": jnp.greater, "Less": jnp.less,
+              "GreaterOrEqual": jnp.greater_equal,
+              "LessOrEqual": jnp.less_equal,
               "Equal": jnp.equal, "Not": jnp.logical_not,
               "And": jnp.logical_and, "Or": jnp.logical_or}
         if op in ew:

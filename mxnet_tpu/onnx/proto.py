@@ -27,6 +27,11 @@ NP2ONNX = {
     onp.dtype(onp.int64): INT64, onp.dtype(onp.bool_): BOOL,
     onp.dtype(onp.float16): FLOAT16, onp.dtype(onp.float64): DOUBLE,
 }
+try:  # bf16 (the AMP default target) rides ml_dtypes
+    import ml_dtypes as _mld
+    NP2ONNX[onp.dtype(_mld.bfloat16)] = BFLOAT16
+except ImportError:
+    pass
 ONNX2NP = {v: k for k, v in NP2ONNX.items()}
 
 # AttributeProto.AttributeType

@@ -146,9 +146,12 @@ class FuseBNProperty(SubgraphProperty):
                 for (n1, c1), (n2, c2) in zip(kids, kids[1:]):
                     if isinstance(c1, (Conv2D, Dense)) \
                             and isinstance(c2, BatchNorm) \
+                            and getattr(c1, "_activation", None) is None \
                             and c1.weight._data is not None \
                             and getattr(c2, "running_mean", None) is not None \
                             and c2.running_mean._data is not None:
+                        # (a fused activation on c1 would make this
+                        # BN(act(conv(x))) — not foldable weight algebra)
                         _fold_conv_bn(c1, c2)
                         ident = _make_identity()
                         block._children[n2] = ident

@@ -1,0 +1,59 @@
+"""Minimum-slice convergence bar (SURVEY.md §7.4 / VERDICT weak #8):
+MNIST MLP through the REAL user stack — gluon DataLoader + transforms +
+hybridized net + Trainer — reaches >97% val accuracy within 5 epochs.
+
+MNIST falls back to a deterministic synthetic surrogate when the raw
+files are absent (no egress); `.synthetic` records which ran.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import DataLoader
+from mxnet_tpu.gluon.data.vision import MNIST
+
+pytestmark = pytest.mark.slow
+
+
+def test_mnist_mlp_converges():
+    train = MNIST(train=True)
+    val = MNIST(train=False)
+
+    def to_batches(ds, batch, shuffle):
+        return DataLoader(ds, batch_size=batch, shuffle=shuffle,
+                          last_batch="discard")
+
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def preprocess(x):
+        return x.astype("float32").reshape((x.shape[0], -1)) / 255.0
+
+    acc = None
+    for epoch in range(5):
+        for data, label in to_batches(train, 128, True):
+            x = nd.array(preprocess(data.asnumpy()))
+            y = nd.array(label.asnumpy().astype("float32"))
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+        correct = total = 0
+        for data, label in to_batches(val, 256, False):
+            x = nd.array(preprocess(data.asnumpy()))
+            pred = net(x).asnumpy().argmax(axis=1)
+            correct += (pred == label.asnumpy().ravel()).sum()
+            total += pred.shape[0]
+        acc = correct / total
+        if acc > 0.97:
+            break
+    assert acc is not None and acc > 0.97, \
+        f"val acc {acc} (synthetic={train.synthetic})"

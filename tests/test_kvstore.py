@@ -1,0 +1,115 @@
+"""KVStore eager path (parity: src/kvstore/kvstore_local.h Comm::Reduce,
+PushPull fusion, gradient_compression.cc 2-bit scheme; VERDICT weak #5)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _kv():
+    return mx.kv.create("device")
+
+
+def test_init_push_pull():
+    kv = _kv()
+    kv.init("w", nd.array(onp.zeros(4, onp.float32)))
+    grads = [nd.array(onp.full(4, float(i + 1), onp.float32))
+             for i in range(3)]
+    kv.push("w", grads)
+    out = nd.array(onp.zeros(4, onp.float32))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(4, 6.0))
+
+
+def test_pushpull_fused_single_reduce():
+    kv = _kv()
+    kv.init("g", nd.array(onp.zeros(3, onp.float32)))
+    vals = [nd.array(onp.ones(3, onp.float32)),
+            nd.array(2 * onp.ones(3, onp.float32))]
+    outs = [nd.array(onp.zeros(3, onp.float32)) for _ in range(2)]
+    kv.pushpull("g", vals, out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), onp.full(3, 3.0))
+
+
+def test_update_on_kvstore_pushpull_pulls_weight():
+    kv = _kv()
+    w0 = onp.full(4, 10.0, onp.float32)
+    kv.init("w", nd.array(w0))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    grad = nd.array(onp.ones(4, onp.float32))
+    out = nd.array(onp.zeros(4, onp.float32))
+    kv.pushpull("w", grad, out=out)
+    # server-side sgd: w = w - 0.1 * grad; the pulled value is the WEIGHT
+    onp.testing.assert_allclose(out.asnumpy(), w0 - 0.1, rtol=1e-6)
+
+
+def test_gradient_compression_2bit_quantizes():
+    kv = _kv()
+    kv.init("g", nd.array(onp.zeros(5, onp.float32)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = nd.array(onp.array([0.9, 0.3, -0.7, -0.2, 0.0], onp.float32))
+    kv.push("g", g)
+    out = nd.array(onp.zeros(5, onp.float32))
+    kv.pull("g", out=out)
+    # quantized to {-0.5, 0, +0.5}
+    onp.testing.assert_allclose(out.asnumpy(),
+                                [0.5, 0.0, -0.5, 0.0, 0.0])
+
+
+def test_gradient_compression_error_feedback_accumulates():
+    kv = _kv()
+    kv.init("g", nd.array(onp.zeros(1, onp.float32)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = nd.array(onp.array([0.3], onp.float32))
+    pulled = []
+    for _ in range(4):
+        kv.push("g", g)
+        out = nd.array(onp.zeros(1, onp.float32))
+        kv.pull("g", out=out)
+        pulled.append(float(out.asnumpy()[0]))
+    # 0.3 < threshold alone, but residuals accumulate: 0.3, 0.6→fire...
+    assert pulled[0] == 0.0
+    assert pulled[1] == 0.5
+    # long-run mean matches the true gradient (unbiased with feedback)
+    total = sum(pulled)
+    assert abs(total - 4 * 0.3) <= 0.5
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = _kv()
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "4bit"})
+    kv.set_gradient_compression({"type": "none"})   # disables cleanly
+    kv.init("x", nd.array(onp.ones(2, onp.float32)))
+    kv.push("x", nd.array(onp.full(2, 0.25, onp.float32)))
+    out = nd.array(onp.zeros(2, onp.float32))
+    kv.pull("x", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(2, 0.25))
+
+
+def test_trainer_with_compression_params_converges():
+    """Trainer accepts compression_params and still trains (parity:
+    Trainer(compression_params={'type': '2bit', ...}))."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    rs = onp.random.RandomState(0)
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05},
+                       compression_params={"type": "2bit",
+                                           "threshold": 0.05})
+    w_true = rs.randn(4).astype("f")
+    loss_prev = None
+    for step in range(60):
+        x = rs.randn(16, 4).astype("f")
+        y = x @ w_true
+        xb, yb = nd.array(x), nd.array(y[:, None])
+        with autograd.record():
+            l = ((net(xb) - yb) ** 2).mean()
+        l.backward()
+        tr.step(1)          # loss is already a mean over the batch
+        loss_prev = float(l.asnumpy())
+    assert loss_prev < 0.1, loss_prev
