@@ -99,6 +99,20 @@ class Optimizer:
         self.num_update = max(self._index_update_count[index],
                               self.num_update)
 
+    # -- traced mode -------------------------------------------------------
+    def traced(self, lr, t):
+        """Context manager putting THIS optimizer into traced mode: the
+        learning rate and every per-index update count read the given
+        traced scalars, and count bookkeeping is suspended — so one
+        compiled step (bias correction, schedulers and all) serves every
+        iteration.  This is the optimizer's own contract for running
+        inside a jitted training step (used by parallel.ShardedTrainer);
+        subclasses that grow new step-dependent state must consult
+        ``self._index_update_count[index]`` (which yields the traced step
+        in this mode) rather than private counters.
+        """
+        return _TracedMode(self, lr, t)
+
     # -- state ------------------------------------------------------------
     def create_state(self, index, weight: NDArray):
         return None
@@ -126,6 +140,47 @@ class Optimizer:
             weight._rebind(master.jax.astype(jnp.float16))
         else:
             self.update(index, weight, grad, state)
+
+
+class _TracedCount(dict):
+    """Stands in for Optimizer._index_update_count during tracing: every
+    index reads the traced step scalar, writes are discarded."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, k):
+        return self._t
+
+    def __setitem__(self, k, v):
+        pass
+
+    def __contains__(self, k):
+        return True
+
+
+class _TracedMode:
+    """Implementation of Optimizer.traced(): swaps lr/scheduler/count
+    plumbing for traced scalars and restores on exit."""
+
+    def __init__(self, opt, lr, t):
+        self._opt, self._lr, self._t = opt, lr, t
+        self._saved = None
+
+    def __enter__(self):
+        opt = self._opt
+        self._saved = (opt.lr, opt.lr_scheduler, opt._index_update_count)
+        opt.lr, opt.lr_scheduler = self._lr, None
+        opt._index_update_count = _TracedCount(self._t)
+        opt.__dict__["_update_count"] = lambda index: None
+        return opt
+
+    def __exit__(self, *a):
+        opt = self._opt
+        opt.lr, opt.lr_scheduler, opt._index_update_count = self._saved
+        opt.__dict__.pop("_update_count", None)
+        return False
 
 
 def _apply(weight: NDArray, new_w):
@@ -362,7 +417,6 @@ class LAMB(Optimizer):
                  bias_correction=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
-        self.lazy_update = lazy_update
         self.lower_bound, self.upper_bound = lower_bound, upper_bound
         self.bias_correction = bias_correction
 

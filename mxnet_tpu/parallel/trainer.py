@@ -12,7 +12,6 @@ flows; ShardedTrainer is the pjit path that scales it to a pod.
 """
 from __future__ import annotations
 
-import contextlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,40 +25,6 @@ from ..ndarray import NDArray
 from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
 from .sharding import ShardingRules, batch_spec, logical_axes_of, shard_params
-
-
-class _TracedCount(dict):
-    """Stands in for Optimizer._index_update_count during tracing: every
-    index reads the traced step counter, writes are discarded."""
-
-    def __init__(self, t):
-        super().__init__()
-        self._t = t
-
-    def __getitem__(self, k):
-        return self._t
-
-    def __setitem__(self, k, v):
-        pass
-
-    def __contains__(self, k):
-        return True
-
-
-@contextlib.contextmanager
-def _traced_optimizer(opt: opt_mod.Optimizer, lr, t):
-    """Patch an Optimizer so its update() math traces cleanly: lr and the
-    per-index update count become traced scalars (so one compiled step serves
-    every iteration — bias correction, schedulers and all)."""
-    saved = (opt.lr, opt.lr_scheduler, opt._index_update_count)
-    opt.lr, opt.lr_scheduler = lr, None
-    opt._index_update_count = _TracedCount(t)
-    opt.__dict__["_update_count"] = lambda index: None
-    try:
-        yield opt
-    finally:
-        opt.lr, opt.lr_scheduler, opt._index_update_count = saved
-        opt.__dict__.pop("_update_count", None)
 
 
 def _flatten_state(state) -> Tuple[List[NDArray], Any]:
@@ -247,7 +212,7 @@ class ShardedTrainer:
                     forward, has_aux=True)(tuple(param_vals))
 
                 new_params, new_states = [], []
-                with _traced_optimizer(optimizer, lr, t):
+                with optimizer.traced(lr, t):
                     off = 0
                     for i, ((name, p), g) in enumerate(zip(trainable, grads)):
                         w_nd = NDArray(param_vals[i])
