@@ -75,25 +75,39 @@ def _record(metric: str, value: float, unit: str, mfu: float) -> dict:
             "vs_baseline": round(mfu / 0.45, 4)}
 
 
+
+def _fit_batch(batch: int, mesh) -> int:
+    """Round batch up to a multiple of the mesh's dp axis (the CPU
+    fallback runs on an 8-virtual-device mesh)."""
+    from mxnet_tpu import parallel as par
+    dp = par.axis_size(mesh, "dp")
+    return -(-batch // dp) * dp
+
+
 # ------------------------------------------------------------------ GPT-2
 
-def bench_gpt2(on_tpu: bool) -> dict:
+def _bench_gpt2_config(on_tpu: bool, long: bool) -> dict:
+    """GPT-2 training throughput; ``long`` is BASELINE config 5 (seq 4096
+    through the Pallas flash path, O(T) memory)."""
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
 
     if on_tpu:
-        batch, seq, steps, warmup = 16, 1024, 20, 3
+        batch, seq, steps, warmup = (4, 4096, 10, 3) if long \
+            else (16, 1024, 20, 3)
         layers, units, vocab = 12, 768, 50257
         net = get_gpt2("gpt2_124m", max_length=seq, dropout=0.0)
     else:  # CPU sanity mode: tiny variant, same code path
-        batch, seq, steps, warmup = 4, 128, 3, 1
-        layers, units, vocab = 4, 256, 1024
+        batch, seq, steps, warmup = (2, 512, 2, 1) if long \
+            else (4, 128, 3, 1)
+        layers, units, vocab = (2, 128, 512) if long else (4, 256, 1024)
         net = get_gpt2("gpt2_124m", vocab_size=vocab, units=units,
-                       num_layers=layers, num_heads=8, max_length=seq,
-                       dropout=0.0)
+                       num_layers=layers, num_heads=4 if long else 8,
+                       max_length=seq, dropout=0.0)
     net.initialize()
     mesh = par.make_mesh()
+    batch = _fit_batch(batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "adam", loss=gpt2_lm_loss,
@@ -111,8 +125,17 @@ def bench_gpt2(on_tpu: bool) -> dict:
                        + 12.0 * layers * units * seq)
     mfu = tokens_per_sec * flops_per_token / (
         peak_flops_per_device() * len(jax_devices()))
-    return _record("gpt2_124m_train_throughput", tokens_per_sec,
-                   "tokens/sec", mfu)
+    name = "gpt2_124m_seq4096_train_throughput" if long \
+        else "gpt2_124m_train_throughput"
+    return _record(name, tokens_per_sec, "tokens/sec", mfu)
+
+
+def bench_gpt2(on_tpu: bool) -> dict:
+    return _bench_gpt2_config(on_tpu, long=False)
+
+
+def bench_gpt2_long(on_tpu: bool) -> dict:
+    return _bench_gpt2_config(on_tpu, long=True)
 
 
 # --------------------------------------------------------------- ResNet-50
@@ -137,6 +160,7 @@ def bench_resnet50(on_tpu: bool) -> dict:
         train_flops_per_img = 3 * 1.8e9 * (64 / 224) ** 2
     net.initialize()
     mesh = par.make_mesh()
+    batch = _fit_batch(batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "sgd", loss=ce_loss,
@@ -186,6 +210,7 @@ def bench_bert(on_tpu: bool) -> dict:
 
     net.initialize()
     mesh = par.make_mesh()
+    batch = _fit_batch(batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "adam", loss=mlm_loss,
@@ -222,7 +247,8 @@ def jax_devices():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="gpt2",
-                    choices=["gpt2", "resnet50", "bert", "all"])
+                    choices=["gpt2", "gpt2_long", "resnet50", "bert",
+                             "all"])
     args = ap.parse_args()
 
     platform = _init_platform()
@@ -231,10 +257,10 @@ def main():
         from mxnet_tpu import amp
         amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
 
-    names = (["resnet50", "bert", "gpt2"] if args.workload == "all"
-             else [args.workload])
-    table = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
-             "bert": bench_bert}
+    names = (["resnet50", "bert", "gpt2_long", "gpt2"]
+             if args.workload == "all" else [args.workload])
+    table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
+             "resnet50": bench_resnet50, "bert": bench_bert}
     for name in names:
         rec = table[name](on_tpu)
         print(json.dumps(rec), flush=True)

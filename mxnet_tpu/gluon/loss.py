@@ -7,7 +7,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
+           "CTCLoss", "PoissonNLLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -215,4 +216,103 @@ class CosineEmbeddingLoss(Loss):
         neg = F.relu(cos - self._margin)
         loss = F.where(label == 1, pos, neg)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification loss (parity:
+    gluon.loss.CTCLoss / src/operator/contrib/ctc_loss — warp-ctc role).
+
+    TPU-native: the log-space forward algorithm runs as optax.ctc_loss
+    (lax.scan under jit — no warp-ctc kernel needed).  Blank is class 0
+    (upstream ``blank_label='first'`` default).  layout 'NTC' or 'TNC';
+    labels (B, L) padded with -1 (or use label_lengths).
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise ValueError(f"unsupported layout {layout}")
+        if label_layout not in ("NT", "TN"):
+            raise ValueError(f"unsupported label_layout {label_layout}")
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import jax.numpy as jnp
+        import optax
+
+        from ..ndarray.ops import _as_nd, invoke
+        pred, label = _as_nd(pred), _as_nd(label)
+        nd_in = [pred, label]
+        if pred_lengths is not None:
+            nd_in.append(_as_nd(pred_lengths))
+        if label_lengths is not None:
+            nd_in.append(_as_nd(label_lengths))
+
+        tnc = self._layout == "TNC"
+        lt = self._label_layout == "TN"
+
+        def f(p, l, *lens):
+            if tnc:
+                p = jnp.transpose(p, (1, 0, 2))      # → (B, T, K)
+            if lt:
+                l = jnp.transpose(l, (1, 0))         # → (B, L)
+            B, T, _ = p.shape
+            L = l.shape[1]
+            i = 0
+            if pred_lengths is not None:
+                plen = lens[i].astype(jnp.int32)
+                i += 1
+                logit_pad = (jnp.arange(T)[None, :] >=
+                             plen[:, None]).astype(p.dtype)
+            else:
+                logit_pad = jnp.zeros((B, T), p.dtype)
+            if label_lengths is not None:
+                llen = lens[i].astype(jnp.int32)
+                label_pad = (jnp.arange(L)[None, :] >=
+                             llen[:, None]).astype(p.dtype)
+            else:
+                label_pad = (l < 0).astype(p.dtype)  # -1-padded labels
+            labels = jnp.where(l < 0, 0, l).astype(jnp.int32)
+            return optax.ctc_loss(p, logit_pad, labels, label_pad,
+                                  blank_id=0)
+
+        loss = invoke("ctc_loss", f, nd_in)
+        return _apply_weighting(nd, loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (parity: gluon.loss.PoissonNLLLoss):
+    L = pred - target*log(pred) [+ ln(target!) via Stirling]."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        import jax.numpy as jnp
+
+        from ..ndarray.ops import _as_nd, invoke
+        pred, target = _as_nd(pred), _as_nd(target)
+
+        def f(p, t):
+            loss = jnp.exp(p) - t * p if self._from_logits \
+                else p - t * jnp.log(p + epsilon)
+            if self._compute_full:
+                stirling = (t * jnp.log(t + 1e-12) - t +
+                            0.5 * jnp.log(2 * jnp.pi * (t + 1e-12)))
+                loss = loss + jnp.where(t > 1, stirling,
+                                        jnp.zeros_like(stirling))
+            return loss
+
+        # weight ELEMENTWISE (upstream order), then reduce to per-sample
+        loss = invoke("poisson_nll", f, [pred, target])
+        loss = _apply_weighting(nd, loss, self._weight, sample_weight)
+        if loss.ndim > 1:
+            loss = loss.mean(axis=tuple(range(1, loss.ndim)))
         return loss

@@ -254,3 +254,52 @@ def test_explicit_initializer_honored():
     ref = onp.zeros(8, dtype=onp.float32)
     ref[2:4] = 1.0
     assert_almost_equal(p.data(), ref)
+
+
+def test_ctc_loss_matches_manual():
+    """CTCLoss vs a hand-computed simple alignment case + shape/layout
+    checks (parity: gluon.loss.CTCLoss, blank=0)."""
+    import numpy as onp
+    from mxnet_tpu.gluon.loss import CTCLoss
+    rs = onp.random.RandomState(0)
+    B, T, K, L = 2, 6, 5, 3
+    pred = nd.array(rs.randn(B, T, K).astype("f"))
+    label = nd.array(onp.array([[1, 2, 3], [2, 4, -1]], "f"))
+    loss = CTCLoss()(pred, label)
+    assert loss.shape == (B,)
+    v = loss.asnumpy()
+    assert (v > 0).all() and onp.isfinite(v).all()
+    # TNC layout gives identical values
+    loss_tnc = CTCLoss(layout="TNC")(
+        nd.array(pred.asnumpy().transpose(1, 0, 2)), label)
+    onp.testing.assert_allclose(loss_tnc.asnumpy(), v, rtol=1e-5)
+    # a sequence that can only emit the target: prob ~1 → loss ~0
+    big = onp.full((1, 3, 3), -20.0, "f")
+    big[0, 0, 1] = 20.0; big[0, 1, 0] = 20.0; big[0, 2, 1] = 20.0
+    l2 = CTCLoss()(nd.array(big), nd.array(onp.array([[1, 1]], "f")))
+    assert float(l2.asnumpy()[0]) < 1e-3
+
+
+def test_ctc_loss_differentiable():
+    import numpy as onp
+    from mxnet_tpu.gluon.loss import CTCLoss
+    rs = onp.random.RandomState(1)
+    pred = nd.array(rs.randn(2, 5, 4).astype("f"))
+    pred.attach_grad()
+    label = nd.array(onp.array([[1, 2], [3, -1]], "f"))
+    with autograd.record():
+        loss = CTCLoss()(pred, label).sum()
+    loss.backward()
+    g = pred.grad.asnumpy()
+    assert onp.isfinite(g).all() and (g != 0).any()
+
+
+def test_poisson_nll_loss():
+    import numpy as onp
+    from mxnet_tpu.gluon.loss import PoissonNLLLoss
+    pred = nd.array(onp.array([[0.0, 1.0]], "f"))
+    tgt = nd.array(onp.array([[1.0, 2.0]], "f"))
+    # from_logits: exp(p) - t*p averaged over features
+    expect = ((onp.exp(0.0) - 1.0 * 0.0) + (onp.exp(1.0) - 2.0)) / 2
+    got = float(PoissonNLLLoss()(pred, tgt).asnumpy()[0])
+    assert abs(got - expect) < 1e-5

@@ -92,3 +92,19 @@ def test_opperf_cli(tmp_path):
     rep = json.loads(out.read_text())
     assert rep["backend"] == "cpu"
     assert "sum" in rep["results"]["reduce"]
+
+
+def test_bandwidth_tool_runs():
+    """tools/bandwidth.py (parity: tools/bandwidth/) sweeps collective
+    sizes over the mesh and prints GB/s rows."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bandwidth.py"),
+         "--cpu-devices", "4", "--sizes-mb", "1", "--iters", "2"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert "allreduce GB/s" in r.stdout
+    assert "1.0MB" in r.stdout.replace(" ", "")
