@@ -71,6 +71,8 @@ _LAZY = {
     "operator": ".operator",
     "onnx": ".onnx",
     "subgraph": ".subgraph",
+    "viz": ".visualization",
+    "visualization": ".visualization",
     "library": ".library",
 }
 

@@ -12,10 +12,10 @@ from . import data  # noqa: E402
 
 
 def __getattr__(name):
-    if name == "contrib":
+    if name in ("contrib", "model_zoo"):
         import importlib
-        mod = importlib.import_module(".contrib", __name__)
-        globals()["contrib"] = mod
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute "
                          f"{name!r}")

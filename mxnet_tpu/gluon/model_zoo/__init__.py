@@ -1,0 +1,6 @@
+"""``mx.gluon.model_zoo`` — the upstream import path for the vision zoo
+(parity: python/mxnet/gluon/model_zoo; implementations live in
+mxnet_tpu.models.vision)."""
+from . import vision
+
+__all__ = ["vision"]

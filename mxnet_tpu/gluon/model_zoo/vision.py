@@ -1,0 +1,4 @@
+"""Alias of mxnet_tpu.models.vision under the upstream path
+``mx.gluon.model_zoo.vision`` (GluonCV-era scripts import from here)."""
+from ...models.vision import *          # noqa: F401,F403
+from ...models.vision import get_model, _models  # noqa: F401

@@ -1,5 +1,6 @@
 """gluon.rnn — recurrent layers & cells."""
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ResidualCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ResidualCell,
                        BidirectionalCell, ZoneoutCell)

@@ -305,3 +305,8 @@ class BidirectionalCell(RecurrentCell):
         if merge_outputs:
             outs = nd.stack(*outs, axis=axis)
         return outs, l_states + r_states
+
+
+# hybridizable alias (parity: rnn_cell.HybridSequentialRNNCell — identical
+# semantics here since every cell traces through the same dispatcher)
+HybridSequentialRNNCell = SequentialRNNCell
