@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Classic Gluon MNIST training script — written in the exact idiom of
+upstream MXNet examples (example/gluon/mnist.py): the 'scripts run
+unmodified' pledge, with only the import name changed.
+"""
+import argparse
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(),
+            nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def evaluate(net, val_iter):
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    for batch in val_iter:
+        out = net(batch.data[0])
+        metric.update(batch.label, [out])
+    return metric.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--hybridize", action="store_true", default=True)
+    args = ap.parse_args()
+
+    train_iter, val_iter = mx.test_utils.get_mnist_iterator(
+        args.batch_size, (1, 28, 28))
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric = mx.metric.Accuracy()
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        val_acc = evaluate(net, val_iter)
+        print(f"epoch {epoch}: train acc {metric.get()[1]:.4f}, "
+              f"val acc {val_acc:.4f}")
+    assert val_acc > 0.95, f"failed to converge: {val_acc}"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Symbolic Module-API MNIST script in the 1.x idiom
+(example/image-classification/train_mnist.py): Symbol compose →
+Module.fit with an eval metric — the legacy path GluonCV-era tooling
+still drives.
+"""
+import mxnet_tpu as mx
+
+
+def get_symbol():
+    data = mx.sym.Variable("data")
+    flat = mx.sym.reshape(data, shape=(-1, 784), name="flatten")
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu", name="relu2")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, name="softmax") \
+        if hasattr(mx.sym, "SoftmaxOutput") else \
+        mx.sym.softmax(fc3, name="softmax")
+
+
+def main():
+    train_iter, val_iter = mx.test_utils.get_mnist_iterator(100, (1, 28, 28))
+    mod = mx.mod.Module(get_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train_iter, eval_data=val_iter, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc", num_epoch=3,
+            batch_end_callback=mx.callback.Speedometer(100, 30))
+    score = mod.score(val_iter, mx.metric.Accuracy())
+    acc = dict([score] if isinstance(score, tuple) else score)["accuracy"]
+    print(f"final val accuracy: {acc:.4f}")
+    assert acc > 0.95, acc
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -188,20 +188,25 @@ class Module(BaseModule):
         shapes = {d.name: d.shape
                   for d in self._data_shapes + self._label_shapes}
         names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
         # explicit Variable(shape=...) attrs participate in shape resolution
         for n in _topo_nulls(self._symbol):
-            if n._name in names and "__shape__" in n._attrs:
+            if "__shape__" in n._attrs:
                 shapes.setdefault(n._name, tuple(n._attrs["__shape__"]))
         for k, v in self._arg_params.items():
             shapes.setdefault(k, tuple(v.shape))
-        missing = [n for n in names if n not in shapes]
-        if missing:
+        try:
+            # partial inference solves layer-parameter shapes (nnvm
+            # InferShape parity) — auto-created weights need no explicit
+            # shape here
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        except _base.MXNetError as e:
             raise _base.MXNetError(
-                f"Module.bind cannot resolve shapes for {missing}: give "
+                f"Module.bind cannot resolve shapes: {e} — give "
                 "sym.Variable(shape=...) explicit shapes, or load params "
                 "first (set_params / Module.load)")
-        arg_shapes, _, _ = self._symbol.infer_shape(**shapes)
         self._arg_shape = dict(zip(names, arg_shapes))
+        self._arg_shape.update(dict(zip(aux_names, aux_shapes)))
         args = {}
         grads = {}
         for n in names:
@@ -212,7 +217,17 @@ class Module(BaseModule):
                                      and n in self._data_names)) \
                     and n not in self._fixed_param_names:
                 grads[n] = nd.zeros(shape)
-        req = {n: ("write" if n in grads else "null") for n in names}
+        # aux states bind with their declared init (moving_var = ones)
+        aux_set = set(aux_names)
+        for n_node in _topo_nulls(self._symbol):
+            if n_node._name in aux_set:
+                if n_node._name not in self._aux_params:
+                    shape = self._arg_shape[n_node._name]
+                    self._aux_params[n_node._name] = nd.ones(shape) \
+                        if n_node._attrs.get("__init__") == "ones" \
+                        else nd.zeros(shape)
+                args[n_node._name] = self._aux_params[n_node._name]
+        req = {n: ("write" if n in grads else "null") for n in args}
         self._exec = self._symbol.bind(args=args, args_grad=grads,
                                        grad_req=req)
         self.binded = True
@@ -255,7 +270,12 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             return
         if isinstance(optimizer, str):
-            optimizer = _opt.create(optimizer, **dict(optimizer_params))
+            params = dict(optimizer_params)
+            # upstream Module defaults rescale_grad = 1/batch_size — loss
+            # heads (SoftmaxOutput) emit batch-SUMMED gradients
+            if "rescale_grad" not in params and self._data_shapes:
+                params["rescale_grad"] = 1.0 / self._data_shapes[0].shape[0]
+            optimizer = _opt.create(optimizer, **params)
         self._optimizer = optimizer
         self._updater = _opt.get_updater(optimizer)
         self.optimizer_initialized = True

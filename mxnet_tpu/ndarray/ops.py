@@ -1388,6 +1388,73 @@ def smooth_l1(data, scalar=1.0):
 
 
 @_export
+def SoftmaxOutput(data, label=None, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, normalization="null",
+                  out_grad=False, **kw):
+    """Classic 1.x softmax loss head (parity: src/operator/softmax_output.cc):
+    forward = softmax(data); backward IGNORES the incoming gradient and
+    emits (softmax - onehot(label)) * grad_scale, normalized per
+    ``normalization`` ('null' | 'batch' | 'valid')."""
+    data = _as_nd(data)
+    if label is None:
+        return softmax(data, axis=-1)
+    label = _as_nd(label)
+
+    @jax.custom_vjp
+    def _softmax_output(x, y):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _fwd(x, y):
+        p = jax.nn.softmax(x, axis=-1)
+        return p, (p, y)
+
+    def _bwd(res, g):
+        p, y = res
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, p.shape[-1], dtype=p.dtype)
+        dx = (p - onehot) * grad_scale
+        valid = None
+        if use_ignore:
+            valid = (yi != ignore_label)
+            dx = dx * valid[..., None].astype(p.dtype)
+        if normalization == "batch":
+            dx = dx / p.shape[0]
+        elif normalization == "valid":
+            n = jnp.sum(valid) if valid is not None else \
+                jnp.asarray(float(onp.prod(y.shape)), p.dtype)
+            dx = dx / jnp.maximum(n, 1)
+        return dx, jnp.zeros_like(y)
+
+    _softmax_output.defvjp(_fwd, _bwd)
+    return invoke("SoftmaxOutput", _softmax_output, [data, label])
+
+
+@_export
+def LinearRegressionOutput(data, label=None, grad_scale=1.0, **kw):
+    """1.x L2 head (parity: regression_output.cc): forward = identity;
+    backward = (data - label) * grad_scale."""
+    data = _as_nd(data)
+    if label is None:
+        return data
+    label = _as_nd(label)
+
+    @jax.custom_vjp
+    def _linreg(x, y):
+        return x
+
+    def _fwd(x, y):
+        return x, (x, y)
+
+    def _bwd(res, g):
+        x, y = res
+        return ((x - y.reshape(x.shape)) * grad_scale,
+                jnp.zeros_like(y))
+
+    _linreg.defvjp(_fwd, _bwd)
+    return invoke("LinearRegressionOutput", _linreg, [data, label])
+
+
+@_export
 def MakeLoss(data, grad_scale=1.0, **kw):
     data = _as_nd(data)
     return invoke("make_loss", lambda x: x * grad_scale, [data])

@@ -60,7 +60,7 @@ class Symbol:
     def __repr__(self):
         return f"<Symbol {self._name}>"
 
-    def list_arguments(self) -> List[str]:
+    def _walk_nulls(self):
         seen, order = set(), []
 
         def walk(s):
@@ -71,10 +71,20 @@ class Symbol:
             for i in s._inputs:
                 walk(i)
             if s._op == "null":
-                order.append(s._name)
+                order.append(s)
 
         walk(self)
         return order
+
+    def list_arguments(self) -> List[str]:
+        return [s._name for s in self._walk_nulls()
+                if not s._attrs.get("__aux__")]
+
+    def list_auxiliary_states(self) -> List[str]:
+        """Aux states (BatchNorm moving stats) — not gradient targets
+        (parity: Symbol.list_auxiliary_states)."""
+        return [s._name for s in self._walk_nulls()
+                if s._attrs.get("__aux__")]
 
     def list_outputs(self) -> List[str]:
         if self._op == "group":
@@ -152,23 +162,28 @@ class Symbol:
         return outs
 
     def infer_shape(self, **shapes):
-        """Returns (arg_shapes, out_shapes, aux_shapes) like MXNet."""
-        import jax
+        """Returns (arg_shapes, out_shapes, aux_shapes) like MXNet.
 
+        Partial inference (parity: nnvm InferShape): shapes given for the
+        data arguments propagate forward, and the parameter variables of
+        layer ops (FullyConnected/Convolution/BatchNorm/...) are SOLVED
+        from their input shape + attrs — so auto-created weights need no
+        explicit shape."""
+        known = {k: tuple(v) for k, v in shapes.items()}
+        node_shape = _infer_graph_shapes(self, known)
         args = self.list_arguments()
-        missing = [a for a in args if a not in shapes]
+        aux = self.list_auxiliary_states()
+        missing = [a for a in args + aux if known.get(a) is None]
         if missing:
             raise _base.MXNetError(f"infer_shape missing args {missing}")
-        avals = {a: jax.ShapeDtypeStruct(tuple(shapes[a]), _onp.float32)
-                 for a in args}
-
-        def f(env):
-            outs = _evaluate_abstract(self, env)
-            return [o for o in outs]
-
-        outs = jax.eval_shape(f, avals)
-        return ([tuple(shapes[a]) for a in args],
-                [tuple(o.shape) for o in outs], [])
+        outs = []
+        targets = self._inputs if self._op == "group" else [self]
+        for t in targets:
+            v = node_shape[id(t._base or t)]
+            if t._out_index is not None and isinstance(v, list):
+                v = v[t._out_index]
+            outs.extend(v if isinstance(v, list) else [v])
+        return ([known[a] for a in args], outs, [known[a] for a in aux])
 
     def infer_type(self, **dtypes):
         args = self.list_arguments()
@@ -181,9 +196,16 @@ class Symbol:
         return Executor(self, ctx, args, args_grad, grad_req)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes) -> "Executor":
-        arg_shapes, _, _ = self.infer_shape(**shapes)
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
         names = self.list_arguments()
         args = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
+        # aux states bind with their declared init (moving_var = ones)
+        aux_names = self.list_auxiliary_states()
+        aux_by_name = {s._name: s for s in self._walk_nulls()}
+        for n, s in zip(aux_names, aux_shapes):
+            init = aux_by_name[n]._attrs.get("__init__")
+            args[n] = _nd_ops.ones(s) if init == "ones" else \
+                _nd_ops.zeros(s)
         grads = None
         if grad_req != "null":
             grads = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
@@ -290,6 +312,108 @@ def _run_node(n: Symbol, in_vals):
     if fn is None:
         raise _base.MXNetError(f"unknown op in graph: {n._op}")
     return fn(*in_vals, **attrs)
+
+
+def _solve_param_shapes(op, data_shape, attrs):
+    """Parameter-variable shapes of a layer op, solved from its input
+    shape + attrs (position → shape, positions counted incl. data at 0)."""
+    ds = tuple(data_shape)
+    at = attrs
+    if op == "FullyConnected":
+        flatten = at.get("flatten", True)
+        k = int(_onp.prod(ds[1:])) if flatten else ds[-1]
+        nh = int(at["num_hidden"])
+        return {1: (nh, k), 2: (nh,)}
+    if op in ("Convolution", "Deconvolution"):
+        kernel = tuple(at["kernel"])
+        nf = int(at["num_filter"])
+        g = int(at.get("num_group", 1))
+        if op == "Convolution":
+            w = (nf, ds[1] // g) + kernel
+        else:
+            w = (ds[1], nf // g) + kernel
+        return {1: w, 2: (nf,)}
+    if op == "Embedding":
+        return {1: (int(at["input_dim"]), int(at["output_dim"]))}
+    if op == "LayerNorm":
+        c = ds[int(at.get("axis", -1))]
+        return {1: (c,), 2: (c,)}
+    if op == "BatchNorm":
+        c = ds[int(at.get("axis", 1))]
+        return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+    if op == "SoftmaxOutput":
+        return {1: ds[:-1]}      # label: data shape minus the class axis
+    if op == "LinearRegressionOutput":
+        return {1: ds}
+    return {}
+
+
+def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
+    """Topo-walk shape inference: fills `known` for solvable variables and
+    returns {node id: shape | [shapes]} for every node."""
+    import jax
+
+    # Variable(shape=...) attrs participate
+    for n in _topo(root):
+        if n._op == "null" and "__shape__" in n._attrs:
+            known.setdefault(n._name, tuple(n._attrs["__shape__"]))
+
+    node_shape: Dict[int, Any] = {}
+
+    def shp_of(i):
+        v = node_shape.get(id(i._base or i))
+        if i._out_index is not None and isinstance(v, list):
+            v = v[i._out_index]
+        return v
+
+    for n in _topo(root):
+        if n._op == "none":
+            node_shape[id(n)] = None
+            continue
+        if n._op == "null":
+            node_shape[id(n)] = known.get(n._name)
+            continue
+        if n._op == "group":
+            continue
+        in_shapes = [shp_of(i) for i in n._inputs]
+        if n._op in _PARAM_SPECS and in_shapes and in_shapes[0] is not None:
+            solved = _solve_param_shapes(
+                n._op, in_shapes[0],
+                {k: v for k, v in n._attrs.items()})
+            for pos, shp in solved.items():
+                if pos >= len(n._inputs):
+                    continue
+                node = n._inputs[pos]._base or n._inputs[pos]
+                if node._op == "null" and node_shape.get(id(node)) is None:
+                    node_shape[id(node)] = shp
+                    known[node._name] = shp
+                    in_shapes[pos] = shp
+        unresolved = [i._name for i, s in zip(n._inputs, in_shapes)
+                      if s is None and (i._base or i)._op == "null"]
+        if unresolved:
+            raise _base.MXNetError(
+                f"infer_shape: cannot resolve {unresolved} feeding "
+                f"{n._op} {n._name!r} — pass their shapes or use "
+                "Variable(shape=...)")
+
+        concrete = [s for s in in_shapes if s is not None]
+
+        def f(*xs, _n=n, _in_shapes=tuple(in_shapes)):
+            it = iter(xs)
+            ins = [None if s is None else NDArray(next(it))
+                   for s in _in_shapes]
+            out = _run_node(_n, ins)
+            if isinstance(out, (list, tuple)):
+                return [o.jax if isinstance(o, NDArray) else o
+                        for o in out]
+            return out.jax if isinstance(out, NDArray) else out
+
+        avals = [jax.ShapeDtypeStruct(s, _onp.float32) for s in concrete]
+        out = jax.eval_shape(f, *avals)
+        node_shape[id(n)] = ([tuple(o.shape) for o in out]
+                             if isinstance(out, (list, tuple))
+                             else tuple(out.shape))
+    return node_shape
 
 
 def _evaluate(root: Symbol, env: Dict[str, NDArray]) -> List[NDArray]:
@@ -470,8 +594,89 @@ class Executor:
 _SYM_ONLY = {"null", "group"}
 
 
+# Layer ops whose parameter inputs auto-create named Variables when not
+# given (parity: NNVM's ListArguments — sym.FullyConnected(data,
+# num_hidden=k, name='fc1') materializes fc1_weight/fc1_bias).  Entries:
+# (param names after data, init hints, aux names, aux init hints).
+_PARAM_SPECS = {
+    "FullyConnected": (("weight", "bias"), (None, "zeros"), (), ()),
+    "Convolution": (("weight", "bias"), (None, "zeros"), (), ()),
+    "Deconvolution": (("weight", "bias"), (None, "zeros"), (), ()),
+    "Embedding": (("weight",), (None,), (), ()),
+    "LayerNorm": (("gamma", "beta"), ("ones", "zeros"), (), ()),
+    "BatchNorm": (("gamma", "beta"), ("ones", "zeros"),
+                  ("moving_mean", "moving_var"), ("zeros", "ones")),
+    # loss heads auto-create their label variable ({name}_label — the
+    # classic "softmax_label" Module binds by label_names)
+    "SoftmaxOutput": (("label",), (None,), (), ()),
+    "LinearRegressionOutput": (("label",), (None,), (), ()),
+}
+
+
+def _kwargs_to_positional(opname, args, kwargs):
+    """Move Symbol-valued keyword inputs (data=, weight=, label=, ...) into
+    their positional slots per the nd op's signature — the dominant
+    GluonCV-era calling idiom.  Unfilled intermediate slots become None."""
+    if not any(isinstance(v, Symbol) for v in kwargs.values()):
+        return args
+    fn = getattr(_nd_ops, opname, None)
+    if fn is None:
+        return args
+    import inspect
+    try:
+        sig = list(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        return args
+    merged = list(args)
+    for idx in range(len(args), len(sig)):
+        if not any(isinstance(v, Symbol) for v in kwargs.values()):
+            break
+        pn = sig[idx]
+        if pn in kwargs and isinstance(kwargs[pn], Symbol):
+            merged.append(kwargs.pop(pn))
+        else:
+            merged.append(None)
+    return tuple(merged)
+
+
+def _auto_params(opname, args, kwargs, name):
+    """Fill missing/None param/aux inputs of a layer op with named
+    Variables."""
+    spec = _PARAM_SPECS.get(opname)
+    if spec is None or not args or args[0] is None:
+        return args
+    pnames, pinits, anames, ainits = spec
+    no_bias = bool(kwargs.get("no_bias", False))
+    out = list(args)
+    slots = list(zip(pnames, pinits)) + list(zip(anames, ainits))
+    aux_start = len(pnames)
+    for slot_idx, (pname, init) in enumerate(slots):
+        pos = 1 + slot_idx
+        if pos < len(out) and out[pos] is not None:
+            continue
+        if pname == "bias" and no_bias:
+            v = None
+        else:
+            v = Variable(f"{name}_{pname}")
+            if init is not None:
+                v._attrs["__init__"] = init
+            if slot_idx >= aux_start:
+                v._attrs["__aux__"] = True
+        if pos < len(out):
+            out[pos] = v
+        else:
+            out.append(v)
+    return tuple(out)
+
+
 def _sym_op(opname):
     def op(*args, name: Optional[str] = None, **kwargs):
+        args = _kwargs_to_positional(opname, args, kwargs)
+        if opname in _PARAM_SPECS and name is None:
+            # one prefix for the node AND its auto-created params, so
+            # '{node}_weight' matches the node name (upstream convention)
+            name = _auto_name(opname.lower())
+        args = _auto_params(opname, args, kwargs, name)
         # None positional inputs (e.g. bias with no_bias=True) become "none"
         # sentinel nodes so argument positions survive serialization
         args = tuple(Symbol("none", _auto_name("none")) if a is None else a

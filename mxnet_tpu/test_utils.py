@@ -12,7 +12,9 @@ from .ndarray import NDArray, array
 
 __all__ = ["default_rtol", "default_atol", "assert_almost_equal",
            "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
-           "with_seed", "same", "check_consistency"]
+           "with_seed", "same", "check_consistency", "default_context",
+           "set_default_context", "list_gpus", "download", "get_mnist",
+           "get_mnist_iterator"]
 
 
 def _as_dtype(dtype):
@@ -173,6 +175,94 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=None, rtol=None,
                     b, a, rtol=rt, atol=at,
                     err_msg=f"grad {i}: {ctx}/{dt} vs {ref_ctx}/{ref_dt}")
     return results
+
+
+def default_context():
+    """Current default device scope (parity: mx.test_utils.default_context)."""
+    from . import context as ctx_mod
+    return ctx_mod.current_context()
+
+
+def set_default_context(ctx):
+    """Pin the process default context (parity: set_default_context —
+    how the upstream GPU suite re-ran the CPU tests under another
+    device; pairs with MXNET_TPU_TEST_PLATFORM=tpu here)."""
+    from . import context as ctx_mod
+    stack = getattr(ctx_mod._state, "stack", None)
+    if stack:
+        stack[-1] = ctx
+    else:
+        ctx_mod._push_context(ctx)
+
+
+def list_gpus():
+    """Indices of visible accelerators (parity: mx.test_utils.list_gpus —
+    'gpu' aliases the TPU here, SURVEY §7.1 device mapping)."""
+    from . import context as ctx_mod
+    return list(range(ctx_mod.num_gpus()))
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Parity: mx.test_utils.download.  This image has no network egress,
+    so only already-present files resolve; otherwise a clear error."""
+    import os
+    fname = fname or url.split("/")[-1]
+    if dirname:
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname):
+        if overwrite:
+            raise _base_error(
+                f"download({url!r}, overwrite=True): no network egress in "
+                f"this environment — cannot refresh {fname!r} (drop "
+                "overwrite to use the existing file)")
+        return fname
+    raise _base_error(
+        f"download({url!r}): no network egress in this environment and "
+        f"{fname!r} does not exist locally")
+
+
+def _base_error(msg):
+    from . import base
+    return base.MXNetError(msg)
+
+
+def get_mnist():
+    """MNIST as numpy dict (parity: mx.test_utils.get_mnist).  Falls back
+    to the deterministic synthetic surrogate when raw files are absent
+    (same data the gluon MNIST dataset serves — hermetic, no egress)."""
+    from .gluon.data.vision import MNIST
+    tr, te = MNIST(train=True), MNIST(train=False)
+
+    def arr(x):
+        return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+    return {
+        "train_data": arr(tr._data).reshape(-1, 1, 28, 28)
+        .astype(onp.float32) / 255.0,
+        "train_label": arr(tr._label).ravel(),
+        "test_data": arr(te._data).reshape(-1, 1, 28, 28)
+        .astype(onp.float32) / 255.0,
+        "test_label": arr(te._label).ravel(),
+    }
+
+
+def get_mnist_iterator(batch_size, input_shape, num_parts=1, part_index=0):
+    """(train_iter, val_iter) NDArrayIters over MNIST (parity:
+    mx.test_utils.get_mnist_iterator)."""
+    from .io import NDArrayIter
+    mnist = get_mnist()
+    shape = (-1,) + tuple(input_shape)
+    tr_data = mnist["train_data"].reshape(shape)
+    te_data = mnist["test_data"].reshape(shape)
+    if num_parts > 1:
+        n = tr_data.shape[0] // num_parts
+        sl = slice(part_index * n, (part_index + 1) * n)
+        tr_data, tr_label = tr_data[sl], mnist["train_label"][sl]
+    else:
+        tr_label = mnist["train_label"]
+    train = NDArrayIter(tr_data, tr_label, batch_size, shuffle=True)
+    val = NDArrayIter(te_data, mnist["test_label"], batch_size)
+    return train, val
 
 
 def with_seed(seed=None):
