@@ -33,7 +33,9 @@ def main():
     ap.add_argument("--batch-size", type=int, default=100)
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.1)
-    ap.add_argument("--hybridize", action="store_true", default=True)
+    ap.add_argument("--no-hybridize", dest="hybridize",
+                    action="store_false")
+    ap.set_defaults(hybridize=True)
     args = ap.parse_args()
 
     train_iter, val_iter = mx.test_utils.get_mnist_iterator(

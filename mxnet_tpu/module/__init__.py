@@ -217,16 +217,16 @@ class Module(BaseModule):
                                      and n in self._data_names)) \
                     and n not in self._fixed_param_names:
                 grads[n] = nd.zeros(shape)
-        # aux states bind with their declared init (moving_var = ones)
-        aux_set = set(aux_names)
-        for n_node in _topo_nulls(self._symbol):
-            if n_node._name in aux_set:
-                if n_node._name not in self._aux_params:
-                    shape = self._arg_shape[n_node._name]
-                    self._aux_params[n_node._name] = nd.ones(shape) \
-                        if n_node._attrs.get("__init__") == "ones" \
-                        else nd.zeros(shape)
-                args[n_node._name] = self._aux_params[n_node._name]
+        # aux states bind at their declared init (moving_var = ones)
+        if aux_names:
+            defaults = None
+            for n_name in aux_names:
+                if n_name not in self._aux_params:
+                    if defaults is None:
+                        defaults = self._symbol.default_aux_arrays(
+                            aux_shapes)
+                    self._aux_params[n_name] = defaults[n_name]
+                args[n_name] = self._aux_params[n_name]
         req = {n: ("write" if n in grads else "null") for n in args}
         self._exec = self._symbol.bind(args=args, args_grad=grads,
                                        grad_req=req)

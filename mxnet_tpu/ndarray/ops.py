@@ -549,6 +549,30 @@ def broadcast_like(lhs, rhs):
 
 
 @_export
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape (parity: reshape_like op, incl. the
+    partial-range form reshaping lhs[lhs_begin:lhs_end] dims to
+    rhs[rhs_begin:rhs_end] dims)."""
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+
+    partial = any(v is not None for v in
+                  (lhs_begin, lhs_end, rhs_begin, rhs_end))
+
+    def f(a, b):
+        if not partial:
+            return jnp.reshape(a, b.shape)
+        lb = 0 if lhs_begin is None else lhs_begin
+        le = a.ndim if lhs_end is None else lhs_end
+        rb = 0 if rhs_begin is None else rhs_begin
+        re_ = b.ndim if rhs_end is None else rhs_end
+        new_shape = a.shape[:lb] + b.shape[rb:re_] + a.shape[le:]
+        return jnp.reshape(a, new_shape)
+
+    return invoke("reshape_like", f, [lhs, rhs])
+
+
+@_export
 def broadcast_axis(data, axis=(), size=()):
     data = _as_nd(data)
     axes = (axis,) if isinstance(axis, int) else tuple(axis)

@@ -76,15 +76,45 @@ class Symbol:
         walk(self)
         return order
 
+    def _aux_info(self) -> Dict[str, str]:
+        """{name: init hint} for null nodes consumed at AUX positions of
+        layer ops — aux-ness derives from the op input position (like
+        nnvm mutate_inputs), so user-supplied moving stats classify too."""
+        info: Dict[str, str] = {}
+        for n in _topo(self):
+            spec = _PARAM_SPECS.get(n._op)
+            if not spec:
+                continue
+            pnames, _, anames, ainits = spec
+            for j, init in enumerate(ainits):
+                pos = 1 + len(pnames) + j
+                if pos < len(n._inputs):
+                    node = n._inputs[pos]._base or n._inputs[pos]
+                    if node._op == "null":
+                        info[node._name] = init
+        return info
+
     def list_arguments(self) -> List[str]:
-        return [s._name for s in self._walk_nulls()
-                if not s._attrs.get("__aux__")]
+        aux = self._aux_info()
+        return [s._name for s in self._walk_nulls() if s._name not in aux]
 
     def list_auxiliary_states(self) -> List[str]:
         """Aux states (BatchNorm moving stats) — not gradient targets
         (parity: Symbol.list_auxiliary_states)."""
-        return [s._name for s in self._walk_nulls()
-                if s._attrs.get("__aux__")]
+        aux = self._aux_info()
+        return [s._name for s in self._walk_nulls() if s._name in aux]
+
+    def default_aux_arrays(self, aux_shapes=None, **shapes) -> Dict[str,
+                                                                    "NDArray"]:
+        """Fresh aux-state arrays at their declared inits (moving_var =
+        ones) — the single source both simple_bind and Module.bind use."""
+        info = self._aux_info()
+        names = self.list_auxiliary_states()
+        if aux_shapes is None:
+            _, _, aux_shapes = self.infer_shape(**shapes)
+        return {n: (_nd_ops.ones(s) if info.get(n) == "ones"
+                    else _nd_ops.zeros(s))
+                for n, s in zip(names, aux_shapes)}
 
     def list_outputs(self) -> List[str]:
         if self._op == "group":
@@ -199,13 +229,7 @@ class Symbol:
         arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
         names = self.list_arguments()
         args = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
-        # aux states bind with their declared init (moving_var = ones)
-        aux_names = self.list_auxiliary_states()
-        aux_by_name = {s._name: s for s in self._walk_nulls()}
-        for n, s in zip(aux_names, aux_shapes):
-            init = aux_by_name[n]._attrs.get("__init__")
-            args[n] = _nd_ops.ones(s) if init == "ones" else \
-                _nd_ops.zeros(s)
+        args.update(self.default_aux_arrays(aux_shapes))
         grads = None
         if grad_req != "null":
             grads = {n: _nd_ops.zeros(s) for n, s in zip(names, arg_shapes)}
@@ -416,7 +440,12 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
     return node_shape
 
 
-def _evaluate(root: Symbol, env: Dict[str, NDArray]) -> List[NDArray]:
+def _evaluate(root: Symbol, env: Dict[str, NDArray],
+              bn_capture: Optional[Dict[int, Any]] = None) -> List[NDArray]:
+    """Run the DAG.  When ``bn_capture`` is given (training forward),
+    BatchNorm nodes additionally report (aux arrays, batch stats) so the
+    executor can update moving statistics — the aux-mutation the
+    reference does INSIDE batch_norm.cc, kept outside the pure op here."""
     cache: Dict[int, Any] = {}
     for n in _topo(root):
         if n._op == "none":
@@ -433,7 +462,16 @@ def _evaluate(root: Symbol, env: Dict[str, NDArray]) -> List[NDArray]:
             if i._out_index is not None:
                 v = v[i._out_index]
             ins.append(v)
-        cache[id(n)] = _run_node(n, ins)
+        if bn_capture is not None and n._op == "BatchNorm" \
+                and not n._attrs.get("output_mean_var") \
+                and not n._attrs.get("use_global_stats"):
+            attrs = dict(n._attrs)
+            attrs["_internal_stats"] = True
+            out, mean, var = _nd_ops.BatchNorm(*ins, **attrs)
+            cache[id(n)] = out
+            bn_capture[id(n)] = (ins[3], ins[4], mean, var)
+        else:
+            cache[id(n)] = _run_node(n, ins)
 
     def out_of(s):
         v = cache[id(s._base or s)]
@@ -559,12 +597,27 @@ class Executor:
                 if req != "null" and n in self.grad_dict:
                     a.attach_grad(req)
                     self._tracked.append(n)
+            bn_capture: Dict[int, Any] = {}
             with autograd.record():
-                self.outputs = _evaluate(self._symbol, self.arg_dict)
+                self.outputs = _evaluate(self._symbol, self.arg_dict,
+                                         bn_capture=bn_capture)
                 self._train_outputs = self.outputs
+            # moving-statistics update (batch_norm.cc's aux mutation)
+            for node_id, (mm, mv, mean, var) in bn_capture.items():
+                node = self._bn_node(node_id)
+                m = float(node._attrs.get("momentum", 0.9))
+                with autograd.pause():
+                    mm._rebind(m * mm.jax + (1 - m) * mean.detach().jax)
+                    mv._rebind(m * mv.jax + (1 - m) * var.detach().jax)
         else:
             self.outputs = _evaluate(self._symbol, self.arg_dict)
         return self.outputs
+
+    def _bn_node(self, node_id):
+        for n in _topo(self._symbol):
+            if id(n) == node_id:
+                return n
+        raise _base.MXNetError("lost BatchNorm node during forward")
 
     def backward(self, out_grads=None):
         from .. import autograd

@@ -211,10 +211,11 @@ def download(url, fname=None, dirname=None, overwrite=False):
         fname = os.path.join(dirname, fname)
     if os.path.exists(fname):
         if overwrite:
-            raise _base_error(
+            import warnings
+            warnings.warn(
                 f"download({url!r}, overwrite=True): no network egress in "
-                f"this environment — cannot refresh {fname!r} (drop "
-                "overwrite to use the existing file)")
+                f"this environment — using the existing {fname!r} "
+                "unrefreshed")
         return fname
     raise _base_error(
         f"download({url!r}): no network egress in this environment and "

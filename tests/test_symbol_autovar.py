@@ -168,3 +168,47 @@ def test_loss_head_label_shape_inferred():
     ex = out.simple_bind(data=(32, 784))
     assert "softmax_label" in ex.arg_dict
     assert tuple(ex.arg_dict["softmax_label"].shape) == (32,)
+
+
+def test_batchnorm_module_train_updates_moving_stats():
+    """Symbolic BN: training updates moving stats (batch_norm.cc's aux
+    mutation) so inference normalizes correctly — val accuracy survives
+    the is_train=False switch."""
+    rs = onp.random.RandomState(0)
+    # data with strongly non-unit statistics so untrained moving stats
+    # (mean 0 / var 1) would wreck inference
+    X = (rs.randn(240, 3, 8, 8) * 5 + 7).astype("f")
+    y = rs.randint(0, 4, 240)
+    X[onp.arange(240), 0, 0, y] += 30.0
+    it = NDArrayIter(X, y.astype("f"), 40, shuffle=True,
+                     last_batch_handle="discard")
+    val = NDArrayIter(X, y.astype("f"), 40)
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="c1")
+    bn = mx.sym.BatchNorm(c, name="bn1")
+    act = mx.sym.Activation(bn, act_type="relu")
+    flat = mx.sym.reshape(act, shape=(0, -1))
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        flat, num_hidden=4, name="fc"), name="softmax")
+    mod = mx.mod.Module(out, label_names=("softmax_label",))
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric="acc", num_epoch=4)
+    # moving stats moved off their inits
+    aux = mod._aux_params
+    assert abs(aux["bn1_moving_mean"].asnumpy()).max() > 0.5
+    assert abs(aux["bn1_moving_var"].asnumpy() - 1.0).max() > 0.5
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_user_supplied_moving_stats_classify_as_aux():
+    d = mx.sym.Variable("data")
+    mm = mx.sym.Variable("my_mean")
+    mv = mx.sym.Variable("my_var")
+    g = mx.sym.Variable("g")
+    b = mx.sym.Variable("b")
+    bn = mx.sym.BatchNorm(d, g, b, mm, mv, name="bn")
+    assert bn.list_auxiliary_states() == ["my_mean", "my_var"]
+    assert "my_mean" not in bn.list_arguments()
