@@ -85,8 +85,16 @@ class Parameter:
         self._init_impl(initializer, ctx)
 
     def _init_impl(self, initializer, ctx):
-        arr = _ndmod.zeros(self.shape, ctx=ctx, dtype=self.dtype)
-        initializer(self._name, arr, explicit=self.init is not None)
+        # deferred init can fire inside an abstract settle trace
+        # (ShardedTrainer's jax.eval_shape pass); under omnistaging every
+        # jax op would stage to that trace and bind TRACERS as param data.
+        # Parameter values are never functions of traced inputs, so force
+        # concrete evaluation.
+        import jax
+
+        with jax.ensure_compile_time_eval():
+            arr = _ndmod.zeros(self.shape, ctx=ctx, dtype=self.dtype)
+            initializer(self._name, arr, explicit=self.init is not None)
         self._data = arr
         self._deferred_init = None
         if self.grad_req != "null":

@@ -22,8 +22,11 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, num_layers=12,
                  num_heads=12, max_length=512, type_vocab_size=2,
-                 dropout=0.1, layer_norm_eps=1e-12, **kwargs):
+                 dropout=0.1, layer_norm_eps=1e-12, scan_layers=None,
+                 remat=False, **kwargs):
         super().__init__(**kwargs)
+        self._scan_layers = scan_layers
+        self._remat = remat
         self._units = units
         self.vocab_size = vocab_size
         self.max_length = max_length
@@ -63,8 +66,9 @@ class BERTModel(HybridBlock):
             steps = F.arange_like(tokens, axis=1)
             mask = (steps.reshape((1, 1, 1, t)) <
                     valid_length.reshape((b, 1, 1, 1)))
-        for layer in self.layers:
-            x = layer(x, mask)
+        from .transformer import run_blocks
+        x = run_blocks(self.layers, x, mask, scan=self._scan_layers,
+                       remat=self._remat)
         pooled = self.pooler(F.slice_axis(x, axis=1, begin=0, end=1)
                              .reshape((b, self._units)))
         return x, pooled

@@ -29,8 +29,11 @@ class GPT2Model(HybridBlock):
     def __init__(self, vocab_size=50257, units=768, num_layers=12,
                  num_heads=12, max_length=1024, dropout=0.1,
                  layer_norm_eps=1e-5, num_experts=0, moe_every=2,
-                 moe_top_k=2, moe_capacity_factor=1.25, **kwargs):
+                 moe_top_k=2, moe_capacity_factor=1.25, scan_layers=None,
+                 remat=False, **kwargs):
         super().__init__(**kwargs)
+        self._scan_layers = scan_layers
+        self._remat = remat
         self._units = units
         self.vocab_size = vocab_size
         self.max_length = max_length
@@ -67,8 +70,9 @@ class GPT2Model(HybridBlock):
         x = _par.with_sharding_constraint(x, "batch", "seq", None)
         if self.drop is not None:
             x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x)
+        from .transformer import run_blocks
+        x = run_blocks(self.blocks, x, scan=self._scan_layers,
+                       remat=self._remat)
         x = self.ln_f(x)
         # tied lm head: logits = x · wteᵀ (vocab-parallel over tp)
         logits = F.FullyConnected(x, self.wte.weight.data(), None,

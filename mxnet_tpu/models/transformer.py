@@ -113,6 +113,157 @@ class PositionwiseFFN(HybridBlock):
         return h
 
 
+def _block_param_items(block):
+    """(structural_name, Parameter) pairs in REGISTRATION order — the
+    alignment key for stacking layers.  Structural names ('attn.q_proj.weight')
+    are identical across identically-constructed blocks, unlike the global
+    per-class name counters ('dense10_weight' sorts before 'dense6_weight')."""
+    return list(block._collect_params_with_prefix().items())
+
+
+def _block_config_key(b):
+    """Hyperparameters that change the layer FUNCTION without changing its
+    param tree — blocks must agree on all of them to share one scan body."""
+    return (
+        b.attn._num_heads, b.attn._head_dim, b.attn._causal,
+        b.attn._att_dropout,
+        b.attn.dropout._rate if b.attn.dropout is not None else 0.0,
+        b.ffn._activation,
+        b.ffn.dropout._rate if b.ffn.dropout is not None else 0.0,
+        b.ln1._axis, b.ln1._eps, b.ln2._axis, b.ln2._eps,
+    )
+
+
+def _scan_eligible(blocks, x) -> bool:
+    """True iff the stack can run as ONE lax.scan body: homogeneous layer
+    class AND config, params allocated, identical structural param trees
+    (names, shapes, dtypes), and we are inside a jit trace (eager mode
+    keeps the python loop so the imperative autograd tape sees every op)."""
+    import jax
+
+    from ..ndarray import NDArray
+
+    if len(blocks) < 2:
+        return False
+    cls = type(blocks[0])
+    if cls not in (TransformerBlock, TransformerEncoderLayer):
+        return False
+    if any(type(b) is not cls for b in blocks):
+        return False
+    try:
+        if any(_block_config_key(b) != _block_config_key(blocks[0])
+               for b in blocks):
+            return False
+    except AttributeError:   # subclass with a different structure
+        return False
+    if not isinstance(x, NDArray) or not isinstance(x.jax, jax.core.Tracer):
+        return False
+    trees = []
+    for b in blocks:
+        ps = _block_param_items(b)
+        if any(p._data is None for _, p in ps):
+            return False
+        trees.append(tuple((n, tuple(p.shape), str(p._data.jax.dtype))
+                           for n, p in ps))
+    return all(t == trees[0] for t in trees)
+
+
+def _scan_blocks(blocks, x, mask, remat):
+    """Run identical transformer layers as ``lax.scan`` over stacked params.
+
+    TPU-first compile economics (SURVEY.md §7.3 hard part 3): a 24-layer
+    stack unrolled is 24 copies of the same HLO — XLA compiles the scan
+    body ONCE instead.  Gradients flow through the jnp.stack to each
+    layer's own Parameter, so checkpoint format / Trainer integration are
+    unchanged.  Per-layer RNG (dropout) folds the layer index into the
+    ambient trace key so layers decorrelate exactly like the python loop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .. import random as _random
+    from ..ndarray import NDArray
+
+    global _scan_engaged_count
+    _scan_engaged_count += 1
+    b0 = blocks[0]
+    b0_params = [p._data for _, p in _block_param_items(b0)]
+    per_block = [[p._data.jax for _, p in _block_param_items(blk)]
+                 for blk in blocks]
+    stacked = [jnp.stack([vals[j] for vals in per_block])
+               for j in range(len(b0_params))]
+    providers = _random._trace_providers()
+    base_key = providers[-1].key if providers else None
+
+    from ..ndarray.ndarray import swap_values
+
+    def body(carry, xs):
+        idx, layer_vals = xs[0], xs[1:]
+        if base_key is not None:
+            _random.push_trace_key(jax.random.fold_in(base_key, idx))
+        try:
+            with swap_values(b0_params, list(layer_vals)):
+                out = b0(NDArray(carry), mask)
+            return out.jax, None
+        finally:
+            if base_key is not None:
+                _random.pop_trace_key()
+
+    if remat:
+        body = jax.checkpoint(body)
+    idxs = jnp.arange(len(blocks), dtype=jnp.int32)
+    h, _ = jax.lax.scan(body, x.jax, (idxs, *stacked))
+    return NDArray(h)
+
+
+# diagnostic: how many times the scan fast path actually compiled in
+# (tests assert it engages — a silently ineligible stack would otherwise
+# make loop-vs-scan comparisons vacuous)
+_scan_engaged_count = 0
+
+
+def run_blocks(blocks, x, mask=None, scan=None, remat=False):
+    """Apply a stack of transformer layers: ``lax.scan`` fast path for deep
+    homogeneous stacks under jit (one compiled body), python loop otherwise.
+
+    ``scan=None`` auto-enables scanning at >=8 layers; pass True/False to
+    force.  ``remat`` wraps the scan body in jax.checkpoint (activation
+    rematerialization for long sequences / deep stacks).
+    """
+    use_scan = scan if scan is not None else len(blocks) >= 8
+    if use_scan and _scan_eligible(blocks, x):
+        return _scan_blocks(blocks, x, mask, remat)
+    if remat:
+        import jax
+
+        from ..ndarray import NDArray
+
+        if isinstance(x, NDArray) and isinstance(x.jax, jax.core.Tracer):
+            # honor remat on the loop path too (short/heterogeneous
+            # stacks): checkpoint each layer, folding the layer index
+            # into the trace key so fwd and rematerialized traces draw
+            # IDENTICAL dropout masks (scan-body key semantics)
+            from .. import random as _random
+            providers = _random._trace_providers()
+            base_key = providers[-1].key if providers else None
+
+            for i, blk in enumerate(blocks):
+                def f(h, _blk=blk, _i=i):
+                    if base_key is not None:
+                        _random.push_trace_key(
+                            jax.random.fold_in(base_key, _i))
+                    try:
+                        return _blk(NDArray(h), mask).jax
+                    finally:
+                        if base_key is not None:
+                            _random.pop_trace_key()
+                x = NDArray(jax.checkpoint(f)(x.jax))
+            return x
+    for blk in blocks:
+        x = blk(x, mask)
+    return x
+
+
 class TransformerBlock(HybridBlock):
     """Pre-LN transformer layer (GPT-2 style)."""
 

@@ -113,12 +113,63 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def _build(self, data, labels):
         net = self.net
-        # settle deferred shapes with one eager forward — in inference mode
-        # so BatchNorm running stats / dropout are untouched by shape settling
+        # settle deferred shapes with one forward — in inference mode so
+        # BatchNorm running stats / dropout are untouched by shape
+        # settling.  Preferred path is ABSTRACT (jax.eval_shape): shape
+        # propagation without a single FLOP or per-op XLA compile — on a
+        # real chip an eager full-batch settle of a large model costs
+        # minutes of tiny-op compiles.  Fallbacks: eager on a batch-1
+        # slice (dim0 = batch by the data_specs contract; param shapes
+        # never depend on it), then eager on the real batch (models with
+        # batch-shape contracts, e.g. GPipe microbatching).
+        def _settle_slice(x):
+            if isinstance(x, NDArray) and x.ndim >= 1 and x.shape[0] > 1:
+                return x[0:1]
+            return x
+
+        def _abstract_settle():
+            import jax
+
+            def run(*jv):
+                net(*[NDArray(v) for v in jv])
+                return jnp.zeros(())
+
+            jax.eval_shape(run, *[d.jax for d in data])
+
         with _base.training_mode(False):
             rec = _base.set_recording(False)
             try:
-                net(*data)
+                import jax
+                before = {id(p): p._data.jax
+                          for p in net.collect_params().values()
+                          if p._data is not None}
+                try:
+                    _abstract_settle()
+                    leaked = any(
+                        p._data is not None
+                        and isinstance(p._data.jax, jax.core.Tracer)
+                        for p in net.collect_params().values())
+                except Exception:
+                    leaked = True
+                if leaked:
+                    # abstract settle failed or silently bound tracers (a
+                    # forward that rebinds state inside the trace).  Restore
+                    # pre-existing params, re-init any freshly allocated
+                    # ones concretely, and settle eagerly.
+                    for p in net.collect_params().values():
+                        d = p._data
+                        if d is None or not isinstance(d.jax,
+                                                       jax.core.Tracer):
+                            continue
+                        if id(p) in before:
+                            d._rebind(before[id(p)])
+                        else:
+                            p._data = None
+                            p.initialize(force_reinit=True)
+                    try:
+                        net(*[_settle_slice(d) for d in data])
+                    except Exception:
+                        net(*data)
             finally:
                 _base.set_recording(rec)
         seen = set()

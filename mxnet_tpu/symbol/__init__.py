@@ -463,13 +463,20 @@ def _evaluate(root: Symbol, env: Dict[str, NDArray],
                 v = v[i._out_index]
             ins.append(v)
         if bn_capture is not None and n._op == "BatchNorm" \
-                and not n._attrs.get("output_mean_var") \
                 and not n._attrs.get("use_global_stats"):
-            attrs = dict(n._attrs)
-            attrs["_internal_stats"] = True
-            out, mean, var = _nd_ops.BatchNorm(*ins, **attrs)
-            cache[id(n)] = out
-            bn_capture[id(n)] = (ins[3], ins[4], mean, var)
+            momentum = float(n._attrs.get("momentum", 0.9))
+            if n._attrs.get("output_mean_var"):
+                # batch stats are already among the node's outputs
+                out = _run_node(n, ins)
+                cache[id(n)] = out
+                bn_capture[id(n)] = (ins[3], ins[4], out[1], out[2],
+                                     momentum)
+            else:
+                attrs = dict(n._attrs)
+                attrs["_internal_stats"] = True
+                out, mean, var = _nd_ops.BatchNorm(*ins, **attrs)
+                cache[id(n)] = out
+                bn_capture[id(n)] = (ins[3], ins[4], mean, var, momentum)
         else:
             cache[id(n)] = _run_node(n, ins)
 
@@ -519,6 +526,19 @@ def load(fname) -> Symbol:
         return load_json(f.read())
 
 
+def _op_num_outputs(opname: str, attrs) -> int:
+    """Static output arity of an op node from its attrs — shared by the
+    symbol factory and load_json so multi-output nodes survive the JSON
+    roundtrip (a loaded node with the default arity of 1 would hand
+    downstream consumers the whole output tuple)."""
+    if opname in ("split", "SliceChannel"):
+        return int(attrs.get("num_outputs",
+                             attrs.get("indices_or_sections", 1)))
+    if opname == "BatchNorm" and attrs.get("output_mean_var"):
+        return 3  # (out, batch_mean, batch_var)
+    return 1
+
+
 def load_json(json_str: str) -> Symbol:
     g = _json.loads(json_str)
     nodes: List[Symbol] = []
@@ -537,7 +557,8 @@ def load_json(json_str: str) -> Symbol:
                 parent = nodes[nid]
                 ins.append(_select(parent, out_i)
                            if parent._num_outputs > 1 else parent)
-            s = Symbol(jn["op"], jn["name"], ins, attrs)
+            s = Symbol(jn["op"], jn["name"], ins, attrs,
+                       num_outputs=_op_num_outputs(jn["op"], attrs))
         nodes.append(s)
     heads = g["heads"]
     outs = []
@@ -602,22 +623,15 @@ class Executor:
                 self.outputs = _evaluate(self._symbol, self.arg_dict,
                                          bn_capture=bn_capture)
                 self._train_outputs = self.outputs
-            # moving-statistics update (batch_norm.cc's aux mutation)
-            for node_id, (mm, mv, mean, var) in bn_capture.items():
-                node = self._bn_node(node_id)
-                m = float(node._attrs.get("momentum", 0.9))
+            # moving-statistics update (batch_norm.cc's aux mutation);
+            # momentum was recorded at capture time — no graph re-walk
+            for _, (mm, mv, mean, var, m) in bn_capture.items():
                 with autograd.pause():
                     mm._rebind(m * mm.jax + (1 - m) * mean.detach().jax)
                     mv._rebind(m * mv.jax + (1 - m) * var.detach().jax)
         else:
             self.outputs = _evaluate(self._symbol, self.arg_dict)
         return self.outputs
-
-    def _bn_node(self, node_id):
-        for n in _topo(self._symbol):
-            if id(n) == node_id:
-                return n
-        raise _base.MXNetError("lost BatchNorm node during forward")
 
     def backward(self, out_grads=None):
         from .. import autograd
@@ -702,7 +716,6 @@ def _auto_params(opname, args, kwargs, name):
     no_bias = bool(kwargs.get("no_bias", False))
     out = list(args)
     slots = list(zip(pnames, pinits)) + list(zip(anames, ainits))
-    aux_start = len(pnames)
     for slot_idx, (pname, init) in enumerate(slots):
         pos = 1 + slot_idx
         if pos < len(out) and out[pos] is not None:
@@ -713,8 +726,8 @@ def _auto_params(opname, args, kwargs, name):
             v = Variable(f"{name}_{pname}")
             if init is not None:
                 v._attrs["__init__"] = init
-            if slot_idx >= aux_start:
-                v._attrs["__aux__"] = True
+            # aux-vs-arg classification is positional (_aux_info), not
+            # attr-driven — no marker is stored here
         if pos < len(out):
             out[pos] = v
         else:
@@ -738,12 +751,8 @@ def _sym_op(opname):
             raise _base.MXNetError(
                 f"sym.{opname} expects Symbol inputs, got "
                 f"{[type(a).__name__ for a in args]}")
-        num_outputs = 1
-        if opname in ("split", "SliceChannel"):
-            num_outputs = int(kwargs.get("num_outputs",
-                                         kwargs.get("indices_or_sections", 1)))
         return _apply(opname, args, kwargs, name=name,
-                      num_outputs=num_outputs)
+                      num_outputs=_op_num_outputs(opname, kwargs))
 
     op.__name__ = opname
     return op

@@ -135,3 +135,154 @@ def test_interleaved_selfatt_ops_match_reference():
     pr /= pr.sum(-1, keepdims=True)
     ref = onp.einsum("bhqk,kbhd->qbhd", pr, v).reshape(t, b, h * d)
     onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_scan_layers_matches_loop():
+    """run_blocks lax.scan fast path == python loop, fwd and grad (compile
+    economics: deep homogeneous stacks compile ONE scan body)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import base as _base
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ndarray.ndarray import swap_values
+
+    net = get_gpt2("gpt2_124m", vocab_size=128, units=32, num_layers=8,
+                   num_heads=4, max_length=16, dropout=0.0)
+    net.initialize()
+    toks = mx.nd.array(onp.random.randint(0, 128, (2, 8)), dtype="int32")
+    labels = mx.nd.array(onp.random.randint(0, 128, (2, 8)), dtype="int32")
+    net(toks)  # settle shapes
+
+    items, seen = [], set()
+    for _, p in net.collect_params().items():
+        if id(p) in seen or p._data is None:
+            continue
+        seen.add(id(p))
+        items.append(p)
+    pv = tuple(p._data.jax for p in items)
+
+    def run(scan):
+        net._scan_layers = scan
+
+        def f(pv, t):
+            with swap_values([p._data for p in items], pv):
+                with _base.training_mode(False):
+                    rec = _base.set_recording(False)
+                    try:
+                        out = net.forward(NDArray(t))
+                    finally:
+                        _base.set_recording(rec)
+                return gpt2_lm_loss(out, labels).jax
+        loss, grads = jax.jit(jax.value_and_grad(f))(pv, toks.jax)
+        return loss, grads
+
+    from mxnet_tpu.models import transformer as _tr
+    l0, g0 = run(False)
+    n0 = _tr._scan_engaged_count
+    l1, g1 = run(True)
+    assert _tr._scan_engaged_count > n0, "scan fast path did not engage"
+    onp.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(g0, g1):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_scan_layers_per_layer_dropout_keys():
+    """Under the scan path each layer folds its index into the trace key —
+    dropout masks must differ across layers (python-loop semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import base as _base
+    from mxnet_tpu import random as _random
+    from mxnet_tpu.models import get_gpt2
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ndarray.ndarray import swap_values
+
+    net = get_gpt2("gpt2_124m", vocab_size=64, units=16, num_layers=8,
+                   num_heads=2, max_length=8, dropout=0.5)
+    net.initialize()
+    toks = mx.nd.array(onp.zeros((1, 4)), dtype="int32")
+    net(toks)
+
+    items, seen = [], set()
+    for _, p in net.collect_params().items():
+        if id(p) in seen or p._data is None:
+            continue
+        seen.add(id(p))
+        items.append(p)
+    pv = tuple(p._data.jax for p in items)
+
+    def f(pv, t, key):
+        _random.push_trace_key(key)
+        try:
+            with swap_values([p._data for p in items], pv):
+                with _base.training_mode(True):
+                    rec = _base.set_recording(False)
+                    try:
+                        return net.forward(NDArray(t)).jax
+                    finally:
+                        _base.set_recording(rec)
+        finally:
+            _random.pop_trace_key()
+
+    from mxnet_tpu.models import transformer as _tr
+    net._scan_layers = True
+    n0 = _tr._scan_engaged_count
+    k = jax.random.PRNGKey(3)
+    a = jax.jit(f)(pv, toks.jax, k)
+    assert _tr._scan_engaged_count > n0, "scan fast path did not engage"
+    b = jax.jit(f)(pv, toks.jax, jax.random.PRNGKey(4))
+    # different step keys → different dropout → different outputs
+    assert not onp.allclose(onp.asarray(a), onp.asarray(b))
+    # same key is deterministic
+    c = jax.jit(f)(pv, toks.jax, k)
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(c), rtol=1e-6)
+
+
+def test_scan_ineligible_when_configs_differ():
+    """Same param tree but different hyperparameters (causal flag) must
+    NOT share one scan body."""
+    import jax
+    from mxnet_tpu.models import transformer as _tr
+
+    blocks = [_tr.TransformerBlock(16, 32, 2, causal=(i % 2 == 0))
+              for i in range(8)]
+    for b in blocks:
+        b.initialize()
+    x = mx.nd.array(onp.random.randn(1, 4, 16).astype("f"))
+    for b in blocks:
+        b(x)  # settle
+
+    def f(v):
+        from mxnet_tpu.ndarray import NDArray
+        return _tr.run_blocks(blocks, NDArray(v), scan=True).jax
+    n0 = _tr._scan_engaged_count
+    jax.jit(f)(x.jax)
+    assert _tr._scan_engaged_count == n0, "scan engaged across mixed configs"
+
+
+def test_remat_loop_path_matches_plain():
+    """remat=True on the python-loop path (heterogeneous/short stacks)
+    must produce identical outputs to the plain loop."""
+    import jax
+    from mxnet_tpu.models import transformer as _tr
+    from mxnet_tpu.ndarray import NDArray
+
+    blocks = [_tr.TransformerBlock(16, 32, 2, causal=True)
+              for i in range(3)]
+    for b in blocks:
+        b.initialize()
+    x = mx.nd.array(onp.random.randn(2, 4, 16).astype("f"))
+    for b in blocks:
+        b(x)
+
+    def f(v, remat):
+        return _tr.run_blocks(blocks, NDArray(v), scan=False,
+                              remat=remat).jax
+    a = jax.jit(lambda v: f(v, False))(x.jax)
+    b = jax.jit(lambda v: f(v, True))(x.jax)
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=1e-5, atol=1e-6)

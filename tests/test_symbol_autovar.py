@@ -212,3 +212,39 @@ def test_user_supplied_moving_stats_classify_as_aux():
     bn = mx.sym.BatchNorm(d, g, b, mm, mv, name="bn")
     assert bn.list_auxiliary_states() == ["my_mean", "my_var"]
     assert "my_mean" not in bn.list_arguments()
+
+
+def test_batchnorm_output_mean_var_still_updates_moving_stats():
+    """BN with output_mean_var=True must ALSO update moving stats during
+    training (batch_norm.cc updates aux regardless of output_mean_var)."""
+    rs = onp.random.RandomState(1)
+    x = mx.nd.array((rs.randn(32, 6) * 4 + 5).astype("f"))
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(d, output_mean_var=True, name="bnm")
+    # use only the normalized output downstream; mean/var outputs exist
+    loss = mx.sym.MakeLoss(mx.sym.mean(bn[0] * bn[0]))
+    ex = loss.simple_bind(data=(32, 6))
+    ex.copy_params_from({"bnm_gamma": mx.nd.ones((6,)),
+                         "bnm_beta": mx.nd.zeros((6,)),
+                         "bnm_moving_mean": mx.nd.zeros((6,)),
+                         "bnm_moving_var": mx.nd.ones((6,))})
+    ex.arg_dict["data"]._rebind(x.jax)
+    ex.forward(is_train=True)
+    mm = ex.arg_dict["bnm_moving_mean"].asnumpy()
+    mv = ex.arg_dict["bnm_moving_var"].asnumpy()
+    assert abs(mm).max() > 0.1, mm       # moved toward batch mean (~5)
+    assert abs(mv - 1.0).max() > 0.1, mv
+
+
+def test_multi_output_batchnorm_json_roundtrip():
+    """num_outputs must survive tojson/load_json — a loaded multi-output
+    BN node with default arity would hand consumers the whole tuple."""
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(d, output_mean_var=True, name="bnr")
+    loss = mx.sym.MakeLoss(mx.sym.mean(bn[0] * bn[0] + bn[1]))
+    loaded = mx.sym.load_json(loss.tojson())
+    ex = loaded.simple_bind(data=(4, 3))
+    ex.arg_dict["data"]._rebind(
+        mx.nd.array(onp.random.randn(4, 3).astype("f")).jax)
+    out = ex.forward(is_train=True)
+    assert out[0].shape == ()
