@@ -179,6 +179,57 @@ def bench_resnet50(on_tpu: bool) -> dict:
                    "images/sec", mfu)
 
 
+# ------------------------------------------------------------ NMT (config 4)
+
+def bench_nmt(on_tpu: bool) -> dict:
+    """Transformer-big WMT-style encoder-decoder training throughput
+    (BASELINE config 4; Sockeye parity workload)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_nmt, nmt_loss
+
+    if on_tpu:
+        from mxnet_tpu.models import nmt as _nmt
+        batch, seq, steps, warmup = 16, 256, 10, 2
+        layers, units, hidden, _heads = _nmt._CONFIGS["transformer_big"]
+        vocab = 32000
+        net = get_nmt("transformer_big", src_vocab_size=vocab,
+                      dropout=0.0)
+    else:
+        batch, seq, steps, warmup = 4, 32, 2, 1
+        layers, units, hidden, vocab = 2, 64, 128, 512
+        net = get_nmt("transformer_base", src_vocab_size=vocab,
+                      units=units, hidden_size=hidden, num_layers=layers,
+                      num_heads=4, dropout=0.0)
+    net.initialize()
+    mesh = par.make_mesh()
+    batch = _fit_batch(batch, mesh)
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "adam", loss=lambda o, l: nmt_loss(o, l),
+            optimizer_params={"learning_rate": 1e-4}, mesh=mesh)
+        src = mx.nd.array(
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+        tgt = mx.nd.array(
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+        labels = mx.nd.array(
+            onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+        dt = _run_steps(trainer, [((src, tgt), labels)], warmup, steps)
+
+    tokens_per_sec = batch * seq * steps / dt
+    # per tgt token: decoder (self+cross attn + ffn) + encoder (per src
+    # token, same count) + tied output projection; x3 for training
+    enc_block = 4 * units * units + 2 * units * hidden
+    dec_block = 8 * units * units + 2 * units * hidden
+    flops_per_token = 6.0 * (layers * (enc_block + dec_block)
+                             + units * vocab) \
+        + 24.0 * layers * units * seq
+    mfu = tokens_per_sec * flops_per_token / (
+        peak_flops_per_device() * len(jax_devices()))
+    return _record("transformer_big_nmt_train_throughput", tokens_per_sec,
+                   "tokens/sec", mfu)
+
+
 # -------------------------------------------------------------- BERT-large
 
 def bench_bert(on_tpu: bool) -> dict:
@@ -248,7 +299,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="gpt2",
                     choices=["gpt2", "gpt2_long", "resnet50", "bert",
-                             "all"])
+                             "nmt", "all"])
     args = ap.parse_args()
 
     platform = _init_platform()
@@ -257,10 +308,11 @@ def main():
         from mxnet_tpu import amp
         amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
 
-    names = (["resnet50", "bert", "gpt2_long", "gpt2"]
+    names = (["resnet50", "bert", "nmt", "gpt2_long", "gpt2"]
              if args.workload == "all" else [args.workload])
     table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
-             "resnet50": bench_resnet50, "bert": bench_bert}
+             "resnet50": bench_resnet50, "bert": bench_bert,
+             "nmt": bench_nmt}
     for name in names:
         rec = table[name](on_tpu)
         print(json.dumps(rec), flush=True)

@@ -95,10 +95,10 @@ class Parameter:
         with jax.ensure_compile_time_eval():
             arr = _ndmod.zeros(self.shape, ctx=ctx, dtype=self.dtype)
             initializer(self._name, arr, explicit=self.init is not None)
-        self._data = arr
-        self._deferred_init = None
-        if self.grad_req != "null":
-            self._attach_grad()
+            self._data = arr
+            self._deferred_init = None
+            if self.grad_req != "null":
+                self._attach_grad()   # grad buffer must be concrete too
 
     def _finish_deferred_init(self):
         if self._deferred_init is None:

@@ -8,6 +8,7 @@ from . import vision
 from .bert import BERTForPretrain, BERTModel, get_bert
 from .gpt2 import GPT2Model, get_gpt2, gpt2_lm_loss
 from .moe import MoELayer, MoETransformerBlock, pop_aux_losses
+from .nmt import TransformerDecoderBlock, TransformerNMT, get_nmt, nmt_loss
 from .stacked import StackedGPT2Model, get_stacked_gpt2
 from .transformer import (MultiHeadAttention, PositionwiseFFN,
                           TransformerBlock, TransformerEncoderLayer)
@@ -17,4 +18,6 @@ __all__ = ["vision", "get_model", "BERTModel", "BERTForPretrain", "get_bert",
            "GPT2Model", "get_gpt2", "gpt2_lm_loss", "MoELayer",
            "MoETransformerBlock", "pop_aux_losses", "StackedGPT2Model",
            "get_stacked_gpt2", "MultiHeadAttention", "PositionwiseFFN",
-           "TransformerBlock", "TransformerEncoderLayer"]
+           "TransformerBlock", "TransformerEncoderLayer",
+           "TransformerNMT", "TransformerDecoderBlock", "get_nmt",
+           "nmt_loss"]

@@ -1,0 +1,250 @@
+"""Sockeye-style Transformer NMT (BASELINE config 4: Transformer-big
+WMT En-De).
+
+Parity: the reference kept NMT out of tree (Sockeye over BucketingModule +
+the contrib fused attention matmuls, SURVEY.md §2.4 "bucketing"); here the
+encoder-decoder transformer is in-tree on the same TP/SP-aware blocks as
+BERT/GPT-2, with sinusoidal positions, tied target embeddings and
+label-smoothed CE — trainable via ShardedTrainer (one jitted SPMD step) or
+Module/BucketingModule (variable-length buckets share parameters, the
+compile-cache discipline of SURVEY.md §7.3 hard part 3).
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense, Dropout, Embedding, LayerNorm
+from ..ndarray import ops as F
+from ..parallel.sharding import annotate
+from .transformer import (MultiHeadAttention, PositionwiseFFN,
+                          TransformerEncoderLayer, run_blocks)
+
+_CONFIGS = {
+    # name: (layers, units, hidden, heads)
+    "transformer_base": (6, 512, 2048, 8),
+    "transformer_big": (6, 1024, 4096, 16),
+}
+
+
+def _sinusoidal_positions(x, units):
+    """(B, T, U) positional encoding added functionally (Sockeye default —
+    no learned position table, any length up to the trace shape works)."""
+    import jax.numpy as jnp
+
+    from ..ndarray.ops import _as_nd, invoke
+
+    def f(v):
+        t = v.shape[1]
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        dim = jnp.arange(units // 2, dtype=jnp.float32)[None, :]
+        ang = pos / jnp.power(10000.0, 2.0 * dim / units)
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        return (v + pe[None].astype(v.dtype))
+
+    return invoke("sinusoidal_pos", f, [_as_nd(x)])
+
+
+class TransformerDecoderBlock(HybridBlock):
+    """Pre-LN decoder layer: causal self-attention → encoder cross-attention
+    → FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, layer_norm_eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.self_attn = MultiHeadAttention(
+            units, num_heads, dropout=dropout,
+            attention_dropout=attention_dropout, causal=True)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.cross_attn = MultiHeadAttention(
+            units, num_heads, dropout=dropout,
+            attention_dropout=attention_dropout, causal=False)
+        self.ln3 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout)
+
+    def forward(self, x, memory, mem_mask=None):
+        x = x + self.self_attn(self.ln1(x))
+        x = x + self.cross_attn(self.ln2(x), mem_mask, memory)
+        return x + self.ffn(self.ln3(x))
+
+
+class TransformerNMT(HybridBlock):
+    """Encoder-decoder transformer: (src, tgt) int32 token batches →
+    logits (B, T_tgt, tgt_vocab).  ``tgt`` is the shifted-right target
+    (BOS-prefixed); labels are the unshifted target."""
+
+    def __init__(self, src_vocab_size, tgt_vocab_size=None, units=512,
+                 hidden_size=2048, num_layers=6, num_heads=8,
+                 dropout=0.1, layer_norm_eps=1e-5, shared_embed=False,
+                 scan_layers=None, remat=False, **kwargs):
+        super().__init__(**kwargs)
+        tgt_vocab_size = tgt_vocab_size or src_vocab_size
+        self._units = units
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self._scan_layers = scan_layers
+        self._remat = remat
+        self.src_embed = Embedding(src_vocab_size, units)
+        annotate(self.src_embed.weight, "vocab", "embed")
+        if shared_embed:
+            if tgt_vocab_size != src_vocab_size:
+                raise ValueError("shared_embed needs equal vocab sizes")
+            self.tgt_embed = self.src_embed
+        else:
+            self.tgt_embed = Embedding(tgt_vocab_size, units)
+            annotate(self.tgt_embed.weight, "vocab", "embed")
+        self.drop = Dropout(dropout) if dropout else None
+        self.enc_layers = []
+        for i in range(num_layers):
+            layer = TransformerEncoderLayer(
+                units, hidden_size, num_heads, dropout=dropout,
+                layer_norm_eps=layer_norm_eps)
+            self.register_child(layer, f"enc{i}")
+            self.enc_layers.append(layer)
+        self.enc_ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.dec_layers = []
+        for i in range(num_layers):
+            layer = TransformerDecoderBlock(
+                units, hidden_size, num_heads, dropout=dropout,
+                layer_norm_eps=layer_norm_eps)
+            self.register_child(layer, f"dec{i}")
+            self.dec_layers.append(layer)
+        self.dec_ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+
+    # ------------------------------------------------------------------
+    def _src_mask(self, src, src_valid_length):
+        if src_valid_length is None:
+            return None
+        b, ts = src.shape
+        steps = F.arange_like(src, axis=1)
+        return (steps.reshape((1, 1, 1, ts)) <
+                src_valid_length.reshape((b, 1, 1, 1)))
+
+    def encode(self, src, src_valid_length=None):
+        x = self.src_embed(src) * math.sqrt(self._units)
+        x = _sinusoidal_positions(x, self._units)
+        if self.drop is not None:
+            x = self.drop(x)
+        mask = self._src_mask(src, src_valid_length)
+        x = run_blocks(self.enc_layers, x, mask, scan=self._scan_layers,
+                       remat=self._remat)
+        return self.enc_ln(x)
+
+    def decode(self, tgt, memory, src=None, src_valid_length=None):
+        y = self.tgt_embed(tgt) * math.sqrt(self._units)
+        y = _sinusoidal_positions(y, self._units)
+        if self.drop is not None:
+            y = self.drop(y)
+        mem_mask = (self._src_mask(src, src_valid_length)
+                    if src is not None else None)
+        import jax
+
+        from ..ndarray import NDArray
+        if self._remat and isinstance(y.jax, jax.core.Tracer):
+            # activation checkpointing for the decoder stack too (the
+            # loop-path remat of transformer.run_blocks: per-layer
+            # jax.checkpoint with the layer index folded into the trace
+            # key so fwd and rematerialized traces draw identical
+            # dropout masks); memory is an explicit input so it is
+            # saved, not recomputed
+            from .. import random as _random
+            providers = _random._trace_providers()
+            base_key = providers[-1].key if providers else None
+            for i, blk in enumerate(self.dec_layers):
+                def f(h, mem, _blk=blk, _i=i):
+                    if base_key is not None:
+                        _random.push_trace_key(
+                            jax.random.fold_in(base_key, 1 << 20 | _i))
+                    try:
+                        return _blk(NDArray(h), NDArray(mem),
+                                    mem_mask).jax
+                    finally:
+                        if base_key is not None:
+                            _random.pop_trace_key()
+                y = NDArray(jax.checkpoint(f)(y.jax, memory.jax))
+        else:
+            for blk in self.dec_layers:
+                y = blk(y, memory, mem_mask)
+        y = self.dec_ln(y)
+        # tied output projection: logits = y · tgt_embedᵀ
+        return F.FullyConnected(y, self.tgt_embed.weight.data(), None,
+                                num_hidden=self.tgt_vocab_size,
+                                no_bias=True, flatten=False)
+
+    def forward(self, src, tgt, src_valid_length=None):
+        memory = self.encode(src, src_valid_length)
+        return self.decode(tgt, memory, src, src_valid_length)
+
+    # ----------------------------------------------------------- inference
+    def translate(self, src, src_valid_length=None, max_length=32,
+                  bos_id=1, eos_id=2):
+        """Greedy decode (eager).  Returns (B, <=max_length) int32 tokens
+        ending at EOS per row (padded with EOS)."""
+        import numpy as onp
+
+        from .. import base as _base
+        from ..ndarray import NDArray
+        from ..ndarray import array as nd_array
+
+        # params may live sharded on a mesh (post-ShardedTrainer);
+        # replicate the eager inputs onto the same device set
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+        wsh = getattr(self.src_embed.weight._data.jax, "sharding", None)
+        if isinstance(wsh, NamedSharding):
+            def _put(a):
+                return NDArray(jax.device_put(
+                    a.jax, NamedSharding(wsh.mesh, _P())))
+        else:
+            def _put(a):
+                return a
+
+        src = _put(src)
+        if src_valid_length is not None:
+            src_valid_length = _put(src_valid_length)
+
+        with _base.training_mode(False):
+            memory = self.encode(src, src_valid_length)
+            b = src.shape[0]
+            tokens = onp.full((b, 1), bos_id, dtype="int32")
+            done = onp.zeros((b,), dtype=bool)
+            for _ in range(max_length):
+                logits = self.decode(_put(nd_array(tokens, dtype="int32")),
+                                     memory, src, src_valid_length)
+                nxt = logits.asnumpy()[:, -1].argmax(-1).astype("int32")
+                nxt = onp.where(done, eos_id, nxt)
+                done |= nxt == eos_id
+                tokens = onp.concatenate([tokens, nxt[:, None]], axis=1)
+                if done.all():
+                    break
+            return tokens[:, 1:]
+
+
+def nmt_loss(logits, labels, valid_length=None, label_smoothing=0.1):
+    """Label-smoothed cross entropy over non-pad positions (Sockeye's
+    default training loss, ls=0.1)."""
+    v = logits.shape[-1]
+    lse = F.logsumexp(logits, axis=-1)
+    picked = F.pick(logits, labels, axis=-1)
+    # smoothed nll = (1-eps)*nll_target + eps * mean_nll_all
+    # mean over classes of (lse - logit) = lse - mean(logits)
+    nll_tgt = lse - picked
+    nll_all = lse - logits.mean(axis=-1)
+    nll = (1.0 - label_smoothing) * nll_tgt + label_smoothing * nll_all
+    if valid_length is not None:
+        b, t = labels.shape
+        steps = F.arange_like(labels, axis=1)
+        m = (steps.reshape((1, t)) <
+             valid_length.reshape((b, 1))).astype("float32")
+        return (nll * m).sum() / m.sum()
+    return nll.mean()
+
+
+def get_nmt(name="transformer_base", **kwargs):
+    layers, units, hidden, heads = _CONFIGS[name]
+    cfg = dict(units=units, hidden_size=hidden, num_layers=layers,
+               num_heads=heads)
+    cfg.update(kwargs)
+    return TransformerNMT(**cfg)

@@ -53,12 +53,17 @@ class MultiHeadAttention(HybridBlock):
             annotate(self.out_proj.bias, "norm")
         self.dropout = Dropout(dropout) if dropout else None
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, memory=None):
+        """Self-attention over ``x``; cross-attention when ``memory`` is
+        given (queries from ``x``, keys/values from ``memory`` — the
+        encoder-decoder attention of Sockeye-style NMT)."""
         b, t = x.shape[0], x.shape[1]
         h, d = self._num_heads, self._head_dim
+        kv = x if memory is None else memory
+        tk = kv.shape[1]
         q = self.q_proj(x).reshape((b, t, h, d))
-        k = self.k_proj(x).reshape((b, t, h, d))
-        v = self.v_proj(x).reshape((b, t, h, d))
+        k = self.k_proj(kv).reshape((b, tk, h, d))
+        v = self.v_proj(kv).reshape((b, tk, h, d))
         mesh = _par.current_mesh()
         sp = _par.axis_size(mesh, "sp") if mesh is not None else 1
         # shard_map needs every sharded dim to divide its mesh axis —
@@ -66,7 +71,8 @@ class MultiHeadAttention(HybridBlock):
         divisible = (sp > 1 and isinstance(t, int) and t % sp == 0
                      and b % _par.axis_size(mesh, "dp") == 0
                      and h % _par.axis_size(mesh, "tp") == 0)
-        if divisible and mask is None and self._att_dropout == 0.0:
+        if divisible and mask is None and memory is None \
+                and self._att_dropout == 0.0:
             # sequence parallel: K/V chunks ride the ICI ring instead of
             # an all-gather of the full sequence per device
             from ..ops import nd_ring_attention

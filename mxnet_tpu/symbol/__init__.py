@@ -463,9 +463,9 @@ def _evaluate(root: Symbol, env: Dict[str, NDArray],
                 v = v[i._out_index]
             ins.append(v)
         if bn_capture is not None and n._op == "BatchNorm" \
-                and not n._attrs.get("use_global_stats"):
+                and not _attr_bool(n._attrs.get("use_global_stats")):
             momentum = float(n._attrs.get("momentum", 0.9))
-            if n._attrs.get("output_mean_var"):
+            if _attr_bool(n._attrs.get("output_mean_var")):
                 # batch stats are already among the node's outputs
                 out = _run_node(n, ins)
                 cache[id(n)] = out
@@ -526,6 +526,15 @@ def load(fname) -> Symbol:
         return load_json(f.read())
 
 
+def _attr_bool(v) -> bool:
+    """Normalize a boolean op attr that may arrive as a Python bool or as
+    an upstream-JSON string ('True'/'False'/'1'/'0' — MXNet 1.x serializes
+    every attr as str, and 'False' is truthy)."""
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1")
+    return bool(v)
+
+
 def _op_num_outputs(opname: str, attrs) -> int:
     """Static output arity of an op node from its attrs — shared by the
     symbol factory and load_json so multi-output nodes survive the JSON
@@ -534,7 +543,7 @@ def _op_num_outputs(opname: str, attrs) -> int:
     if opname in ("split", "SliceChannel"):
         return int(attrs.get("num_outputs",
                              attrs.get("indices_or_sections", 1)))
-    if opname == "BatchNorm" and attrs.get("output_mean_var"):
+    if opname == "BatchNorm" and _attr_bool(attrs.get("output_mean_var")):
         return 3  # (out, batch_mean, batch_var)
     return 1
 

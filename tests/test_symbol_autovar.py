@@ -248,3 +248,21 @@ def test_multi_output_batchnorm_json_roundtrip():
         mx.nd.array(onp.random.randn(4, 3).astype("f")).jax)
     out = ex.forward(is_train=True)
     assert out[0].shape == ()
+
+
+def test_string_bool_attrs_from_upstream_json():
+    """Upstream MXNet 1.x serializes every attr as a string; a loaded
+    BatchNorm with output_mean_var='False' must stay single-output."""
+    import json as _j
+    d = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(d, name="bns")
+    g = _j.loads(bn.tojson())
+    for n in g["nodes"]:
+        if n["op"] == "BatchNorm":
+            n["attrs"]["output_mean_var"] = "False"   # upstream style
+            n["attrs"]["use_global_stats"] = "False"
+    loaded = mx.sym.load_json(_j.dumps(g))
+    assert loaded.num_outputs == 1
+    ex = loaded.simple_bind(data=(4, 3))
+    out = ex.forward(is_train=True)
+    assert len(out) == 1 and out[0].shape == (4, 3)
