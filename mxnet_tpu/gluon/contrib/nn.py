@@ -1,0 +1,48 @@
+"""gluon.contrib.nn layer parity (upstream:
+python/mxnet/gluon/contrib/nn/basic_layers.py — Concurrent,
+HybridConcurrent, Identity, SyncBatchNorm).
+
+Identity and SyncBatchNorm live in gluon.nn here (SyncBatchNorm is the
+plain BatchNorm under GSPMD: batch statistics reduce over the GLOBAL
+batch inside the jitted SPMD step, which IS cross-replica sync); both are
+re-exported for upstream import paths.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Identity, SyncBatchNorm  # noqa: F401  (upstream path)
+from ...ndarray import ops as F
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SyncBatchNorm"]
+
+
+class Concurrent(HybridBlock):
+    """Run children on the same input and concatenate outputs along
+    ``axis`` (upstream: contrib.nn.Concurrent; Inception-style branches).
+    """
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block, str(len(self._layers)))
+            self._layers.append(block)
+        return self
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __getitem__(self, i):
+        return self._layers[i]
+
+    def forward(self, x):
+        return F.concat(*[blk(x) for blk in self._layers],
+                        dim=self.axis)
+
+
+class HybridConcurrent(Concurrent):
+    """Alias of Concurrent — every block here is hybridizable (the
+    eager/traced split is dispatch-level, not class-level)."""

@@ -178,29 +178,21 @@ class TransformerNMT(HybridBlock):
 
     # ----------------------------------------------------------- inference
     def translate(self, src, src_valid_length=None, max_length=32,
-                  bos_id=1, eos_id=2):
-        """Greedy decode (eager).  Returns (B, <=max_length) int32 tokens
-        ending at EOS per row (padded with EOS)."""
+                  bos_id=1, eos_id=2, beam_size=1, alpha=1.0):
+        """Greedy (``beam_size=1``) or length-normalized beam decode
+        (Sockeye's default inference; ``alpha`` is the length-penalty
+        exponent).  Returns (B, <=max_length) int32 tokens padded with
+        EOS."""
+        if beam_size > 1:
+            return self._beam_translate(src, src_valid_length, max_length,
+                                        bos_id, eos_id, beam_size, alpha)
         import numpy as onp
 
         from .. import base as _base
         from ..ndarray import NDArray
         from ..ndarray import array as nd_array
 
-        # params may live sharded on a mesh (post-ShardedTrainer);
-        # replicate the eager inputs onto the same device set
-        import jax
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as _P
-        wsh = getattr(self.src_embed.weight._data.jax, "sharding", None)
-        if isinstance(wsh, NamedSharding):
-            def _put(a):
-                return NDArray(jax.device_put(
-                    a.jax, NamedSharding(wsh.mesh, _P())))
-        else:
-            def _put(a):
-                return a
-
+        _put = self._mesh_put()
         src = _put(src)
         if src_valid_length is not None:
             src_valid_length = _put(src_valid_length)
@@ -220,6 +212,92 @@ class TransformerNMT(HybridBlock):
                 if done.all():
                     break
             return tokens[:, 1:]
+
+    def _mesh_put(self):
+        """Params may live sharded on a mesh (post-ShardedTrainer);
+        returns a fn replicating eager inputs onto the same device set."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+
+        from ..ndarray import NDArray
+
+        wsh = getattr(self.src_embed.weight._data.jax, "sharding", None)
+        if isinstance(wsh, NamedSharding):
+            def _put(a):
+                return NDArray(jax.device_put(
+                    a.jax, NamedSharding(wsh.mesh, _P())))
+            return _put
+        return lambda a: a
+
+    def _beam_translate(self, src, src_valid_length, max_length, bos_id,
+                        eos_id, k, alpha):
+        import numpy as onp
+
+        from .. import base as _base
+        from ..ndarray import array as nd_array
+
+        from ..ndarray import ops as _ops
+
+        _put = self._mesh_put()
+        src_np = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+        b, ts = src_np.shape
+        # encode each source ONCE; beams share repeated memory rows
+        # (src_rep is only consulted for the padding mask — no encoder run)
+        src_rep = onp.repeat(src_np, k, axis=0).astype("int32")
+        vlen = None
+        vlen_rep = None
+        if src_valid_length is not None:
+            v = (src_valid_length.asnumpy()
+                 if hasattr(src_valid_length, "asnumpy")
+                 else onp.asarray(src_valid_length))
+            vlen = _put(nd_array(v.astype("int32"), dtype="int32"))
+            vlen_rep = _put(nd_array(onp.repeat(v, k, axis=0).astype("int32"),
+                                     dtype="int32"))
+        src_rep_nd = _put(nd_array(src_rep, dtype="int32"))
+
+        with _base.training_mode(False):
+            memory = _ops.repeat(
+                self.encode(_put(nd_array(src_np.astype("int32"),
+                                          dtype="int32")), vlen),
+                repeats=k, axis=0)
+            tokens = onp.full((b * k, 1), bos_id, dtype="int32")
+            scores = onp.full((b, k), -1e30, dtype="float64")
+            scores[:, 0] = 0.0           # all beams start identical: keep 1
+            done = onp.zeros((b * k,), dtype=bool)
+            for _ in range(max_length):
+                logits = self.decode(_put(nd_array(tokens, dtype="int32")),
+                                     memory, src_rep_nd, vlen_rep)
+                step = logits.asnumpy()[:, -1].astype("float64")  # (b*k, V)
+                logp = step - onp.log(onp.exp(
+                    step - step.max(-1, keepdims=True)).sum(-1,
+                                                            keepdims=True)) \
+                    - step.max(-1, keepdims=True)
+                v = logp.shape[-1]
+                # finished beams only extend with EOS at zero cost
+                logp[done] = -1e30
+                logp[done, eos_id] = 0.0
+                cand = scores.reshape(b * k, 1) + logp       # (b*k, V)
+                cand = cand.reshape(b, k * v)
+                top = onp.argpartition(-cand, k - 1, axis=1)[:, :k]
+                top_scores = onp.take_along_axis(cand, top, axis=1)
+                order = onp.argsort(-top_scores, axis=1)
+                top = onp.take_along_axis(top, order, axis=1)
+                scores = onp.take_along_axis(top_scores, order, axis=1)
+                beam_idx = top // v                          # (b, k)
+                tok_idx = (top % v).astype("int32")
+                flat = (onp.arange(b)[:, None] * k + beam_idx).reshape(-1)
+                tokens = onp.concatenate(
+                    [tokens[flat], tok_idx.reshape(-1, 1)], axis=1)
+                done = done[flat] | (tokens[:, -1] == eos_id)
+                if done.all():
+                    break
+            # length-normalized best beam per row (Sockeye lp: len^alpha)
+            lengths = (tokens[:, 1:] != eos_id).sum(1) + 1.0
+            norm = scores.reshape(-1) / (lengths ** alpha)
+            best = norm.reshape(b, k).argmax(1)
+            out = tokens.reshape(b, k, -1)[onp.arange(b), best, 1:]
+            return out.astype("int32")
 
 
 def nmt_loss(logits, labels, valid_length=None, label_smoothing=0.1):

@@ -49,12 +49,16 @@ def _attention_ref(q, k, v, *, causal=False, mask=None, scale=None,
 
 # ------------------------------------------------------------------ dispatch
 
-def _use_flash(q_shape, causal, mask, dropout) -> bool:
-    """Flash kernel handles: no explicit mask, no attention dropout, long
-    128-aligned sequences, head dims the MXU tiles well (64/128/256)."""
+def _use_flash(q_shape, causal, mask, dropout, k_shape=None) -> bool:
+    """Flash kernel handles: SELF-attention (tq == tk — cross-attention
+    with a different source length falls back to the XLA path), no
+    explicit mask, no attention dropout, long 128-aligned sequences,
+    head dims the MXU tiles well (64/128/256)."""
     if mask is not None or dropout > 0.0:
         return False
     b, t, h, d = q_shape
+    if k_shape is not None and tuple(k_shape) != tuple(q_shape):
+        return False
     if t < 256 or t % 128 or d not in (64, 128, 256):
         return False
     if jax.default_backend() != "tpu":
@@ -68,7 +72,7 @@ def _use_flash(q_shape, causal, mask, dropout) -> bool:
 
 def flash_attention(q, k, v, *, causal=False, scale=None):
     """Jax-level flash attention entry (Pallas on TPU, reference on CPU)."""
-    if _use_flash(q.shape, causal, None, 0.0):
+    if _use_flash(q.shape, causal, None, 0.0, k.shape):
         from .flash import flash_attention as _pallas
         return _pallas(q, k, v, causal=causal, scale=scale)
     return _attention_ref(q, k, v, causal=causal, scale=scale)
@@ -94,7 +98,7 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
             "dropout — use impl='auto'/'ref'")
 
     if impl == "flash" and not _use_flash(query.shape, causal, mask_val,
-                                          dropout):
+                                          dropout, key.shape):
         raise _base.MXNetError(
             f"impl='flash' requested but the Pallas kernel does not support "
             f"this configuration (shape={tuple(query.shape)}, platform="
@@ -104,7 +108,8 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
             "back silently")
 
     def f(q, k, v):
-        if impl != "ref" and _use_flash(q.shape, causal, mask_val, dropout):
+        if impl != "ref" and _use_flash(q.shape, causal, mask_val, dropout,
+                                        k.shape):
             from .flash import flash_attention as _pallas
             return _pallas(q, k, v, causal=causal, scale=scale)
         return _attention_ref(q, k, v, causal=causal, mask=mask_val,

@@ -243,6 +243,12 @@ class Symbol:
         from .. import subgraph as _subgraph
         return _subgraph.optimize_for(self, backend, **kwargs)
 
+    def apply_pass(self, name, **kwargs):
+        """Run a registered graph pass (parity: nnvm pass registry;
+        see mxnet_tpu.symbol.passes)."""
+        from . import passes as _passes
+        return _passes.apply_pass(self, name, **kwargs)
+
     def tojson(self) -> str:
         nodes = _topo(self)
         idx = {id(n): i for i, n in enumerate(nodes)}
@@ -590,6 +596,13 @@ class Executor:
     """
 
     def __init__(self, symbol: Symbol, ctx, args, args_grad, grad_req):
+        import os
+        if os.environ.get("MXNET_TPU_GRAPH_CSE", "1") != "0":
+            # bind-time common-subexpression elimination (parity: the 2.x
+            # CSE nnvm pass run during graph init; MXNET_TPU_GRAPH_CSE=0
+            # disables).  Pure-node merge only — see symbol/passes.py.
+            from . import passes as _passes
+            symbol = _passes.common_subexpr_elim(symbol)
         self._symbol = symbol
         self._ctx = ctx or current_context()
         names = symbol.list_arguments()

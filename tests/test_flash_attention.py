@@ -94,3 +94,15 @@ def test_dot_product_attention_dispatch_ref():
     ref = _attention_ref(q.jax, q.jax, q.jax, causal=True)
     onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref), rtol=1e-5,
                                 atol=1e-5)
+
+
+def test_use_flash_rejects_cross_attention_shapes(monkeypatch):
+    """Cross-attention (tq != tk) must never take the Pallas self-attention
+    kernel, even when the query shape alone qualifies."""
+    from mxnet_tpu.ops import attention as att
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    q = (2, 256, 4, 64)
+    assert att._use_flash(q, True, None, 0.0, q)            # self: ok
+    assert not att._use_flash(q, True, None, 0.0, (2, 300, 4, 64))
+    assert not att._use_flash(q, False, None, 0.0, (2, 1536, 4, 64))

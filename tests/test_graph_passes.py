@@ -1,0 +1,90 @@
+"""NNVM-style graph passes (symbol/passes.py): CSE + identity elim."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.symbol import passes
+
+
+def test_cse_merges_identical_pure_nodes():
+    d = mx.sym.Variable("data")
+    a = mx.sym.exp(d) + mx.sym.exp(d)       # identical exp twice
+    before = passes.node_count(a)
+    opt = a.apply_pass("CommonSubexprElim")
+    after = passes.node_count(opt)
+    assert after < before
+    ex = opt.simple_bind(data=(3,))
+    x = onp.random.randn(3).astype("f")
+    ex.arg_dict["data"]._rebind(mx.nd.array(x).jax)
+    out = ex.forward()[0].asnumpy()
+    onp.testing.assert_allclose(out, 2 * onp.exp(x), rtol=1e-5)
+
+
+def test_cse_does_not_merge_dropout():
+    d = mx.sym.Variable("data")
+    s = mx.sym.Dropout(d, p=0.5) + mx.sym.Dropout(d, p=0.5)
+    opt = s.apply_pass("CommonSubexprElim")
+    from mxnet_tpu.symbol import _topo
+    assert sum(1 for n in _topo(opt) if n._op == "Dropout") == 2
+
+
+def test_cse_respects_attr_differences():
+    d = mx.sym.Variable("data")
+    s = mx.sym.Group([mx.sym.sum(d, axis=0),
+                      mx.sym.sum(d, axis=0, keepdims=True)])
+    opt = s.apply_pass("CommonSubexprElim")
+    from mxnet_tpu.symbol import _topo
+    assert sum(1 for n in _topo(opt) if n._op == "sum") == 2
+    # identical attrs DO merge
+    s2 = mx.sym.Group([mx.sym.sum(d, axis=0), mx.sym.sum(d, axis=0)])
+    opt2 = s2.apply_pass("CommonSubexprElim")
+    assert sum(1 for n in _topo(opt2) if n._op == "sum") == 1
+
+
+def test_eliminate_identity():
+    d = mx.sym.Variable("data")
+    s = mx.sym.identity(mx.sym.identity(d)) + 1.0
+    opt = s.apply_pass("EliminateIdentity")
+    from mxnet_tpu.symbol import _topo
+    assert sum(1 for n in _topo(opt) if n._op == "identity") == 0
+    ex = opt.simple_bind(data=(2,))
+    ex.arg_dict["data"]._rebind(mx.nd.array(onp.ones(2, "f")).jax)
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(), [2.0, 2.0])
+
+
+def test_executor_applies_cse_by_default(monkeypatch):
+    d = mx.sym.Variable("data")
+    a = mx.sym.exp(d) * mx.sym.exp(d)
+    ex = a.simple_bind(data=(2,))
+    from mxnet_tpu.symbol import _topo
+    assert sum(1 for n in _topo(ex._symbol) if n._op == "exp") == 1
+    monkeypatch.setenv("MXNET_TPU_GRAPH_CSE", "0")
+    ex2 = a.simple_bind(data=(2,))
+    assert sum(1 for n in _topo(ex2._symbol) if n._op == "exp") == 2
+
+
+def test_pass_registry_custom():
+    import pytest
+    from mxnet_tpu.symbol.passes import register_pass, list_passes
+
+    @register_pass("MyPass")
+    def my_pass(sym, **kw):
+        return sym
+
+    assert "MyPass" in list_passes()
+    d = mx.sym.Variable("x")
+    assert (d + 1).apply_pass("MyPass") is not None
+    with pytest.raises(Exception):
+        d.apply_pass("NoSuchPass")
+
+
+def test_cse_multi_output_safe():
+    """Two identical split consumers merge; distinct outputs stay distinct."""
+    d = mx.sym.Variable("data")
+    s1 = mx.sym.split(d, num_outputs=2)
+    out = s1[0] + s1[1]
+    opt = out.apply_pass("CommonSubexprElim")
+    ex = opt.simple_bind(data=(2, 4))
+    x = onp.arange(8, dtype="f").reshape(2, 4)
+    ex.arg_dict["data"]._rebind(mx.nd.array(x).jax)
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                x[:, :2] + x[:, 2:])
