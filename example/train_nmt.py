@@ -1,0 +1,69 @@
+"""Train a Sockeye-style Transformer NMT on a synthetic copy task and
+decode with beam search.
+
+Classic-MXNet shape: the reference ran NMT via Sockeye over
+BucketingModule; here the in-tree TransformerNMT trains as ONE jitted
+SPMD step on whatever mesh is available (1 chip .. pod) and decodes with
+length-normalized beam search.
+
+Run (CPU, ~1 min):  python example/train_nmt.py --steps 200
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.models import get_nmt, nmt_loss
+
+BOS, EOS = 1, 2
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seqlen", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--beam", type=int, default=4)
+    args = ap.parse_args()
+
+    net = get_nmt("transformer_base", src_vocab_size=args.vocab,
+                  units=64, hidden_size=128, num_layers=2, num_heads=4,
+                  dropout=0.0, shared_embed=True)
+    net.initialize()
+    mesh = par.make_mesh()
+
+    def batch():
+        src = onp.random.randint(3, args.vocab,
+                                 (args.batch, args.seqlen)).astype("int32")
+        tgt_in = onp.concatenate(
+            [onp.full((args.batch, 1), BOS, "int32"), src[:, :-1]], 1)
+        return (mx.nd.array(src, dtype="int32"),
+                mx.nd.array(tgt_in, dtype="int32")), \
+            mx.nd.array(src, dtype="int32")
+
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "adam", loss=lambda o, l: nmt_loss(o, l),
+            optimizer_params={"learning_rate": 5e-3}, mesh=mesh)
+        for step in range(args.steps):
+            (src, tgt_in), labels = batch()
+            loss = float(trainer.step((src, tgt_in), labels).asnumpy())
+            if step % 50 == 0 or step == args.steps - 1:
+                print(f"step {step:4d}  loss {loss:.4f}", flush=True)
+
+    src = onp.random.randint(3, args.vocab, (3, args.seqlen)).astype("int32")
+    out = net.translate(mx.nd.array(src, dtype="int32"),
+                        max_length=args.seqlen, bos_id=BOS, eos_id=EOS,
+                        beam_size=args.beam)
+    acc = (out[:, :args.seqlen] == src).mean()
+    print("beam copy accuracy:", acc)
+
+
+if __name__ == "__main__":
+    main()

@@ -241,20 +241,32 @@ class TransformerNMT(HybridBlock):
 
         _put = self._mesh_put()
         src_np = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
-        b, ts = src_np.shape
+        b, _ = src_np.shape
         # encode each source ONCE; beams share repeated memory rows
         # (src_rep is only consulted for the padding mask — no encoder run)
         src_rep = onp.repeat(src_np, k, axis=0).astype("int32")
         vlen = None
         vlen_rep = None
         if src_valid_length is not None:
-            v = (src_valid_length.asnumpy()
-                 if hasattr(src_valid_length, "asnumpy")
-                 else onp.asarray(src_valid_length))
-            vlen = _put(nd_array(v.astype("int32"), dtype="int32"))
-            vlen_rep = _put(nd_array(onp.repeat(v, k, axis=0).astype("int32"),
-                                     dtype="int32"))
+            vl_np = (src_valid_length.asnumpy()
+                     if hasattr(src_valid_length, "asnumpy")
+                     else onp.asarray(src_valid_length))
+            vlen = _put(nd_array(vl_np.astype("int32"), dtype="int32"))
+            vlen_rep = _put(nd_array(
+                onp.repeat(vl_np, k, axis=0).astype("int32"), dtype="int32"))
         src_rep_nd = _put(nd_array(src_rep, dtype="int32"))
+
+        # finished-hypothesis pool: a completed beam is recorded here the
+        # step it ends, so later continuations of higher-scoring live
+        # beams can never evict it before length normalization sees it
+        best_norm = onp.full((b,), -onp.inf, dtype="float64")
+        best_tokens = [None] * b
+
+        def _offer(row, toks, score):
+            n = score / (max(len(toks) - 1, 1) ** alpha)
+            if n > best_norm[row]:
+                best_norm[row] = n
+                best_tokens[row] = toks.copy()
 
         with _base.training_mode(False):
             memory = _ops.repeat(
@@ -273,31 +285,42 @@ class TransformerNMT(HybridBlock):
                     step - step.max(-1, keepdims=True)).sum(-1,
                                                             keepdims=True)) \
                     - step.max(-1, keepdims=True)
-                v = logp.shape[-1]
+                vocab = logp.shape[-1]
                 # finished beams only extend with EOS at zero cost
                 logp[done] = -1e30
                 logp[done, eos_id] = 0.0
                 cand = scores.reshape(b * k, 1) + logp       # (b*k, V)
-                cand = cand.reshape(b, k * v)
+                cand = cand.reshape(b, k * vocab)
                 top = onp.argpartition(-cand, k - 1, axis=1)[:, :k]
                 top_scores = onp.take_along_axis(cand, top, axis=1)
                 order = onp.argsort(-top_scores, axis=1)
                 top = onp.take_along_axis(top, order, axis=1)
                 scores = onp.take_along_axis(top_scores, order, axis=1)
-                beam_idx = top // v                          # (b, k)
-                tok_idx = (top % v).astype("int32")
+                beam_idx = top // vocab                      # (b, k)
+                tok_idx = (top % vocab).astype("int32")
                 flat = (onp.arange(b)[:, None] * k + beam_idx).reshape(-1)
+                was_done = done[flat]
                 tokens = onp.concatenate(
                     [tokens[flat], tok_idx.reshape(-1, 1)], axis=1)
-                done = done[flat] | (tokens[:, -1] == eos_id)
+                done = was_done | (tokens[:, -1] == eos_id)
+                newly = done & ~was_done
+                for i in onp.nonzero(newly)[0]:
+                    _offer(i // k, tokens[i], scores.reshape(-1)[i])
                 if done.all():
                     break
-            # length-normalized best beam per row (Sockeye lp: len^alpha)
+            # unfinished rows fall back to the best live beam,
+            # length-normalized (Sockeye lp: len^alpha)
             lengths = (tokens[:, 1:] != eos_id).sum(1) + 1.0
             norm = scores.reshape(-1) / (lengths ** alpha)
-            best = norm.reshape(b, k).argmax(1)
-            out = tokens.reshape(b, k, -1)[onp.arange(b), best, 1:]
-            return out.astype("int32")
+            live_best = norm.reshape(b, k).argmax(1)
+            out = onp.full((b, tokens.shape[1] - 1), eos_id, dtype="int32")
+            for row in range(b):
+                if best_tokens[row] is None:
+                    hyp = tokens.reshape(b, k, -1)[row, live_best[row], 1:]
+                else:
+                    hyp = best_tokens[row][1:]
+                out[row, :len(hyp)] = hyp
+            return out
 
 
 def nmt_loss(logits, labels, valid_length=None, label_smoothing=0.1):

@@ -113,6 +113,7 @@ _NONDIFF = {
     "unravel_index", "ravel_multi_index", "left_shift", "right_shift",
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
     "all", "any", "packbits", "unpackbits", "iinfo", "finfo",
+    "gcd", "lcm",
 }
 
 # jnp functions exported verbatim (name list is the mx.np parity surface).
@@ -169,6 +170,7 @@ _SIMPLE_OPS = [
     "bincount", "digitize", "histogram", "histogram2d", "histogramdd",
     "corrcoef", "cov", "convolve", "correlate", "interp", "gradient", "diff",
     "ediff1d", "polyval", "polyfit", "vander", "around", "round",
+    "gcd", "lcm", "trim_zeros", "apply_along_axis", "apply_over_axes",
     # type utilities
     "result_type", "can_cast", "promote_types", "iinfo", "finfo", "isscalar",
     "ndim", "shape", "size",
@@ -181,6 +183,17 @@ for _name in _SIMPLE_OPS:
     _seen.add(_name)
     globals()[_name] = _wrap_np_op(_name, getattr(_jnp, _name),
                                    differentiable=_name not in _NONDIFF)
+
+# legacy-name aliases (numpy deprecations jnp dropped)
+if "trapezoid" in globals():
+    trapz = globals()["trapezoid"]
+    __all__.append("trapz")
+if "in1d" not in globals() and "isin" in globals():
+    def in1d(ar1, ar2, assume_unique=False, invert=False):
+        res = globals()["isin"](ar1, ar2, assume_unique=assume_unique,
+                                invert=invert)
+        return globals()["ravel"](res)
+    __all__.append("in1d")
 
 abs = globals()["abs"]  # noqa: A001 — numpy parity shadows builtin here
 round = globals()["round"]  # noqa: A001

@@ -30,8 +30,10 @@ _PASSES: Dict[str, Callable] = {}
 # ops whose results must never be merged even when inputs coincide
 # (Custom runs user host callbacks that may be stochastic or stateful)
 _IMPURE_OPS = ("Dropout", "BatchNorm", "Custom")
+# NOTE: bare "gamma" would wrongly catch the pure Gamma-function op;
+# random gamma sampling is already covered by the sample_/random_ prefixes
 _IMPURE_PREFIXES = ("sample_", "random_", "_random", "uniform", "normal",
-                    "gamma", "shuffle")
+                    "shuffle")
 
 
 def register_pass(name: str):
