@@ -88,6 +88,43 @@ class MultiHeadAttention(HybridBlock):
             out = self.dropout(out)
         return out
 
+    def forward_step(self, x, cache, idx):
+        """Incremental decode: x (B,1,U) at position ``idx`` against the
+        KV cache {'k','v': (B,Tmax,H,D) jax arrays}.  Returns
+        (out (B,1,U), new cache).  Inference only (no dropout)."""
+        import jax
+
+        from ..ndarray import NDArray
+
+        b = x.shape[0]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((b, 1, h, d))
+        k_new = self.k_proj(x).reshape((b, 1, h, d))
+        v_new = self.v_proj(x).reshape((b, 1, h, d))
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.jax.astype(cache["k"].dtype), (0, idx, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.jax.astype(cache["v"].dtype), (0, idx, 0, 0))
+        out = _attention_step(q.jax, kc, vc, idx, 1.0 / (d ** 0.5))
+        out = self.out_proj(NDArray(out.reshape(b, 1, h * d)))
+        return out, {"k": kc, "v": vc}
+
+
+def _attention_step(q, k_cache, v_cache, idx, scale):
+    """Single-position attention against a KV cache: q (B,1,H,D),
+    caches (B,Tmax,H,D), idx = current position (traced int32).  Masked
+    to positions <= idx; returns (B,1,H,D)."""
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(k_cache.shape[1])
+    logits = jnp.where(pos[None, None, None, :] <= idx, logits, -1e30)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                      v_cache)
+
 
 class PositionwiseFFN(HybridBlock):
     """Transformer FFN: Dense(hidden) → GELU → Dense(units), hidden sharded
@@ -289,6 +326,14 @@ class TransformerBlock(HybridBlock):
         x = _par.with_sharding_constraint(x, "batch", "seq", None)
         x = x + self.ffn(self.ln2(x))
         return _par.with_sharding_constraint(x, "batch", "seq", None)
+
+    def forward_step(self, x, cache, idx):
+        """Incremental decode through the block (see
+        MultiHeadAttention.forward_step)."""
+        a, cache = self.attn.forward_step(self.ln1(x), cache, idx)
+        x = x + a
+        x = x + self.ffn(self.ln2(x))
+        return x, cache
 
 
 class TransformerEncoderLayer(TransformerBlock):

@@ -243,6 +243,41 @@ def array(obj, dtype=None, ctx=None, device=None, copy=True):
 __all__.append("array")
 
 
+# ----------------------------------------------- callable-taking functions
+# The generic wrapper would hand the user's function raw jnp tracers and
+# reject NDArray returns; these shims wrap slices as NDArray going in and
+# unwrap NDArray results coming out, so func1d written against the mx.np
+# surface (the point of the parity shim) works.
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    arr_nd = arr if isinstance(arr, NDArray) else _from_jax(_jnp.asarray(arr))
+
+    def shim(row):
+        res = func1d(_from_jax(row), *args, **kwargs)
+        return res.jax if isinstance(res, NDArray) else res
+
+    return _ops.invoke(
+        "apply_along_axis",
+        lambda a: _jnp.apply_along_axis(shim, axis, a), [arr_nd])
+
+
+def apply_over_axes(func, a, axes):
+    a_nd = a if isinstance(a, NDArray) else _from_jax(_jnp.asarray(a))
+
+    def shim(x, ax):
+        res = func(_from_jax(x), ax)
+        return res.jax if isinstance(res, NDArray) else res
+
+    return _ops.invoke(
+        "apply_over_axes",
+        lambda v: _jnp.apply_over_axes(shim, v, axes), [a_nd])
+
+
+for _n in ("apply_along_axis", "apply_over_axes"):
+    if _n not in __all__:
+        __all__.append(_n)
+
+
 def may_share_memory(a, b, max_work=None):
     return False
 

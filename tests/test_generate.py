@@ -1,0 +1,61 @@
+"""GPT-2 KV-cache incremental decoding (models/gpt2.py generate)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import base as _base
+from mxnet_tpu.models import get_gpt2
+
+
+def _net():
+    onp.random.seed(0)
+    net = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=3,
+                   num_heads=4, max_length=64, dropout=0.0)
+    net.initialize()
+    return net
+
+
+def test_kv_cache_greedy_matches_full_recompute():
+    net = _net()
+    prompt = onp.random.randint(0, 97, (2, 5)).astype("int32")
+    net(mx.nd.array(prompt, dtype="int32"))  # settle
+    gen = net.generate(mx.nd.array(prompt, dtype="int32"),
+                       max_new_tokens=10, temperature=0).asnumpy()
+    toks = prompt.copy()
+    with _base.training_mode(False):
+        for _ in range(10):
+            logits = net(mx.nd.array(toks, dtype="int32")).asnumpy()
+            nxt = logits[:, -1].argmax(-1).astype("int32")
+            toks = onp.concatenate([toks, nxt[:, None]], 1)
+    onp.testing.assert_array_equal(gen, toks)
+
+
+def test_generate_sampling_seeded_and_prompt_preserved():
+    net = _net()
+    prompt = onp.random.randint(0, 97, (2, 5)).astype("int32")
+    net(mx.nd.array(prompt, dtype="int32"))
+    p = mx.nd.array(prompt, dtype="int32")
+    a = net.generate(p, 8, temperature=1.0, seed=1).asnumpy()
+    b = net.generate(p, 8, temperature=1.0, seed=1).asnumpy()
+    c = net.generate(p, 8, temperature=1.0, seed=2).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    assert not (a == c).all()
+    onp.testing.assert_array_equal(a[:, :5], prompt)
+    d = net.generate(p, 4, temperature=0.8, top_k=5, seed=3)
+    assert d.shape == (2, 9)
+
+
+def test_generate_guards():
+    net = _net()
+    prompt = mx.nd.array(onp.zeros((1, 60)), dtype="int32")
+    net(prompt)
+    with pytest.raises(ValueError):
+        net.generate(prompt, max_new_tokens=10)   # exceeds max_length
+    moe = get_gpt2("gpt2_124m", vocab_size=64, units=32, num_layers=2,
+                   num_heads=4, max_length=32, dropout=0.0,
+                   num_experts=2, moe_every=2)
+    moe.initialize()
+    p2 = mx.nd.array(onp.zeros((1, 4)), dtype="int32")
+    moe(p2)
+    with pytest.raises(ValueError):
+        moe.generate(p2, 4)

@@ -66,8 +66,11 @@ def test_hybridize_training_gradients_match():
         with autograd.record():
             loss = loss_fn(net(x), y)
         loss.backward()
+        # align by STRUCTURAL name ("0.weight") — global name counters
+        # ("dense10" sorts before "dense9") depend on how many layers
+        # earlier tests created
         grads.append([p.grad().asnumpy() for _, p in
-                      sorted(net.collect_params().items())])
+                      sorted(net._collect_params_with_prefix().items())])
     for ga, gb in zip(*grads):
         assert_almost_equal(ga, gb, rtol=1e-4, atol=1e-5)
 
