@@ -2,6 +2,7 @@
 from .dataset import (Dataset, SimpleDataset, ArrayDataset,
                       RecordFileDataset)
 from .sampler import (Sampler, SequentialSampler, RandomSampler,
-                      BatchSampler, FilterSampler, IntervalSampler)
+                      BatchSampler, FilterSampler, IntervalSampler,
+                      FixedBucketSampler)
 from .dataloader import DataLoader, default_batchify_fn
 from . import vision

@@ -96,3 +96,70 @@ class BatchSampler(Sampler):
         if self._last_batch == "discard":
             return n // self._batch_size
         return (n + len(self._prev)) // self._batch_size
+
+
+class FixedBucketSampler(Sampler):
+    """Batch sampler assigning variable-length samples to fixed-length
+    buckets (the Sockeye/GluonNLP bucketing mechanism — upstream it lived
+    in gluonnlp.data; in-tree here because bucketing is the XLA
+    compile-cache discipline, SURVEY.md §7.3 hard part 3).
+
+    Parameters
+    ----------
+    lengths : list of int (or list of tuple for multi-input)
+    batch_size : samples per batch
+    num_buckets : bucket count; edges are linear between min and max length
+    shuffle : shuffle batches (and samples within buckets) each epoch
+    """
+
+    def __init__(self, lengths, batch_size, num_buckets=10, shuffle=False,
+                 bucket_keys=None):
+        import numpy as onp
+
+        self._lengths = [max(l) if isinstance(l, (tuple, list)) else l
+                         for l in lengths]
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        lo, hi = min(self._lengths), max(self._lengths)
+        explicit = bucket_keys is not None
+        if bucket_keys is None:
+            num_buckets = max(1, min(num_buckets, hi - lo + 1))
+            bucket_keys = set(
+                int(round(lo + (hi - lo) * (i + 1) / num_buckets))
+                for i in range(num_buckets))
+        self.bucket_keys = sorted(bucket_keys)
+        buckets = {k: [] for k in self.bucket_keys}
+        for i, l in enumerate(self._lengths):
+            for k in self.bucket_keys:
+                if l <= k:
+                    buckets[k].append(i)
+                    break
+            else:
+                if explicit:
+                    raise ValueError(
+                        f"sample {i} has length {l} > largest bucket key "
+                        f"{self.bucket_keys[-1]} — downstream pad-to-key "
+                        "code would truncate it")
+                buckets[self.bucket_keys[-1]].append(i)
+        self._buckets = buckets
+        self._rng = onp.random.RandomState(0)
+
+    def __iter__(self):
+        batches = []
+        for k in self.bucket_keys:
+            idx = list(self._buckets[k])
+            if self._shuffle:
+                self._rng.shuffle(idx)
+            for i in range(0, len(idx), self._batch_size):
+                batches.append(idx[i:i + self._batch_size])
+        if self._shuffle:
+            self._rng.shuffle(batches)
+        return iter(batches)
+
+    def __len__(self):
+        return sum(-(-len(v) // self._batch_size)
+                   for v in self._buckets.values())
+
+    def stats(self):
+        """Human-readable bucket occupancy (gluonnlp parity)."""
+        return {k: len(v) for k, v in self._buckets.items()}

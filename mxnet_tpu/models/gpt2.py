@@ -35,7 +35,9 @@ class _GPT2Decoding:
     """KV-cache incremental decoding mixin surface for GPT2Model."""
 
     def init_cache(self, batch, max_length=None, dtype=None):
-        """Per-layer KV caches (B, Tmax, H, D), zero-filled."""
+        """Per-layer KV caches (B, Tmax, H, D), zero-filled.  Cache dtype
+        follows the parameters (bf16 params → bf16 cache, half the HBM)
+        unless overridden."""
         import jax.numpy as jnp
 
         _dense_blocks_only(self)
@@ -43,10 +45,30 @@ class _GPT2Decoding:
         blk0 = self.blocks[0]
         h = blk0.attn._num_heads
         d = blk0.attn._head_dim
-        dt = dtype or jnp.float32
+        dt = dtype or self.wte.weight.data().jax.dtype
+        if dt not in (jnp.bfloat16, jnp.float16, jnp.float32, jnp.float64):
+            dt = jnp.float32
         return [{"k": jnp.zeros((batch, t, h, d), dt),
                  "v": jnp.zeros((batch, t, h, d), dt)}
                 for _ in self.blocks]
+
+    def prefill(self, tokens_nd, caches):
+        """Batched cache fill over the prompt (B,Tp): ONE causal forward
+        writes every layer's K/V for positions [0,Tp) and returns the
+        last position's logits (B, vocab)."""
+        pos = F.arange_like(tokens_nd, axis=1).astype("int32")
+        x = self.wte(tokens_nd) + self.wpe(pos)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, c = blk.forward_prefill(x, cache)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        last = F.slice_axis(x, axis=1, begin=-1, end=None)
+        logits = F.FullyConnected(last, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return logits.reshape((tokens_nd.shape[0], self.vocab_size)), \
+            new_caches
 
     def forward_step(self, tok, caches, idx):
         """One decode position: tok (B,1) int32 at position ``idx`` →
@@ -104,62 +126,69 @@ class _GPT2Decoding:
         param_vals = tuple(d.jax for d in param_nds)
         net = self
 
-        # cache the jitted program per decode config — jax.jit caches by
+        # cache the jitted program per decode SHAPE — jax.jit caches by
         # function object, so a fresh closure per call would recompile
-        # every generate()
-        cfg = (b, tp, int(max_new_tokens), float(temperature), int(top_k))
+        # every generate().  temperature is a traced scalar argument (a
+        # temperature schedule must not recompile); only the
+        # greedy/sampling structure and top_k change the program.
+        greedy = temperature <= 0
+        top_k = min(int(top_k), self.vocab_size) if top_k else 0
+        cfg = (b, tp, int(max_new_tokens), greedy, top_k)
         jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-        cached = jit_cache.get(cfg)
-        if cached is not None:
-            out = cached(param_vals, prompt_j, jax.random.PRNGKey(seed))
-            return nd_array(out, dtype="int32")
+        run = jit_cache.get(cfg)
+        if run is None:
+            from ..ndarray.ndarray import swap_values
 
-        from ..ndarray.ndarray import swap_values
-
-        @jax.jit
-        def run(param_vals, prompt_j, key):
-            with swap_values(param_nds, param_vals):
-                with _base.training_mode(False):
-                    rec = _base.set_recording(False)
-                    try:
-                        caches = net.init_cache(b, total)
-                        tokens = jnp.concatenate(
-                            [prompt_j,
-                             jnp.zeros((b, total - tp), jnp.int32)], axis=1)
-
-                        def body(t, carry):
-                            tokens, caches, key = carry
-                            tok_t = jax.lax.dynamic_slice(
-                                tokens, (0, t), (b, 1))
-                            logits, caches = net.forward_step(
-                                NDArray(tok_t), caches, t)
-                            lg = logits.jax / jnp.maximum(temperature, 1e-6)
-                            if temperature <= 0:
-                                nxt = jnp.argmax(logits.jax, axis=-1)
-                            else:
-                                if top_k and top_k > 0:
+            @jax.jit
+            def run(param_vals, prompt_j, key, temp):
+                with swap_values(param_nds, param_vals):
+                    with _base.training_mode(False):
+                        rec = _base.set_recording(False)
+                        try:
+                            def pick(logits_j, key, t):
+                                if greedy:
+                                    return jnp.argmax(
+                                        logits_j, axis=-1).astype(jnp.int32)
+                                lg = logits_j / jnp.maximum(temp, 1e-6)
+                                if top_k:
                                     kth = jnp.sort(lg, axis=-1)[:, -top_k]
                                     lg = jnp.where(lg < kth[:, None],
                                                    -1e30, lg)
-                                nxt = jax.random.categorical(
-                                    jax.random.fold_in(key, t), lg, axis=-1)
-                            nxt = nxt.astype(jnp.int32)
-                            keep = jax.lax.dynamic_slice(
-                                tokens, (0, t + 1), (b, 1))
-                            write = jnp.where(t + 1 >= tp, nxt[:, None],
-                                              keep)
-                            tokens = jax.lax.dynamic_update_slice(
-                                tokens, write, (0, t + 1))
-                            return tokens, caches, key
+                                return jax.random.categorical(
+                                    jax.random.fold_in(key, t), lg,
+                                    axis=-1).astype(jnp.int32)
 
-                        tokens, _, _ = jax.lax.fori_loop(
-                            0, total - 1, body, (tokens, caches, key))
-                        return tokens
-                    finally:
-                        _base.set_recording(rec)
+                            caches = net.init_cache(b, total)
+                            # batched prefill: one causal pass fills all
+                            # layer caches for positions [0, tp)
+                            logits0, caches = net.prefill(
+                                NDArray(prompt_j), caches)
+                            first = pick(logits0.jax, key, tp - 1)
+                            tokens = jnp.concatenate(
+                                [prompt_j, first[:, None],
+                                 jnp.zeros((b, total - tp - 1), jnp.int32)],
+                                axis=1) if total > tp else prompt_j
 
-        jit_cache[cfg] = run
-        out = run(param_vals, prompt_j, jax.random.PRNGKey(seed))
+                            def body(t, carry):
+                                tokens, caches, key = carry
+                                tok_t = jax.lax.dynamic_slice(
+                                    tokens, (0, t), (b, 1))
+                                logits, caches = net.forward_step(
+                                    NDArray(tok_t), caches, t)
+                                nxt = pick(logits.jax, key, t)
+                                tokens = jax.lax.dynamic_update_slice(
+                                    tokens, nxt[:, None], (0, t + 1))
+                                return tokens, caches, key
+
+                            tokens, _, _ = jax.lax.fori_loop(
+                                tp, total - 1, body, (tokens, caches, key))
+                            return tokens
+                        finally:
+                            _base.set_recording(rec)
+
+            jit_cache[cfg] = run
+        out = run(param_vals, prompt_j, jax.random.PRNGKey(seed),
+                  jnp.asarray(max(float(temperature), 0.0), jnp.float32))
         return nd_array(out, dtype="int32")
 
 

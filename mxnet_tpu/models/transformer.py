@@ -109,6 +109,28 @@ class MultiHeadAttention(HybridBlock):
         out = self.out_proj(NDArray(out.reshape(b, 1, h * d)))
         return out, {"k": kc, "v": vc}
 
+    def forward_prefill(self, x, cache):
+        """Batched cache fill: full causal attention over the prompt
+        (B,T,U) in ONE pass, writing K/V for positions [0, T) into the
+        cache.  Inference only."""
+        import jax
+
+        from ..ndarray import NDArray
+        from ..ops import dot_product_attention
+
+        b, t = x.shape[0], x.shape[1]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((b, t, h, d))
+        k = self.k_proj(x).reshape((b, t, h, d))
+        v = self.v_proj(x).reshape((b, t, h, d))
+        kc = jax.lax.dynamic_update_slice(
+            cache["k"], k.jax.astype(cache["k"].dtype), (0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            cache["v"], v.jax.astype(cache["v"].dtype), (0, 0, 0, 0))
+        out = dot_product_attention(q, k, v, causal=True)
+        out = self.out_proj(out.reshape((b, t, h * d)))
+        return out, {"k": kc, "v": vc}
+
 
 def _attention_step(q, k_cache, v_cache, idx, scale):
     """Single-position attention against a KV cache: q (B,1,H,D),
@@ -331,6 +353,14 @@ class TransformerBlock(HybridBlock):
         """Incremental decode through the block (see
         MultiHeadAttention.forward_step)."""
         a, cache = self.attn.forward_step(self.ln1(x), cache, idx)
+        x = x + a
+        x = x + self.ffn(self.ln2(x))
+        return x, cache
+
+    def forward_prefill(self, x, cache):
+        """Batched cache fill through the block (see
+        MultiHeadAttention.forward_prefill)."""
+        a, cache = self.attn.forward_prefill(self.ln1(x), cache)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache

@@ -189,3 +189,60 @@ def test_contrib_concurrent_layers():
     assert len(net) == 2
     # upstream import paths for Identity/SyncBatchNorm
     assert cnn.Identity is not None and cnn.SyncBatchNorm is not None
+
+
+def test_nmt_bucketed_shapes_share_one_trainer():
+    """Variable-length buckets (Sockeye's bucketing discipline): one
+    ShardedTrainer serves multiple sequence lengths — each bucket shape
+    compiles once into the jit cache, parameters are shared."""
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import nmt_loss
+
+    net = _tiny(src_vocab_size=16, tgt_vocab_size=16, dropout=0.0)
+    mesh = par.make_mesh()
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(
+            net, "adam", loss=lambda o, l: nmt_loss(o, l),
+            optimizer_params={"learning_rate": 1e-3}, mesh=mesh)
+        losses = {}
+        for seqlen in (8, 12, 8, 12, 16):
+            src = onp.random.randint(3, 16, (8, seqlen)).astype("int32")
+            tgt_in = onp.concatenate(
+                [onp.ones((8, 1), "int32"), src[:, :-1]], 1)
+            l = float(tr.step((mx.nd.array(src, dtype="int32"),
+                               mx.nd.array(tgt_in, dtype="int32")),
+                              mx.nd.array(src, dtype="int32")).asnumpy())
+            losses[seqlen] = l
+        assert all(onp.isfinite(v) for v in losses.values())
+        # one compiled program per bucket shape, re-used on repeats
+        assert tr._step_fn._cache_size() == 3
+
+
+def test_fixed_bucket_sampler():
+    from mxnet_tpu.gluon.data import FixedBucketSampler
+
+    lengths = [3, 5, 8, 8, 9, 15, 16, 4, 7, 12]
+    s = FixedBucketSampler(lengths, batch_size=2, num_buckets=3,
+                           shuffle=True)
+    seen = sorted(i for batch in s for i in batch)
+    assert seen == list(range(10))            # every sample exactly once
+    assert len(s) == sum(1 for _ in iter(s))
+    # within a batch, all lengths fall in the same bucket (<= its key)
+    for batch in s:
+        ls = [lengths[i] for i in batch]
+        key = min(k for k in s.bucket_keys if max(ls) <= k)
+        assert all(l <= key for l in ls)
+    assert sum(s.stats().values()) == 10
+
+
+def test_fixed_bucket_sampler_explicit_keys():
+    from mxnet_tpu.gluon.data import FixedBucketSampler
+
+    s = FixedBucketSampler([3, 9, 15], 2, bucket_keys=[16, 8, 4])
+    assert s.bucket_keys == [4, 8, 16]        # unsorted keys are sorted
+    for batch in s:
+        key = min(k for k in s.bucket_keys
+                  if max([3, 9, 15][i] for i in batch) <= k)
+        assert all([3, 9, 15][i] <= key for i in batch)
+    with pytest.raises(ValueError):
+        FixedBucketSampler([3, 20], 2, bucket_keys=[8, 16])
