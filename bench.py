@@ -86,7 +86,7 @@ def _fit_batch(batch: int, mesh) -> int:
 
 # ------------------------------------------------------------------ GPT-2
 
-def _bench_gpt2_config(on_tpu: bool, long: bool) -> dict:
+def _bench_gpt2_config(on_tpu: bool, long: bool, batch_override=None) -> dict:
     """GPT-2 training throughput; ``long`` is BASELINE config 5 (seq 4096
     through the Pallas flash path, O(T) memory)."""
     import mxnet_tpu as mx
@@ -107,7 +107,7 @@ def _bench_gpt2_config(on_tpu: bool, long: bool) -> dict:
                        max_length=seq, dropout=0.0)
     net.initialize()
     mesh = par.make_mesh()
-    batch = _fit_batch(batch, mesh)
+    batch = _fit_batch(batch_override or batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "adam", loss=gpt2_lm_loss,
@@ -130,17 +130,17 @@ def _bench_gpt2_config(on_tpu: bool, long: bool) -> dict:
     return _record(name, tokens_per_sec, "tokens/sec", mfu)
 
 
-def bench_gpt2(on_tpu: bool) -> dict:
-    return _bench_gpt2_config(on_tpu, long=False)
+def bench_gpt2(on_tpu: bool, batch_override=None) -> dict:
+    return _bench_gpt2_config(on_tpu, long=False, batch_override=batch_override)
 
 
-def bench_gpt2_long(on_tpu: bool) -> dict:
-    return _bench_gpt2_config(on_tpu, long=True)
+def bench_gpt2_long(on_tpu: bool, batch_override=None) -> dict:
+    return _bench_gpt2_config(on_tpu, long=True, batch_override=batch_override)
 
 
 # --------------------------------------------------------------- ResNet-50
 
-def bench_resnet50(on_tpu: bool) -> dict:
+def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models.vision import get_resnet
@@ -163,7 +163,7 @@ def bench_resnet50(on_tpu: bool) -> dict:
         train_flops_per_img = 3 * 1.8e9 * (64 / 224) ** 2
     net.initialize()
     mesh = par.make_mesh()
-    batch = _fit_batch(batch, mesh)
+    batch = _fit_batch(batch_override or batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "sgd", loss=ce_loss,
@@ -184,7 +184,7 @@ def bench_resnet50(on_tpu: bool) -> dict:
 
 # ------------------------------------------------------------ NMT (config 4)
 
-def bench_nmt(on_tpu: bool) -> dict:
+def bench_nmt(on_tpu: bool, batch_override=None) -> dict:
     """Transformer-big WMT-style encoder-decoder training throughput
     (BASELINE config 4; Sockeye parity workload)."""
     import mxnet_tpu as mx
@@ -206,7 +206,7 @@ def bench_nmt(on_tpu: bool) -> dict:
                       num_heads=4, dropout=0.0)
     net.initialize()
     mesh = par.make_mesh()
-    batch = _fit_batch(batch, mesh)
+    batch = _fit_batch(batch_override or batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "adam", loss=lambda o, l: nmt_loss(o, l),
@@ -235,7 +235,7 @@ def bench_nmt(on_tpu: bool) -> dict:
 
 # -------------------------------------------------------------- BERT-large
 
-def bench_bert(on_tpu: bool) -> dict:
+def bench_bert(on_tpu: bool, batch_override=None) -> dict:
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_bert
@@ -264,7 +264,7 @@ def bench_bert(on_tpu: bool) -> dict:
 
     net.initialize()
     mesh = par.make_mesh()
-    batch = _fit_batch(batch, mesh)
+    batch = _fit_batch(batch_override or batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
             net, "adam", loss=mlm_loss,
@@ -274,8 +274,9 @@ def bench_bert(on_tpu: bool) -> dict:
         types = mx.nd.array(onp.zeros((batch, seq)), dtype="int32")
         vlen = mx.nd.array(onp.full((batch,), seq), dtype="int32")
         pos = mx.nd.array(
-            onp.sort(onp.random.choice(seq, (batch, n_masked),
-                                       replace=False)), dtype="int32")
+            onp.stack([onp.sort(onp.random.choice(seq, n_masked,
+                                                  replace=False))
+                       for _ in range(batch)]), dtype="int32")
         mlm_lab = mx.nd.array(
             onp.random.randint(0, vocab, (batch, n_masked)), dtype="int32")
         nsp_lab = mx.nd.array(onp.random.randint(0, 2, (batch,)),

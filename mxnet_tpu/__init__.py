@@ -74,6 +74,8 @@ _LAZY = {
     "viz": ".visualization",
     "visualization": ".visualization",
     "library": ".library",
+    "monitor": ".monitor",
+    "mon": ".monitor",
 }
 
 

@@ -3,4 +3,5 @@ from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
                        SequentialRNNCell, HybridSequentialRNNCell,
                        DropoutCell, ResidualCell,
-                       BidirectionalCell, ZoneoutCell)
+                       BidirectionalCell, ZoneoutCell, ModifierCell,
+                       VariationalDropoutCell, LSTMPCell)

@@ -6,7 +6,8 @@ from ..block import HybridBlock
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "DropoutCell", "ResidualCell",
-           "BidirectionalCell", "ZoneoutCell"]
+           "BidirectionalCell", "ZoneoutCell", "ModifierCell",
+           "VariationalDropoutCell", "LSTMPCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -30,6 +31,8 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         from ... import ndarray as nd
+        self.reset()   # per-sequence state (variational masks, zoneout
+        # prev-output) must not leak across unrolls (upstream semantics)
         axis = layout.find("T")
         if isinstance(inputs, (list, tuple)):
             seq = list(inputs)
@@ -227,7 +230,10 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class ResidualCell(RecurrentCell):
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (parity:
+    rnn_cell.ModifierCell — Zoneout/Residual/VariationalDropout)."""
+
     def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
         self.base_cell = base_cell
@@ -235,21 +241,26 @@ class ResidualCell(RecurrentCell):
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
+    def reset(self):
+        self.base_cell.reset()
+
+
+class ResidualCell(ModifierCell):
     def forward(self, inputs, states):
         out, states = self.base_cell(inputs, states)
         return out + inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
                  **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
+        super().__init__(base_cell, **kwargs)
         self._zo, self._zs = zoneout_outputs, zoneout_states
         self._prev_output = None
 
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
+    def reset(self):
+        super().reset()
+        self._prev_output = None
 
     def forward(self, inputs, states):
         from ... import base as _b, random as _r
@@ -305,6 +316,102 @@ class BidirectionalCell(RecurrentCell):
         if merge_outputs:
             outs = nd.stack(*outs, axis=axis)
         return outs, l_states + r_states
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (per-sequence) dropout: ONE mask per unroll, reused at
+    every time step (parity: rnn_cell.VariationalDropoutCell).  Call
+    :meth:`reset` between sequences."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    def reset(self):
+        super().reset()
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    @staticmethod
+    def _mask(rate, like):
+        F = _get_F()
+        return F.random_bernoulli(1 - rate, like.shape,
+                                  ctx=like.context) / (1 - rate)
+
+    def forward(self, inputs, states):
+        from ... import base as _b
+        if _b.is_training():
+            if self._di > 0:
+                if self._mask_i is None:
+                    self._mask_i = self._mask(self._di, inputs)
+                inputs = inputs * self._mask_i
+            if self._ds > 0:
+                if self._mask_s is None:
+                    self._mask_s = self._mask(self._ds, states[0])
+                states = [states[0] * self._mask_s] + list(states[1:])
+        out, new_states = self.base_cell(inputs, states)
+        if _b.is_training() and self._do > 0:
+            if self._mask_o is None:
+                self._mask_o = self._mask(self._do, out)
+            out = out * self._mask_o
+        return out, new_states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (parity: rnn_cell.LSTMPCell,
+    LSTMP of Sak et al. 2014): cell state is ``hidden_size`` wide, the
+    recurrent/output state is projected to ``projection_size``."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        H, P = hidden_size, projection_size
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(4 * H, input_size),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(4 * H, P), init=h2h_weight_initializer,
+            allow_deferred_init=True)
+        self.h2r_weight = self.params.get(
+            "h2r_weight", shape=(P, H), init=h2r_weight_initializer,
+            allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(4 * H,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(4 * H,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._set_shape((4 * self._hidden_size, x.shape[-1]))
+
+    def forward(self, inputs, states):
+        F = _get_F()
+        H = self._hidden_size
+        gates = F.FullyConnected(inputs, self.i2h_weight.data(),
+                                 self.i2h_bias.data(), num_hidden=4 * H) + \
+            F.FullyConnected(states[0], self.h2h_weight.data(),
+                             self.h2h_bias.data(), num_hidden=4 * H)
+        sl = F.split(gates, num_outputs=4, axis=-1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = F.tanh(sl[2])
+        o = F.sigmoid(sl[3])
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        r = F.FullyConnected(h, self.h2r_weight.data(), None,
+                             num_hidden=self._projection_size, no_bias=True)
+        return r, [r, c]
 
 
 # hybridizable alias (parity: rnn_cell.HybridSequentialRNNCell — identical

@@ -132,7 +132,8 @@ class _GPT2Decoding:
         # temperature schedule must not recompile); only the
         # greedy/sampling structure and top_k change the program.
         greedy = temperature <= 0
-        top_k = min(int(top_k), self.vocab_size) if top_k else 0
+        top_k = min(int(top_k), self.vocab_size) \
+            if top_k and top_k > 0 else 0
         cfg = (b, tp, int(max_new_tokens), greedy, top_k)
         jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
         run = jit_cache.get(cfg)

@@ -115,6 +115,14 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def install_monitor(self, mon):
+        """Attach an mx.monitor.Monitor (parity: BaseModule
+        .install_monitor → executor set_monitor_callback); the monitor
+        observes every eager op output via the dispatcher hook."""
+        mon.install()
+        self._monitor = mon
+        return mon
+
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,118 @@
+"""``mx.monitor`` — intermediate-output monitoring for debugging.
+
+Parity: ``python/mxnet/monitor.py`` (``mx.mon.Monitor``) — upstream hooks
+executor output callbacks to print a statistic (default
+``|x|_1 / size``) of every op output matching a regex, between
+``tic()``/``toc()``.  TPU-native realization: the single op dispatcher
+(``ndarray.ops.invoke``) exposes a hook list; while a Monitor is active
+(``install()`` or Module's ``install_monitor``), matching eager op
+outputs are recorded.  Ops inside a jitted step are fused into one XLA
+program and are not individually observable — run the model un-hybridized
+(or under ``mx.util.disable_jit()``) when monitoring, exactly like
+upstream recommends NaiveEngine for debugging (SURVEY.md §5.2).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as onp
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x) -> onp.ndarray:
+    return onp.abs(x).sum() / x.size
+
+
+class Monitor:
+    """Collect a statistic of matching op outputs between tic()/toc().
+
+    Parameters
+    ----------
+    interval : record every N-th batch (tic/toc pairs)
+    stat_func : ndarray -> scalar statistic (default mean |x|)
+    pattern : regex on op names
+    sort : sort toc() results by name
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, onp.ndarray]] = []
+        self._installed = False
+        self._counts = {}
+
+    # ------------------------------------------------------------- hooks
+    def _hook(self, name, outputs):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        import jax
+
+        i = self._counts.get(name, 0)
+        self._counts[name] = i + 1
+        for j, out in enumerate(outputs):
+            if isinstance(out.jax, jax.core.Tracer):
+                continue           # inside a trace: not observable eagerly
+            key = f"{name}{i}" + (f"_output{j}" if len(outputs) > 1 else "")
+            try:
+                self.queue.append(
+                    (self.step, key,
+                     onp.asarray(self.stat_func(out.asnumpy()))))
+            except Exception:
+                pass               # stat errors must never kill the op
+
+    def install(self):
+        """Start observing (parity: executor set_monitor_callback /
+        Module.install_monitor calls this)."""
+        from .ndarray import ops as _ops
+        if not self._installed:
+            _ops._invoke_hooks.append(self._hook)
+            self._installed = True
+        return self
+
+    def uninstall(self):
+        from .ndarray import ops as _ops
+        if self._installed:
+            _ops._invoke_hooks.remove(self._hook)
+            self._installed = False
+        return self
+
+    # ------------------------------------------------------------ control
+    def tic(self):
+        """Start collecting for this batch (every ``interval``-th)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self._counts = {}
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, onp.ndarray]]:
+        """Stop collecting; return [(step, name, stat)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = self.queue
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda t: t[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")
+
+    def __enter__(self):
+        self.install()
+        self.tic()
+        return self
+
+    def __exit__(self, *exc):
+        self.toc_print()
+        self.uninstall()

@@ -49,6 +49,11 @@ def _amp_policy():
     return _amp_mod.current_policy()
 
 
+# optional op-observation hooks (mx.monitor.Monitor installs here); each
+# is called hook(op_name, output_NDArrays) after a successful dispatch
+_invoke_hooks = []
+
+
 def invoke(name, pure_fn, nd_inputs, nout=1, ctx=None, differentiable=True):
     """Dispatch a pure jax function over NDArray inputs with autograd."""
     arrs = tuple(x.jax for x in nd_inputs)
@@ -73,6 +78,9 @@ def invoke(name, pure_fn, nd_inputs, nout=1, ctx=None, differentiable=True):
                        for o in outs_list])
         for i, r in enumerate(res):
             r._node = OutRef(node, i)
+    if _invoke_hooks:
+        for h in tuple(_invoke_hooks):
+            h(name, res)
     return res if multi else res[0]
 
 

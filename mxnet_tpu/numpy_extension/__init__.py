@@ -140,5 +140,31 @@ def seed(s):
 
 
 from ..context import cpu, gpu, num_gpus  # noqa: E402,F401
+from ..context import current_context as current_device  # noqa: E402,F401
 
-__all__ += ["save", "load", "waitall", "seed", "cpu", "gpu", "num_gpus"]
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
+    """Parity: npx.masked_softmax — softmax over positions where mask is
+    True; masked positions get probability 0 (all-masked rows get 0)."""
+    import jax.numpy as jnp
+
+    nds = [_nd._as_nd(data)]
+    has_mask = mask is not None
+    if has_mask:
+        nds.append(_nd._as_nd(mask))
+
+    def f(x, *m):
+        x = x / temperature
+        if m:
+            x = jnp.where(m[0].astype(bool), x, -1e30)
+        e = jnp.exp(x - jnp.max(x, axis=axis, keepdims=True))
+        if m:
+            e = jnp.where(m[0].astype(bool), e, 0.0)
+        s = jnp.sum(e, axis=axis, keepdims=True)
+        return jnp.where(s > 0, e / jnp.maximum(s, 1e-30), 0.0)
+
+    return _nd.invoke("masked_softmax", f, nds)
+
+
+__all__ += ["save", "load", "waitall", "seed", "cpu", "gpu", "num_gpus",
+            "current_device", "masked_softmax"]

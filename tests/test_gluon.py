@@ -338,3 +338,48 @@ def test_hybrid_sequential_rnn_cell_alias():
     from mxnet_tpu.gluon import rnn
     cell = rnn.HybridSequentialRNNCell()
     assert isinstance(cell, rnn.SequentialRNNCell)
+
+
+def test_lstmp_cell_shapes_and_unroll():
+    from mxnet_tpu.gluon import rnn as grnn
+
+    cell = grnn.LSTMPCell(hidden_size=16, projection_size=8)
+    cell.initialize()
+    x = nd.array(onp.random.randn(4, 5, 12).astype("f"))
+    outs, states = cell.unroll(5, x, merge_outputs=True)
+    assert outs.shape == (4, 5, 8)            # projected outputs
+    assert states[0].shape == (4, 8)          # projected h
+    assert states[1].shape == (4, 16)         # full cell state
+
+
+def test_variational_dropout_cell_fixed_mask():
+    from mxnet_tpu import base as _b
+    from mxnet_tpu.gluon import rnn as grnn
+
+    base = grnn.RNNCell(8)
+    cell = grnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = nd.array(onp.ones((2, 8), "f"))
+    st = cell.begin_state(2)
+    with _b.training_mode(True):
+        o1, st2 = cell(x, st)
+        o2, _ = cell(x, st2)
+        # same mask across steps: zeros appear at the SAME positions
+        z1 = o1.asnumpy() == 0
+        z2 = o2.asnumpy() == 0
+        assert z1.any()
+        onp.testing.assert_array_equal(z1, z2)
+    cell.reset()
+    assert cell._mask_o is None
+    # inference: no dropout
+    o3, _ = cell(x, st)
+    assert not (o3.asnumpy() == 0).all()
+
+
+def test_modifier_cell_hierarchy():
+    from mxnet_tpu.gluon import rnn as grnn
+
+    base = grnn.LSTMCell(4)
+    assert isinstance(grnn.ResidualCell(base), grnn.ModifierCell)
+    assert isinstance(grnn.ZoneoutCell(base), grnn.ModifierCell)
+    assert isinstance(grnn.VariationalDropoutCell(base), grnn.ModifierCell)

@@ -302,3 +302,40 @@ def test_engine_type_env_knob():
                            os.path.abspath(__file__))))
     assert r.returncode == 0, r.stderr
     assert r.stdout.strip().endswith("True")
+
+
+def test_monitor_records_matching_ops():
+    """mx.mon.Monitor parity: stats of matching op outputs between
+    tic()/toc()."""
+    import mxnet_tpu as mx
+    import numpy as onp
+
+    mon = mx.mon.Monitor(interval=1, pattern=".*FullyConnected.*",
+                         sort=True)
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    x = mx.nd.array(onp.ones((2, 3), "f"))
+    mon.install()
+    try:
+        mon.tic()
+        net(x)
+        res = mon.toc()
+    finally:
+        mon.uninstall()
+    assert res and all("FullyConnected" in name for _, name, _ in res)
+    assert all(onp.isfinite(stat) for _, _, stat in res)
+    # interval=2 skips every other batch
+    mon2 = mx.mon.Monitor(interval=2, pattern=".*").install()
+    try:
+        mon2.tic(); net(x); first = mon2.toc()
+        mon2.tic(); net(x); second = mon2.toc()
+    finally:
+        mon2.uninstall()
+    assert first and not second
+    # module integration
+    d = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        d, num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(out, label_names=("softmax_label",))
+    m = mod.install_monitor(mx.mon.Monitor(1, pattern=".*fc.*"))
+    m.uninstall()

@@ -199,3 +199,22 @@ def test_where_take_sort():
     onp.testing.assert_allclose(
         np.take(a, np.array([0, 2], dtype="int32"), axis=0).asnumpy(),
         onp.take(x, [0, 2], axis=0))
+
+
+def test_npx_masked_softmax():
+    x = mx.np.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    m = mx.np.array([[1, 1, 0], [0, 0, 0]])
+    p = mx.npx.masked_softmax(x, m).asnumpy()
+    assert p[0, 2] == 0.0
+    onp.testing.assert_allclose(p[0].sum(), 1.0, rtol=1e-5)
+    onp.testing.assert_allclose(p[1], 0.0)         # all-masked row -> 0
+    # gradient flows through unmasked positions
+    from mxnet_tpu import autograd
+    xa = mx.np.array([[1.0, 2.0, 3.0]])
+    xa.attach_grad()
+    with autograd.record():
+        y = mx.npx.masked_softmax(xa, mx.np.array([[1, 1, 0]]))
+        s = (y * y).sum()
+    s.backward()
+    g = xa.grad.asnumpy()
+    assert onp.isfinite(g).all() and g[0, 2] == 0.0

@@ -1,8 +1,10 @@
 """Batch-size sweep for the bench workloads on the real chip.
 
 Finds the throughput-optimal per-chip batch for each bench.py workload by
-running SHORT timed segments (few steps — sized to finish well inside any
-driver timeout; a killed TPU client can wedge the chip tunnel for hours).
+re-running bench.py's own workload builders (same model, loss, timing
+discipline) with a batch override — short runs sized to finish well
+inside any driver timeout (a killed TPU client can wedge the chip tunnel
+for hours).
 
 Usage:
     python tools/tpu_tune.py --workload gpt2 --batches 8,16,24,32
@@ -17,128 +19,20 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as onp
+import bench as _bench
 
-
-def _bench_gpt2(batch, steps, warmup):
-    import mxnet_tpu as mx
-    from mxnet_tpu import parallel as par
-    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
-
-    seq = 1024
-    net = get_gpt2("gpt2_124m", max_length=seq, dropout=0.0)
-    net.initialize()
-    mesh = par.make_mesh()
-    with par.use_mesh(mesh):
-        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
-                                optimizer_params={"learning_rate": 1e-4},
-                                mesh=mesh)
-        toks = mx.nd.array(onp.random.randint(0, 50257, (batch, seq)),
-                           dtype="int32")
-        labels = mx.nd.array(onp.random.randint(0, 50257, (batch, seq)),
-                             dtype="int32")
-        for _ in range(warmup):
-            float(tr.step(toks, labels).asnumpy())
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = tr.step(toks, labels)
-        float(loss.asnumpy())
-        dt = time.perf_counter() - t0
-    return batch * seq * steps / dt, "tokens/sec"
-
-
-def _bench_resnet50(batch, steps, warmup):
-    import mxnet_tpu as mx
-    from mxnet_tpu import parallel as par
-    from mxnet_tpu.models.vision import get_resnet
-    from mxnet_tpu.ndarray import ops as F
-
-    def ce(logits, labels):
-        return (F.logsumexp(logits, axis=-1)
-                - F.pick(logits, labels, axis=-1)).mean()
-
-    net = get_resnet(1, 50, classes=1000)
-    net.initialize()
-    mesh = par.make_mesh()
-    with par.use_mesh(mesh):
-        tr = par.ShardedTrainer(
-            net, "sgd", loss=ce,
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-            mesh=mesh)
-        imgs = mx.nd.array(onp.random.uniform(
-            -1, 1, (batch, 3, 224, 224)).astype("float32"))
-        labels = mx.nd.array(onp.random.randint(0, 1000, (batch,)),
-                             dtype="int32")
-        for _ in range(warmup):
-            float(tr.step(imgs, labels).asnumpy())
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = tr.step(imgs, labels)
-        float(loss.asnumpy())
-        dt = time.perf_counter() - t0
-    return batch * steps / dt, "images/sec"
-
-
-def _bench_bert(batch, steps, warmup):
-    import mxnet_tpu as mx
-    from mxnet_tpu import parallel as par
-    from mxnet_tpu.models import get_bert
-    from mxnet_tpu.models.bert import BERTForPretrain
-    from mxnet_tpu.ndarray import ops as F
-
-    seq, vocab = 512, 30522
-    n_masked = seq // 8
-    net = BERTForPretrain(get_bert("bert_large", vocab_size=vocab,
-                                   max_length=seq))
-
-    def loss_fn(outs, mlm_labels, nsp_labels):
-        mlm_logits, nsp_logits = outs
-        mlm = (F.logsumexp(mlm_logits, axis=-1)
-               - F.pick(mlm_logits, mlm_labels, axis=-1)).mean()
-        nsp = (F.logsumexp(nsp_logits, axis=-1)
-               - F.pick(nsp_logits, nsp_labels, axis=-1)).mean()
-        return mlm + nsp
-
-    net.initialize()
-    mesh = par.make_mesh()
-    with par.use_mesh(mesh):
-        tr = par.ShardedTrainer(net, "adam", loss=loss_fn,
-                                optimizer_params={"learning_rate": 1e-4},
-                                mesh=mesh)
-        toks = mx.nd.array(onp.random.randint(0, vocab, (batch, seq)),
-                           dtype="int32")
-        types = mx.nd.array(onp.zeros((batch, seq)), dtype="int32")
-        vlen = mx.nd.array(onp.full((batch,), seq), dtype="int32")
-        pos = mx.nd.array(onp.sort(onp.random.choice(
-            seq, (batch, n_masked), replace=False)), dtype="int32")
-        mlm = mx.nd.array(onp.random.randint(0, vocab, (batch, n_masked)),
-                          dtype="int32")
-        nsp = mx.nd.array(onp.random.randint(0, 2, (batch,)), dtype="int32")
-        data = (toks, types, vlen, pos)
-        for _ in range(warmup):
-            float(tr.step(data, (mlm, nsp)).asnumpy())
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = tr.step(data, (mlm, nsp))
-        float(loss.asnumpy())
-        dt = time.perf_counter() - t0
-    return batch * steps / dt, "samples/sec"
-
-
-_TABLE = {"gpt2": _bench_gpt2, "resnet50": _bench_resnet50,
-          "bert": _bench_bert}
+_TABLE = {"gpt2": _bench.bench_gpt2, "gpt2_long": _bench.bench_gpt2_long,
+          "resnet50": _bench.bench_resnet50, "bert": _bench.bench_bert,
+          "nmt": _bench.bench_nmt}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="gpt2", choices=sorted(_TABLE))
     ap.add_argument("--batches", default="8,16,24,32")
-    ap.add_argument("--steps", type=int, default=8)
-    ap.add_argument("--warmup", type=int, default=2)
     args = ap.parse_args()
 
     from mxnet_tpu.utils.platform import init_backend
@@ -153,18 +47,18 @@ def main():
     best = None
     for b in [int(x) for x in args.batches.split(",")]:
         try:
-            val, unit = _TABLE[args.workload](b, args.steps, args.warmup)
+            rec = _TABLE[args.workload](True, batch_override=b)
         except Exception as e:  # OOM etc. — report and keep sweeping
             print(json.dumps({"batch": b, "error": str(e)[:200]}),
                   flush=True)
             continue
-        print(json.dumps({"batch": b, "value": round(val, 1),
-                          "unit": unit}), flush=True)
-        if best is None or val > best[1]:
-            best = (b, val)
+        print(json.dumps({"batch": b, "value": rec["value"],
+                          "unit": rec["unit"],
+                          "vs_baseline": rec["vs_baseline"]}), flush=True)
+        if best is None or rec["value"] > best[1]:
+            best = (b, rec["value"])
     if best:
-        print(json.dumps({"best": best[0], "value": round(best[1], 1)}),
-              flush=True)
+        print(json.dumps({"best": best[0], "value": best[1]}), flush=True)
 
 
 if __name__ == "__main__":
