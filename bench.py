@@ -70,9 +70,13 @@ def _run_steps(trainer, batches, warmup: int, steps: int) -> float:
     return time.perf_counter() - t0
 
 
-def _record(metric: str, value: float, unit: str, mfu: float) -> dict:
-    return {"metric": metric, "value": round(value, 1), "unit": unit,
-            "vs_baseline": round(mfu / 0.45, 4)}
+def _record(metric: str, value: float, unit: str, mfu: float,
+            batch=None) -> dict:
+    rec = {"metric": metric, "value": round(value, 1), "unit": unit,
+           "vs_baseline": round(mfu / 0.45, 4)}
+    if batch is not None:
+        rec["batch"] = batch   # ACTUAL per-step batch (after dp rounding)
+    return rec
 
 
 
@@ -127,7 +131,7 @@ def _bench_gpt2_config(on_tpu: bool, long: bool, batch_override=None) -> dict:
         peak_flops_per_device() * len(jax_devices()))
     name = "gpt2_124m_seq4096_train_throughput" if long \
         else "gpt2_124m_train_throughput"
-    return _record(name, tokens_per_sec, "tokens/sec", mfu)
+    return _record(name, tokens_per_sec, "tokens/sec", mfu, batch=batch)
 
 
 def bench_gpt2(on_tpu: bool, batch_override=None) -> dict:
@@ -179,7 +183,7 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
     mfu = imgs_per_sec * train_flops_per_img / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("resnet50_train_throughput", imgs_per_sec,
-                   "images/sec", mfu)
+                   "images/sec", mfu, batch=batch)
 
 
 # ------------------------------------------------------------ NMT (config 4)
@@ -230,7 +234,7 @@ def bench_nmt(on_tpu: bool, batch_override=None) -> dict:
     mfu = tokens_per_sec * flops_per_token / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("transformer_big_nmt_train_throughput", tokens_per_sec,
-                   "tokens/sec", mfu)
+                   "tokens/sec", mfu, batch=batch)
 
 
 # -------------------------------------------------------------- BERT-large
@@ -291,7 +295,7 @@ def bench_bert(on_tpu: bool, batch_override=None) -> dict:
     mfu = samples_per_sec * flops_per_sample / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("bert_large_pretrain_throughput", samples_per_sec,
-                   "samples/sec", mfu)
+                   "samples/sec", mfu, batch=batch)
 
 
 def jax_devices():

@@ -2,5 +2,6 @@
 from .estimator import Estimator  # noqa: F401
 from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,  # noqa
                             EarlyStoppingHandler, EpochBegin, EpochEnd,
-                            LoggingHandler, StoppingHandler, TrainBegin,
+                            GradientUpdateHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
                             TrainEnd, ValidationHandler)

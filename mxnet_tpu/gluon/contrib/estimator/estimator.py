@@ -7,7 +7,8 @@ import copy
 from .... import metric as _metric_mod
 from ... import Trainer
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
-                            LoggingHandler, StoppingHandler, TrainBegin,
+                            GradientUpdateHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
                             TrainEnd, ValidationHandler)
 
 __all__ = ["Estimator"]
@@ -88,6 +89,10 @@ class Estimator:
 
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler())
         if not any(isinstance(h, LoggingHandler) for h in handlers):
             handlers.append(LoggingHandler())
         if val_data is not None and \
@@ -105,27 +110,29 @@ class Estimator:
         self.stop_training = False
         fire("train_begin", TrainBegin)
         while not self.stop_training:
-            for m in self.train_metrics:
-                m.reset()
-            self.train_loss_metric.reset()
-            fire("epoch_begin", EpochBegin)
+            fire("epoch_begin", EpochBegin)   # MetricHandler resets here
             for batch in train_data:
                 if self.stop_training:
                     break
                 fire("batch_begin", BatchBegin)
                 data, label = self._unpack(batch)
-                bsz = data.shape[batch_axis]
                 with autograd.record():
                     pred = self.net(data)
                     loss = self.loss(pred, label)
                 loss.backward()
-                self.trainer.step(bsz)
-                self.train_loss_metric.update(None, loss)
-                for m in self.train_metrics:
-                    m.update([label], [pred])
+                # optimizer step + metric updates are handlers
+                # (GradientUpdateHandler -2000, MetricHandler -1000 —
+                # 2.x parity; override either by passing your own)
+                self._batch_size = data.shape[batch_axis]
+                self._batch_label = label
+                self._batch_pred = pred
+                self._batch_loss = loss
                 fire("batch_end", BatchEnd)
             fire("epoch_end", EpochEnd)
             if hasattr(train_data, "reset"):
                 train_data.reset()
+        # release the last batch's tensors (the loss pins its whole
+        # autograd graph — activations would stay live with the estimator)
+        self._batch_pred = self._batch_label = self._batch_loss = None
         fire("train_end", TrainEnd)
         return self

@@ -11,7 +11,8 @@ import numpy as onp
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler", "LoggingHandler", "ValidationHandler"]
+           "EarlyStoppingHandler", "LoggingHandler", "ValidationHandler",
+           "MetricHandler", "GradientUpdateHandler"]
 
 
 class TrainBegin:
@@ -71,6 +72,38 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
         self.current_epoch += 1
         if self.max_epoch is not None and self.current_epoch >= self.max_epoch:
             estimator.stop_training = True
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step after backward (parity: 2.x
+    GradientUpdateHandler — override to customize the update, e.g.
+    gradient accumulation).  priority -2000: runs before metrics."""
+
+    priority = -2000
+
+    def batch_end(self, estimator, *args, **kwargs):
+        estimator.trainer.step(estimator._batch_size)
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Updates train metrics from the last batch (parity: 2.x
+    MetricHandler).  priority -1000: after the gradient update, before
+    user handlers read metrics."""
+
+    priority = -1000
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in (self.metrics or estimator.train_metrics):
+            m.reset()
+        estimator.train_loss_metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        estimator.train_loss_metric.update(None, estimator._batch_loss)
+        for m in (self.metrics or estimator.train_metrics):
+            m.update([estimator._batch_label], [estimator._batch_pred])
 
 
 class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):

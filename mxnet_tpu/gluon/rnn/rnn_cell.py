@@ -201,6 +201,10 @@ class SequentialRNNCell(RecurrentCell):
             out.extend(c.state_info(batch_size))
         return out
 
+    def reset(self):
+        for c in self._children.values():
+            c.reset()
+
     def forward(self, inputs, states):
         next_states = []
         pos = 0
@@ -294,6 +298,10 @@ class BidirectionalCell(RecurrentCell):
     def state_info(self, batch_size=0):
         return self.l_cell.state_info(batch_size) + \
             self.r_cell.state_info(batch_size)
+
+    def reset(self):
+        self.l_cell.reset()
+        self.r_cell.reset()
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):

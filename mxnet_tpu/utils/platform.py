@@ -42,14 +42,34 @@ def probe_accelerator(timeout: float = 120.0) -> bool:
 
     The probe must be out-of-process: once the in-process ``jax.devices()``
     blocks on a busy chip there is no safe way to abandon it.
+
+    CRITICAL: a probe that times out is NEVER killed — killing a client
+    mid-TPU-init wedges the chip server-side for hours (the exact failure
+    this probe exists to detect).  A slow probe is left to finish on its
+    own (it exits immediately after connecting) and the call returns
+    False.
     """
+    import time
+
     code = "import jax; jax.devices()"
     try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           capture_output=True, timeout=timeout)
-        return r.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL,
+                             start_new_session=True)
+    except OSError:
         return False
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        rc = p.poll()
+        if rc is not None:
+            return rc == 0
+        time.sleep(0.5)
+    # still connecting — abandoned, NOT killed; reap it from a daemon
+    # thread whenever it eventually exits (no zombie)
+    import threading
+    threading.Thread(target=p.wait, daemon=True).start()
+    return False
 
 
 def init_backend(n_cpu_devices: int = 8, probe_timeout: float = 120.0) -> str:

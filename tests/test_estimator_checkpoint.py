@@ -192,3 +192,38 @@ def test_val_metric_copies_config():
     est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                     train_metrics=mmetric.TopKAccuracy(top_k=2))
     assert est.val_metrics[0].top_k == 2
+
+
+def test_gradient_update_and_metric_handlers_overridable():
+    """2.x parity: the optimizer step and metric updates are handlers a
+    user can replace (e.g. gradient accumulation every 2 batches)."""
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   GradientUpdateHandler,
+                                                   MetricHandler)
+
+    class EveryTwo(GradientUpdateHandler):
+        def __init__(self):
+            self.count = 0
+
+        def batch_end(self, estimator, *args, **kwargs):
+            self.count += 1
+            if self.count % 2 == 0:
+                estimator.trainer.step(2 * estimator._batch_size)
+
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    X = onp.random.randn(32, 4).astype("f")
+    Y = onp.random.randint(0, 2, (32,))
+    data = [(mx.nd.array(X[i:i+8]), mx.nd.array(Y[i:i+8]))
+            for i in range(0, 32, 8)]
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=trainer)
+    h = EveryTwo()
+    w0 = net.weight.data().asnumpy().copy()
+    est.fit(data, epochs=1, event_handlers=[h])
+    assert h.count == 4                      # saw every batch
+    assert not onp.allclose(w0, net.weight.data().asnumpy())
+    # default MetricHandler updated train metrics
+    assert est.train_loss_metric.num_inst > 0

@@ -383,3 +383,27 @@ def test_modifier_cell_hierarchy():
     assert isinstance(grnn.ResidualCell(base), grnn.ModifierCell)
     assert isinstance(grnn.ZoneoutCell(base), grnn.ModifierCell)
     assert isinstance(grnn.VariationalDropoutCell(base), grnn.ModifierCell)
+
+
+def test_container_cells_propagate_reset():
+    from mxnet_tpu import base as _b
+    from mxnet_tpu.gluon import rnn as grnn
+
+    s = grnn.SequentialRNNCell()
+    v = grnn.VariationalDropoutCell(grnn.RNNCell(8), drop_outputs=0.5)
+    s.add(v)
+    s.initialize()
+    x4 = nd.array(onp.ones((4, 3, 8), "f"))
+    x2 = nd.array(onp.ones((2, 3, 8), "f"))
+    with _b.training_mode(True):
+        s.unroll(3, x4, merge_outputs=True)
+        # second unroll with a DIFFERENT batch: stale (4,8) mask would
+        # break broadcasting if reset did not propagate to the child
+        s.unroll(3, x2, merge_outputs=True)
+    b = grnn.BidirectionalCell(
+        grnn.VariationalDropoutCell(grnn.RNNCell(4), drop_outputs=0.5),
+        grnn.VariationalDropoutCell(grnn.RNNCell(4), drop_outputs=0.5))
+    b.initialize()
+    with _b.training_mode(True):
+        b.unroll(3, nd.array(onp.ones((4, 3, 4), "f")), merge_outputs=True)
+        b.unroll(3, nd.array(onp.ones((2, 3, 4), "f")), merge_outputs=True)

@@ -52,11 +52,12 @@ def main():
             print(json.dumps({"batch": b, "error": str(e)[:200]}),
                   flush=True)
             continue
-        print(json.dumps({"batch": b, "value": rec["value"],
-                          "unit": rec["unit"],
+        actual = rec.get("batch", b)
+        print(json.dumps({"batch": actual, "requested": b,
+                          "value": rec["value"], "unit": rec["unit"],
                           "vs_baseline": rec["vs_baseline"]}), flush=True)
         if best is None or rec["value"] > best[1]:
-            best = (b, rec["value"])
+            best = (actual, rec["value"])
     if best:
         print(json.dumps({"best": best[0], "value": best[1]}), flush=True)
 
