@@ -109,30 +109,33 @@ class Estimator:
 
         self.stop_training = False
         fire("train_begin", TrainBegin)
-        while not self.stop_training:
-            fire("epoch_begin", EpochBegin)   # MetricHandler resets here
-            for batch in train_data:
-                if self.stop_training:
-                    break
-                fire("batch_begin", BatchBegin)
-                data, label = self._unpack(batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                # optimizer step + metric updates are handlers
-                # (GradientUpdateHandler -2000, MetricHandler -1000 —
-                # 2.x parity; override either by passing your own)
-                self._batch_size = data.shape[batch_axis]
-                self._batch_label = label
-                self._batch_pred = pred
-                self._batch_loss = loss
-                fire("batch_end", BatchEnd)
-            fire("epoch_end", EpochEnd)
-            if hasattr(train_data, "reset"):
-                train_data.reset()
-        # release the last batch's tensors (the loss pins its whole
-        # autograd graph — activations would stay live with the estimator)
-        self._batch_pred = self._batch_label = self._batch_loss = None
+        try:
+            while not self.stop_training:
+                fire("epoch_begin", EpochBegin)  # MetricHandler resets here
+                for batch in train_data:
+                    if self.stop_training:
+                        break
+                    fire("batch_begin", BatchBegin)
+                    data, label = self._unpack(batch)
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    # optimizer step + metric updates are handlers
+                    # (GradientUpdateHandler -2000, MetricHandler -1000 —
+                    # 2.x parity; override either by passing your own)
+                    self._batch_size = data.shape[batch_axis]
+                    self._batch_label = label
+                    self._batch_pred = pred
+                    self._batch_loss = loss
+                    fire("batch_end", BatchEnd)
+                fire("epoch_end", EpochEnd)
+                if hasattr(train_data, "reset"):
+                    train_data.reset()
+        finally:
+            # release the last batch's tensors even on error — the loss
+            # pins its whole autograd graph (all activations) and would
+            # stay live with the estimator
+            self._batch_pred = self._batch_label = self._batch_loss = None
         fire("train_end", TrainEnd)
         return self

@@ -14,7 +14,8 @@ from ...nn.basic_layers import Sequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+           "RandomSaturation", "RandomLighting", "RandomColorJitter",
+           "RandomHue", "RandomGray", "RandomCrop", "CropResize"]
 
 
 def _to_numpy(x):
@@ -201,6 +202,89 @@ class RandomLighting(_NpTransform):
             .astype(x.dtype)
 
 
+class RandomHue(_NpTransform):
+    """Random hue jitter (parity: transforms.RandomHue) — HSV rotation via
+    the RGB-space approximation upstream uses (YIQ hue matrix)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def _apply(self, x):
+        alpha = onp.random.uniform(-self._h, self._h) * onp.pi
+        dtype = x.dtype
+        f = x.astype("float32")
+        u, w = onp.cos(alpha), onp.sin(alpha)
+        # YIQ rotation (upstream image.py RandomHueAug matrix)
+        t_yiq = onp.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], "float32")
+        t_rgb = onp.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], "float32")
+        rot = onp.array([[1, 0, 0], [0, u, -w], [0, w, u]], "float32")
+        m = t_rgb @ rot @ t_yiq
+        out = f @ m.T
+        return out.clip(0, 255 if dtype == onp.uint8 else None).astype(dtype)
+
+
+class RandomGray(_NpTransform):
+    """With probability p, convert to 3-channel grayscale (parity:
+    transforms.RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def _apply(self, x):
+        if onp.random.uniform() >= self._p:
+            return x
+        gray = (x.astype("float32") @
+                onp.array([0.299, 0.587, 0.114], "float32"))
+        out = onp.repeat(gray[..., None], 3, axis=-1)
+        return out.clip(0, 255 if x.dtype == onp.uint8 else None)             .astype(x.dtype)
+
+
+class RandomCrop(_NpTransform):
+    """Random crop with optional padding (parity: transforms.RandomCrop —
+    the CIFAR augmentation)."""
+
+    def __init__(self, size, pad=None, pad_value=0, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._pad_value = pad_value
+
+    def _apply(self, x):
+        if self._pad:
+            p = self._pad
+            x = onp.pad(x, ((p, p), (p, p), (0, 0)), mode="constant",
+                        constant_values=self._pad_value)
+        w, h = self._size
+        src_h, src_w = x.shape[:2]
+        if src_h < h or src_w < w:
+            return _resize_hwc(x, (w, h))
+        y0 = onp.random.randint(0, src_h - h + 1)
+        x0 = onp.random.randint(0, src_w - w + 1)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class CropResize(_NpTransform):
+    """Fixed crop then optional resize (parity: transforms.CropResize)."""
+
+    def __init__(self, x0, y0, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (int(x0), int(y0), int(width), int(height))
+        self._size = ((size, size) if isinstance(size, int) else size)             if size is not None else None
+
+    def _apply(self, x):
+        x0, y0, w, h = self._box
+        out = x[y0:y0 + h, x0:x0 + w]
+        if self._size is not None:
+            out = _resize_hwc(out, self._size)
+        return out
+
+
 class RandomColorJitter(Compose):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         ts = []
@@ -210,4 +294,6 @@ class RandomColorJitter(Compose):
             ts.append(RandomContrast(contrast))
         if saturation:
             ts.append(RandomSaturation(saturation))
+        if hue:
+            ts.append(RandomHue(hue))
         super().__init__(ts)

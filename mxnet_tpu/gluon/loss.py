@@ -8,7 +8,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
            "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
-           "CTCLoss", "PoissonNLLLoss"]
+           "CTCLoss", "PoissonNLLLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -316,3 +316,40 @@ class PoissonNLLLoss(Loss):
         if loss.ndim > 1:
             loss = loss.mean(axis=tuple(range(1, loss.ndim)))
         return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (parity: gluon/loss.py SDMLLoss).
+
+    Batchwise smoothed CE over pairwise l2 distances between two aligned
+    embedding batches (row i of x1 pairs with row i of x2; all other rows
+    are negatives).
+    """
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+
+    def forward(self, x1, x2):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray.ops import _as_nd, invoke
+
+        x1, x2 = _as_nd(x1), _as_nd(x2)
+
+        def f(a, b):
+            n = a.shape[0]
+            # pairwise euclidean distances (n, n)
+            d = jnp.sqrt(jnp.maximum(
+                jnp.sum((a[:, None, :] - b[None, :, :]) ** 2, axis=-1),
+                1e-12))
+            # smoothed one-hot targets over the batch
+            eye = jnp.eye(n)
+            smooth = self._smoothing / jnp.maximum(n - 1, 1)
+            target = eye * (1 - self._smoothing) + (1 - eye) * smooth
+            logp = jax.nn.log_softmax(-d, axis=-1)
+            return -jnp.sum(target * logp, axis=-1)
+
+        return invoke("sdml_loss", f, [x1, x2])

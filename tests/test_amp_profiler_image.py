@@ -129,3 +129,20 @@ def test_augmenter_dumps():
     assert any(isinstance(a, mx.image.HorizontalFlipAug) for a in augs)
     for a in augs:
         assert isinstance(a.dumps(), str)
+
+
+def test_new_vision_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = (onp.random.rand(32, 32, 3) * 255).astype("uint8")
+    assert T.RandomCrop(28, pad=2)(img).shape == (28, 28, 3)
+    g = T.RandomGray(1.0)(img)
+    ga = g.asnumpy() if hasattr(g, "asnumpy") else onp.asarray(g)
+    assert ga.shape == (32, 32, 3)
+    onp.testing.assert_array_equal(ga[..., 0], ga[..., 1])   # gray
+    h = T.RandomHue(0.3)(img)
+    ha = h.asnumpy() if hasattr(h, "asnumpy") else onp.asarray(h)
+    assert ha.dtype == onp.uint8 and ha.shape == (32, 32, 3)
+    c = T.CropResize(4, 4, 16, 16, size=8)(img)
+    ca = c.asnumpy() if hasattr(c, "asnumpy") else onp.asarray(c)
+    assert ca.shape == (8, 8, 3)

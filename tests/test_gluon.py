@@ -407,3 +407,19 @@ def test_container_cells_propagate_reset():
     with _b.training_mode(True):
         b.unroll(3, nd.array(onp.ones((4, 3, 4), "f")), merge_outputs=True)
         b.unroll(3, nd.array(onp.ones((2, 3, 4), "f")), merge_outputs=True)
+
+
+def test_sdml_loss():
+    """SDMLLoss: aligned pairs score lower than random pairs; grads flow."""
+    l = gluon.loss.SDMLLoss()
+    x1 = nd.array(onp.random.randn(6, 8).astype("f"))
+    x2 = nd.array(x1.asnumpy() + 0.01 * onp.random.randn(6, 8).astype("f"))
+    x3 = nd.array(onp.random.randn(6, 8).astype("f"))
+    aligned = float(l(x1, x2).mean().asnumpy())
+    rand = float(l(x1, x3).mean().asnumpy())
+    assert aligned < rand
+    x1.attach_grad()
+    with autograd.record():
+        out = l(x1, x2).mean()
+    out.backward()
+    assert onp.isfinite(x1.grad.asnumpy()).all()
