@@ -72,8 +72,10 @@ def _run_steps(trainer, batches, warmup: int, steps: int) -> float:
 
 def _record(metric: str, value: float, unit: str, mfu: float,
             batch=None) -> dict:
+    import jax
     rec = {"metric": metric, "value": round(value, 1), "unit": unit,
-           "vs_baseline": round(mfu / 0.45, 4)}
+           "vs_baseline": round(mfu / 0.45, 4),
+           "platform": jax.default_backend()}
     if batch is not None:
         rec["batch"] = batch   # ACTUAL per-step batch (after dp rounding)
     return rec

@@ -206,6 +206,14 @@ class RandomHue(_NpTransform):
     """Random hue jitter (parity: transforms.RandomHue) — HSV rotation via
     the RGB-space approximation upstream uses (YIQ hue matrix)."""
 
+    # constant color-space matrices (upstream image.py RandomHueAug)
+    _T_YIQ = onp.array([[0.299, 0.587, 0.114],
+                        [0.596, -0.274, -0.321],
+                        [0.211, -0.523, 0.311]], "float32")
+    _T_RGB = onp.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], "float32")
+
     def __init__(self, hue):
         super().__init__()
         self._h = hue
@@ -215,15 +223,8 @@ class RandomHue(_NpTransform):
         dtype = x.dtype
         f = x.astype("float32")
         u, w = onp.cos(alpha), onp.sin(alpha)
-        # YIQ rotation (upstream image.py RandomHueAug matrix)
-        t_yiq = onp.array([[0.299, 0.587, 0.114],
-                           [0.596, -0.274, -0.321],
-                           [0.211, -0.523, 0.311]], "float32")
-        t_rgb = onp.array([[1.0, 0.956, 0.621],
-                           [1.0, -0.272, -0.647],
-                           [1.0, -1.107, 1.705]], "float32")
         rot = onp.array([[1, 0, 0], [0, u, -w], [0, w, u]], "float32")
-        m = t_rgb @ rot @ t_yiq
+        m = self._T_RGB @ rot @ self._T_YIQ
         out = f @ m.T
         return out.clip(0, 255 if dtype == onp.uint8 else None).astype(dtype)
 
@@ -258,7 +259,8 @@ class RandomCrop(_NpTransform):
     def _apply(self, x):
         if self._pad:
             p = self._pad
-            x = onp.pad(x, ((p, p), (p, p), (0, 0)), mode="constant",
+            pw = ((p, p), (p, p)) + ((0, 0),) * (x.ndim - 2)
+            x = onp.pad(x, pw, mode="constant",
                         constant_values=self._pad_value)
         w, h = self._size
         src_h, src_w = x.shape[:2]

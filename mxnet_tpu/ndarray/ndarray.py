@@ -379,6 +379,45 @@ class NDArray:
         return self._unary("prod", axis=axis, keepdims=keepdims)
     def argmax(self, axis=None): return self._unary("argmax", axis=axis)
     def argmin(self, axis=None): return self._unary("argmin", axis=axis)
+
+    def _np_method(self, name, *args, **kwargs):
+        """Delegate to the mx.np function of the same name (numpy-parity
+        methods whose op lives only in the np namespace)."""
+        from .. import numpy as _np
+        return getattr(_np, name)(self, *args, **kwargs)
+
+    def std(self, axis=None, keepdims=False):
+        return self._np_method("std", axis=axis, keepdims=keepdims)
+    def var(self, axis=None, keepdims=False):
+        return self._np_method("var", axis=axis, keepdims=keepdims)
+    def cumsum(self, axis=None):
+        return self._np_method("cumsum", axis=axis)
+    # sort/argsort follow NUMPY semantics here (differentiable sort,
+    # integer indices); the legacy float32-index mx.nd.argsort op keeps
+    # its 1.x behavior as a free function
+    def sort(self, axis=-1):
+        return self._np_method("sort", axis=axis)
+    def argsort(self, axis=-1):
+        return self._np_method("argsort", axis=axis)
+    def nonzero(self): return self._np_method("nonzero")
+    def all(self, axis=None, keepdims=False):
+        return self._np_method("all", axis=axis, keepdims=keepdims)
+    def any(self, axis=None, keepdims=False):
+        return self._np_method("any", axis=axis, keepdims=keepdims)
+    def ravel(self): return self._np_method("ravel")
+
+    @property
+    def itemsize(self):
+        import numpy as _onp
+        return _onp.dtype(self.dtype).itemsize
+
+    @property
+    def flat(self):
+        # read-only: a writable .flat would mutate only a host copy —
+        # raising beats silently discarding writes
+        a = self.asnumpy()
+        a.flags.writeable = False
+        return a.flat
     def norm(self, ord=2, axis=None, keepdims=False):
         return self._unary("norm", ord=ord, axis=axis, keepdims=keepdims)
     def clip(self, a_min=None, a_max=None):

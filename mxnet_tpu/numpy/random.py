@@ -23,7 +23,9 @@ __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
            "beta", "exponential", "poisson", "lognormal", "laplace",
            "gumbel", "logistic", "chisquare", "multivariate_normal",
            "binomial", "bernoulli", "weibull", "pareto", "power", "rayleigh",
-           "f"]
+           "f",
+           "standard_normal", "standard_exponential", "standard_cauchy",
+           "negative_binomial"]
 
 
 def seed(s):
@@ -231,3 +233,25 @@ def f(dfnum, dfden, size=None, ctx=None, device=None):
     num = chisquare(dfnum, size=size).jax / dfnum
     den = chisquare(dfden, size=size).jax / dfden
     return _from_jax(num / den)
+
+
+def standard_normal(size=None, ctx=None, device=None):
+    return _from_jax(_jax.random.normal(_key(), _shape(size)))
+
+
+def standard_exponential(size=None, ctx=None, device=None):
+    return _from_jax(_jax.random.exponential(_key(), _shape(size)))
+
+
+def standard_cauchy(size=None, ctx=None, device=None):
+    return _from_jax(_jax.random.cauchy(_key(), _shape(size)))
+
+
+def negative_binomial(n, p, size=None, ctx=None, device=None):
+    """NB(n, p) via the Gamma-Poisson mixture (numpy semantics: number of
+    failures before the n-th success with success probability p)."""
+    n = n.jax if isinstance(n, NDArray) else n
+    p = p.jax if isinstance(p, NDArray) else p
+    shape = _shape(size)
+    lam = _jax.random.gamma(_key(), n, shape=shape or None) * (1 - p) / p
+    return _from_jax(_jax.random.poisson(_key(), lam))

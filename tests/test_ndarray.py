@@ -199,3 +199,41 @@ def test_sample_multinomial_shapes():
     batch = nd.array([[0.5, 0.5], [0.9, 0.1]])
     sb = nd.sample_multinomial(batch)
     assert sb.shape == (2,)
+
+
+def test_numpy_parity_methods():
+    x = mx.np.array(onp.array([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]], "f"))
+    onp.testing.assert_allclose(x.std().asnumpy(),
+                                onp.std(x.asnumpy()), rtol=1e-6)
+    onp.testing.assert_allclose(x.var(axis=1).asnumpy(),
+                                onp.var(x.asnumpy(), axis=1), rtol=1e-6)
+    onp.testing.assert_allclose(x.cumsum(axis=0).asnumpy(),
+                                onp.cumsum(x.asnumpy(), axis=0))
+    onp.testing.assert_allclose(x.sort(axis=1).asnumpy(),
+                                onp.sort(x.asnumpy(), axis=1))
+    onp.testing.assert_array_equal(x.argsort(axis=1).asnumpy(),
+                                   onp.argsort(x.asnumpy(), axis=1))
+    assert bool((x > 0).all().asnumpy())
+    assert bool((x > 5).any().asnumpy())
+    assert x.ravel().shape == (6,)
+    assert x.itemsize == 4
+    assert list(x.flat)[0] == 3.0
+    nz = x.nonzero()
+    assert len(nz) == 2 and nz[0].shape == (6,)
+
+
+def test_method_sort_grad_and_int_argsort():
+    x = mx.np.array(onp.array([3.0, 1.0, 2.0], "f"))
+    idx = x.argsort()
+    assert idx.dtype.kind in "iu"              # numpy semantics
+    x.attach_grad()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        s = (x.sort() * mx.np.array([1.0, 2.0, 3.0])).sum()
+    s.backward()
+    g = x.grad.asnumpy()
+    onp.testing.assert_allclose(g, [3.0, 1.0, 2.0])   # grads permute back
+    # .flat refuses writes instead of silently dropping them
+    import pytest
+    with pytest.raises(ValueError):
+        x.flat[0] = 99.0

@@ -218,3 +218,14 @@ def test_npx_masked_softmax():
     s.backward()
     g = xa.grad.asnumpy()
     assert onp.isfinite(g).all() and g[0, 2] == 0.0
+
+
+def test_np_random_additions():
+    mx.random.seed(3)
+    assert mx.np.random.standard_normal((64,)).shape == (64,)
+    assert float(mx.np.random.standard_exponential(
+        (64,)).asnumpy().min()) >= 0
+    assert mx.np.random.standard_cauchy((8,)).shape == (8,)
+    nb = mx.np.random.negative_binomial(5, 0.5, (4000,)).asnumpy()
+    assert 4.0 < nb.mean() < 6.0           # mean = n(1-p)/p = 5
+    assert (nb >= 0).all() and nb.dtype.kind in "iu"
