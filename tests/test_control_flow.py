@@ -63,8 +63,8 @@ def test_while_loop_eager():
     outs, (fi, fs) = while_loop(cond_fn, body,
                                 (nd.array([0.0]), nd.array([0.0])),
                                 max_iterations=10)
-    assert float(fi.asnumpy()) == 4.0
-    assert float(fs.asnumpy()) == 0 + 1 + 2 + 3
+    assert float(fi.asscalar()) == 4.0
+    assert float(fs.asscalar()) == 0 + 1 + 2 + 3
     assert outs.shape[0] == 10  # padded
 
 
@@ -87,13 +87,13 @@ def test_while_loop_traced():
     net = W()
     net.hybridize()
     out = net(nd.array([0.0]))
-    assert float(out.asnumpy()) == 6.0   # 0+1+2+3
+    assert float(out.asscalar()) == 6.0   # 0+1+2+3
 
 
 def test_cond_eager_and_traced():
     x = nd.array([2.0])
     r = cond(nd.sum(x) > 1.0, lambda: x * 10.0, lambda: x - 1.0)
-    assert float(r.asnumpy()) == 20.0
+    assert float(r.asscalar()) == 20.0
 
     from mxnet_tpu.gluon import HybridBlock
 
@@ -104,8 +104,8 @@ def test_cond_eager_and_traced():
 
     net = C()
     net.hybridize()
-    assert float(net(nd.array([2.0])).asnumpy()) == 20.0
-    assert float(net(nd.array([0.5])).asnumpy()) == -0.5
+    assert float(net(nd.array([2.0])).asnumpy().item()) == 20.0
+    assert float(net(nd.array([0.5])).asnumpy().item()) == -0.5
 
 
 def test_custom_op():
@@ -160,4 +160,4 @@ def test_library_load_py(tmp_path):
         "        return Double()\n")
     mx.library.load(str(ext))
     out = nd.Custom(nd.array([3.0]), op_type="ext_double")
-    assert float(out.asnumpy()) == 6.0
+    assert float(out.asscalar()) == 6.0

@@ -285,7 +285,7 @@ def test_disable_jit_debug_lever():
     with util.disable_jit():
         assert jax.config.jax_disable_jit
         out = (net_in * 2).sum()
-        assert float(out.asnumpy()) == 12.0
+        assert float(out.asscalar()) == 12.0
     assert not jax.config.jax_disable_jit
 
 

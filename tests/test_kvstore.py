@@ -111,5 +111,5 @@ def test_trainer_with_compression_params_converges():
             l = ((net(xb) - yb) ** 2).mean()
         l.backward()
         tr.step(1)          # loss is already a mean over the batch
-        loss_prev = float(l.asnumpy())
+        loss_prev = float(l.asscalar())
     assert loss_prev < 0.1, loss_prev

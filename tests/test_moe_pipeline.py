@@ -83,9 +83,9 @@ def test_moe_gpt2_ep_sharded_training():
         tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
                                 optimizer_params={"learning_rate": 1e-2},
                                 mesh=mesh)
-        first = float(tr.step(toks, labels).asnumpy())
+        first = float(tr.step(toks, labels).asscalar())
         for _ in range(8):
-            last = float(tr.step(toks, labels).asnumpy())
+            last = float(tr.step(toks, labels).asscalar())
     assert last < first
     assert "ep" in str(net.blocks[1].moe.w1.data().jax.sharding.spec)
 
@@ -160,8 +160,8 @@ def test_stacked_gpt2_pp_sharded_training():
         tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
                                 optimizer_params={"learning_rate": 1e-2},
                                 mesh=mesh)
-        first = float(tr.step(toks, labels).asnumpy())
+        first = float(tr.step(toks, labels).asscalar())
         for _ in range(6):
-            last = float(tr.step(toks, labels).asnumpy())
+            last = float(tr.step(toks, labels).asscalar())
     assert last < first
     assert "pp" in str(net.wqkv.data().jax.sharding.spec)

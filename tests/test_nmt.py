@@ -53,13 +53,13 @@ def test_nmt_src_padding_masked():
 def test_nmt_loss_masks_padding():
     logits = mx.nd.array(onp.random.randn(2, 4, 8).astype("f"))
     labels = mx.nd.array(onp.random.randint(0, 8, (2, 4)), dtype="int32")
-    full = float(models.nmt_loss(logits, labels).asnumpy())
+    full = float(models.nmt_loss(logits, labels).asscalar())
     vlen = mx.nd.array([4, 4], dtype="int32")
-    same = float(models.nmt_loss(logits, labels, vlen).asnumpy())
+    same = float(models.nmt_loss(logits, labels, vlen).asscalar())
     onp.testing.assert_allclose(full, same, rtol=1e-5)
     # masking out the second half changes the value (different positions)
     vlen2 = mx.nd.array([2, 2], dtype="int32")
-    half = float(models.nmt_loss(logits, labels, vlen2).asnumpy())
+    half = float(models.nmt_loss(logits, labels, vlen2).asscalar())
     assert abs(half - full) > 1e-7
 
 

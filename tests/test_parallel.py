@@ -78,8 +78,8 @@ def test_sharded_trainer_mlp_converges():
         for i in range(60):
             loss = trainer.step(mx.nd.array(x), mx.nd.array(y))
             if first is None:
-                first = float(loss.asnumpy())
-        last = float(loss.asnumpy())
+                first = float(loss.asscalar())
+        last = float(loss.asscalar())
     assert last < first * 0.1, (first, last)
 
 

@@ -27,10 +27,10 @@ def test_quantize_v2_auto_range():
     rs = onp.random.RandomState(1)
     x = nd.array(rs.uniform(-2, 5, (16,)).astype("float32"))
     q, mn, mx_ = quantize_v2(x)
-    assert float(mn.asnumpy()) == pytest.approx(float(x.asnumpy().min()))
-    assert float(mx_.asnumpy()) == pytest.approx(float(x.asnumpy().max()))
+    assert float(mn.asscalar()) == pytest.approx(float(x.asnumpy().min()))
+    assert float(mx_.asscalar()) == pytest.approx(float(x.asnumpy().max()))
     back = dequantize(q, mn, mx_)
-    scale = max(abs(float(mn.asnumpy())), abs(float(mx_.asnumpy()))) / 127
+    scale = max(abs(float(mn.asscalar())), abs(float(mx_.asscalar()))) / 127
     onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
                                 atol=scale + 1e-6)
 

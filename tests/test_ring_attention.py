@@ -90,7 +90,7 @@ def test_sharded_trainer_sp_training_step():
         tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
                                 optimizer_params={"learning_rate": 1e-2},
                                 mesh=mesh, seq_axis=1)
-        first = float(tr.step(toks, labels).asnumpy())
+        first = float(tr.step(toks, labels).asscalar())
         for _ in range(5):
-            last = float(tr.step(toks, labels).asnumpy())
+            last = float(tr.step(toks, labels).asscalar())
     assert last < first, (first, last)

@@ -22,7 +22,7 @@ def test_named_ops_and_eval():
     y = sym.relu(x, name="act")
     z = sym.sum(y)
     out = z.eval(x=nd.array([-1.0, 2.0, -3.0, 4.0]))
-    assert float(out[0].asnumpy()) == 6.0
+    assert float(out[0].asscalar()) == 6.0
 
 
 def test_fully_connected_graph():
@@ -35,7 +35,7 @@ def test_fully_connected_graph():
     assert args == ["data", "w", "b"]
     out = loss.eval(data=nd.ones((2, 4)), w=nd.ones((3, 4)),
                     b=nd.zeros((3,)))
-    assert float(out[0].asnumpy()) == 2 * 3 * 4
+    assert float(out[0].asscalar()) == 2 * 3 * 4
 
 
 def test_json_roundtrip():
@@ -96,7 +96,7 @@ def test_executor_forward_backward():
     exe = y.bind(args={"x": xa, "w": wa},
                  args_grad={"x": nd.zeros((3,)), "w": nd.zeros((3,))})
     outs = exe.forward(is_train=True)
-    assert float(outs[0].asnumpy()) == 32.0
+    assert float(outs[0].asscalar()) == 32.0
     exe.backward()
     onp.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), [4.0, 5.0, 6.0])
     onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(), [1.0, 2.0, 3.0])
@@ -111,7 +111,7 @@ def test_simple_bind():
     exe.arg_dict["data"]._rebind(nd.ones((2, 8)).jax)
     exe.arg_dict["w"]._rebind(nd.ones((4, 8)).jax)
     outs = exe.forward(is_train=True)
-    assert float(outs[0].asnumpy()) == 2 * 4 * 8
+    assert float(outs[0].asscalar()) == 2 * 4 * 8
     exe.backward()
     assert exe.grad_dict["w"].shape == (4, 8)
     onp.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
