@@ -47,7 +47,10 @@ def main():
     ap.add_argument("--batch-size", type=int, default=16)
     args = ap.parse_args()
 
-    tmp = tempfile.mkdtemp()
+    mx.random.seed(0)
+    onp.random.seed(0)
+    tmp_ctx = tempfile.TemporaryDirectory()
+    tmp = tmp_ctx.name
     rec = make_rec(os.path.join(tmp, "train.rec"))
     it = mx.io.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, 32, 32),
@@ -75,6 +78,7 @@ def main():
             metric.update([y], [out])
         print(f"epoch {epoch}  train-acc {metric.get()[1]:.3f}", flush=True)
     name, acc = metric.get()
+    tmp_ctx.cleanup()
     assert acc > 0.8, f"did not learn: {acc}"
     print("done")
 

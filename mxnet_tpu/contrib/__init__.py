@@ -169,3 +169,15 @@ def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
         return nds if len(nds) != 1 else nds[0]
     take = bool(pred.asnumpy() if isinstance(pred, NDArray) else pred_v)
     return then_func() if take else else_func()
+
+
+def __getattr__(name):
+    # upstream scripts reach contrib OPS as mx.nd.contrib.<op>
+    # (arange_like, interleaved_matmul_selfatt_*, div_sqrt_dim, ...);
+    # the kernels live in the main op namespace here.  Only REGISTERED
+    # ops (ops.__all__) forward — internals/typing helpers must raise so
+    # hasattr feature-probes stay truthful.
+    from ..ndarray import ops as _ops
+    if not name.startswith("_") and name in _ops.__all__:
+        return getattr(_ops, name)
+    raise AttributeError(name)

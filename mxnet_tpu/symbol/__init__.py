@@ -780,6 +780,22 @@ def _sym_op(opname):
     return op
 
 
+class _SymContrib:
+    """``mx.sym.contrib`` — upstream contrib ops resolve to the same
+    symbolic op factory (parity: symbol/contrib generated namespace).
+    Only REGISTERED ops (ops.__all__) resolve — module helpers/typing
+    names must raise so hasattr feature-probes stay truthful."""
+
+    def __getattr__(self, name):
+        from ..ndarray import ops as _real_ops
+        if not name.startswith("_") and name in _real_ops.__all__:
+            return _sym_op(name)
+        raise AttributeError(f"mx.sym.contrib has no op {name!r}")
+
+
+contrib = _SymContrib()
+
+
 def __getattr__(name):
     if name.startswith("_") or name in _SYM_ONLY:
         raise AttributeError(name)

@@ -126,3 +126,30 @@ def test_get_internals_and_getitem():
     hsym = internals["h"]
     out = hsym.eval(x=nd.array([-2.0, 3.0]))
     onp.testing.assert_allclose(out[0].asnumpy(), [0.0, 3.0])
+
+
+def test_contrib_namespaces():
+    """Upstream reaches contrib ops as mx.nd.contrib.* / mx.sym.contrib.*."""
+    x = mx.nd.array(onp.ones((2, 3), "f"))
+    onp.testing.assert_allclose(
+        mx.nd.contrib.arange_like(x, axis=1).asnumpy(), [0.0, 1.0, 2.0])
+    d = mx.sym.Variable("data")
+    s = mx.sym.contrib.div_sqrt_dim(d)
+    ex = s.simple_bind(data=(2, 4))
+    ex.arg_dict["data"]._rebind(mx.nd.array(onp.ones((2, 4), "f")).jax)
+    onp.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                                onp.ones((2, 4)) / 2.0)
+
+
+def test_contrib_namespaces_only_expose_registered_ops():
+    """hasattr feature-probes against contrib must not see op-module
+    internals or non-op callables."""
+    import pytest
+    assert not hasattr(mx.sym.contrib, "save")
+    assert not hasattr(mx.sym.contrib, "OpNode")
+    assert not hasattr(mx.nd.contrib, "node_of")
+    assert not hasattr(mx.nd.contrib, "invoke")
+    assert hasattr(mx.nd.contrib, "arange_like")
+    assert hasattr(mx.sym.contrib, "interleaved_matmul_selfatt_qk")
+    with pytest.raises(AttributeError):
+        mx.sym.contrib.no_such_op
