@@ -175,8 +175,9 @@ def __getattr__(name):
     # upstream scripts reach contrib OPS as mx.nd.contrib.<op>
     # (arange_like, interleaved_matmul_selfatt_*, div_sqrt_dim, ...);
     # the kernels live in the main op namespace here.  Only REGISTERED
-    # ops (ops.__all__) forward — internals/typing helpers must raise so
-    # hasattr feature-probes stay truthful.
+    # ops (ops.__all__) forward through THIS hook; note the module's own
+    # runtime imports (jax/lax/NDArray/typing) remain visible as plain
+    # module attributes, as in any Python module.
     from ..ndarray import ops as _ops
     if not name.startswith("_") and name in _ops.__all__:
         return getattr(_ops, name)

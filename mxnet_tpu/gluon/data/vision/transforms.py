@@ -8,6 +8,7 @@ from __future__ import annotations
 import numpy as onp
 
 from ....ndarray import NDArray, array
+from ....utils import colorspace as _colorspace
 from ...block import Block
 from ...nn.basic_layers import Sequential
 
@@ -186,10 +187,8 @@ class RandomSaturation(_NpTransform):
 
 
 class RandomLighting(_NpTransform):
-    _eigval = onp.array([55.46, 4.794, 1.148])
-    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
-                         [-0.5808, -0.0045, -0.8140],
-                         [-0.5836, -0.6948, 0.4203]])
+    _eigval = _colorspace.IMAGENET_PCA_EIGVAL
+    _eigvec = _colorspace.IMAGENET_PCA_EIGVEC
 
     def __init__(self, alpha_std):
         super().__init__()
@@ -206,13 +205,9 @@ class RandomHue(_NpTransform):
     """Random hue jitter (parity: transforms.RandomHue) — HSV rotation via
     the RGB-space approximation upstream uses (YIQ hue matrix)."""
 
-    # constant color-space matrices (upstream image.py RandomHueAug)
-    _T_YIQ = onp.array([[0.299, 0.587, 0.114],
-                        [0.596, -0.274, -0.321],
-                        [0.211, -0.523, 0.311]], "float32")
-    _T_RGB = onp.array([[1.0, 0.956, 0.621],
-                        [1.0, -0.272, -0.647],
-                        [1.0, -1.107, 1.705]], "float32")
+    # constant color-space matrices (shared source: utils.colorspace)
+    _T_YIQ = _colorspace.T_YIQ
+    _T_RGB = _colorspace.T_RGB
 
     def __init__(self, hue):
         super().__init__()

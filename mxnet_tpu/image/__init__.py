@@ -13,12 +13,16 @@ import numpy as onp
 from .. import base as _base
 from ..io import DataBatch, DataDesc, DataIter
 from ..ndarray import NDArray, array as nd_array
+from ..utils import colorspace as _colorspace
 
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "random_size_crop", "color_normalize",
            "HorizontalFlipAug", "RandomCropAug", "CenterCropAug", "ResizeAug",
            "ForceResizeAug", "ColorNormalizeAug", "CastAug",
-   	    "CreateAugmenter", "Augmenter", "ImageIter"]
+           "CreateAugmenter", "Augmenter", "ImageIter",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
+           "RandomOrderAug", "imrotate", "copyMakeBorder", "scale_down"]
 
 
 def _to_pil(img):
@@ -207,6 +211,126 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+def _jitter(src, fn):
+    arr = src.asnumpy().astype(onp.float32) \
+        if isinstance(src, NDArray) else onp.asarray(src, onp.float32)
+    return nd_array(fn(arr))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.brightness, self.brightness)
+        return _jitter(src, lambda a: a * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _colorspace.GRAY_COEF
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
+        def f(a):
+            gray = (a @ self._coef).mean()
+            return a * alpha + gray * (1.0 - alpha)
+        return _jitter(src, f)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _colorspace.GRAY_COEF
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
+        def f(a):
+            gray = (a @ self._coef)[..., None]
+            return a * alpha + gray * (1.0 - alpha)
+        return _jitter(src, f)
+
+
+class HueJitterAug(Augmenter):
+    _t_yiq = _colorspace.T_YIQ
+    _t_rgb = _colorspace.T_RGB
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = onp.random.uniform(-self.hue, self.hue) * onp.pi
+        u, w = onp.cos(alpha), onp.sin(alpha)
+        rot = onp.array([[1, 0, 0], [0, u, -w], [0, w, u]], onp.float32)
+        m = self._t_rgb @ rot @ self._t_yiq
+        return _jitter(src, lambda a: a @ m.T)
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (parity: RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for i in onp.random.permutation(len(self.ts)):
+            src = self.ts[i](src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness/contrast/saturation jitter (parity:
+    image.ColorJitterAug is a RandomOrderAug upstream too)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style; parity: image.LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, 3).astype(onp.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return _jitter(src, lambda a: a + rgb)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = _colorspace.GRAY_COEF
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.uniform() < self.p:
+            def f(a):
+                return onp.repeat((a @ self._coef)[..., None], 3, axis=-1)
+            return _jitter(src, f)
+        return src
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -226,6 +350,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise,
+                                   _colorspace.IMAGENET_PCA_EIGVAL,
+                                   _colorspace.IMAGENET_PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:
@@ -233,6 +367,75 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None and std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate image(s) by the given degrees (parity: image.imrotate —
+    upstream contract is CHW / NCHW tensors; HWC also accepted when the
+    last dim is 1/3 channels).  Nearest-neighbor sampling, zero fill.
+    ``zoom_in`` crops away the black corners, ``zoom_out`` shrinks so the
+    whole rotated frame fits (exclusive, like upstream)."""
+    if zoom_in and zoom_out:
+        raise ValueError("zoom_in and zoom_out are exclusive")
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    if arr.ndim == 4:                                   # NCHW
+        out = onp.stack([
+            imrotate(a, rotation_degrees, zoom_in, zoom_out).asnumpy()
+            for a in arr])
+        return nd_array(out)
+    if arr.ndim == 3 and arr.shape[-1] in (1, 3)             and arr.shape[0] not in (1, 3):
+        hwc = arr                                        # HWC
+        chw = False
+    else:                                                # CHW (upstream)
+        hwc = onp.transpose(arr, (1, 2, 0))
+        chw = True
+    theta = onp.deg2rad(float(rotation_degrees))
+    h, w = hwc.shape[:2]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    scale = abs(onp.cos(theta)) + abs(onp.sin(theta))
+    s = 1.0
+    if zoom_in:
+        s = 1.0 / scale        # sample a smaller source window: no corners
+    elif zoom_out:
+        s = scale              # sample a larger window: everything fits
+    yy, xx = onp.meshgrid(onp.arange(h), onp.arange(w), indexing="ij")
+    # inverse rotation mapping (scaled about the center)
+    ys = cy + s * ((yy - cy) * onp.cos(theta) - (xx - cx) * onp.sin(theta))
+    xs = cx + s * ((yy - cy) * onp.sin(theta) + (xx - cx) * onp.cos(theta))
+    yi = onp.round(ys).astype(onp.int64)
+    xi = onp.round(xs).astype(onp.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = onp.zeros_like(hwc)
+    out[valid] = hwc[yi[valid], xi[valid]]
+    if chw:
+        out = onp.transpose(out, (2, 0, 1))
+    return nd_array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0):  # noqa: A002
+    """Pad an HWC image (parity: the cv2-backed mx.image.copyMakeBorder).
+    type 0 = constant, 1 = replicate edge; other border types raise."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    pw = ((top, bot), (left, right)) + ((0, 0),) * (arr.ndim - 2)
+    if type == 0:
+        out = onp.pad(arr, pw, mode="constant", constant_values=value)
+    elif type == 1:
+        out = onp.pad(arr, pw, mode="edge")
+    else:
+        raise NotImplementedError(f"border type {type} not supported")
+    return nd_array(out)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit within src_size keeping aspect (parity:
+    image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
 
 
 class ImageIter(DataIter):

@@ -146,3 +146,62 @@ def test_new_vision_transforms():
     c = T.CropResize(4, 4, 16, 16, size=8)(img)
     ca = c.asnumpy() if hasattr(c, "asnumpy") else onp.asarray(c)
     assert ca.shape == (8, 8, 3)
+
+
+def test_image_jitter_augmenters_and_utils():
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as I
+
+    onp.random.seed(0)
+    img = mx.nd.array((onp.random.rand(24, 24, 3) * 255).astype("f"))
+    for aug in (I.BrightnessJitterAug(0.3), I.ContrastJitterAug(0.3),
+                I.SaturationJitterAug(0.3), I.HueJitterAug(0.3),
+                I.ColorJitterAug(0.2, 0.2, 0.2),
+                I.RandomGrayAug(1.0),
+                I.LightingAug(0.1, onp.ones(3), onp.eye(3)),
+                I.RandomOrderAug([I.BrightnessJitterAug(0.1)])):
+        out = aug(img)
+        assert out.shape == (24, 24, 3), type(aug).__name__
+    g = I.RandomGrayAug(1.0)(img).asnumpy()
+    onp.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+    # CreateAugmenter wires the jitter params (they were silently ignored)
+    augs = I.CreateAugmenter((3, 20, 20), brightness=0.1, hue=0.1,
+                             pca_noise=0.05, rand_gray=0.2)
+    names = [type(a).__name__ for a in augs]
+    assert "ColorJitterAug" in names and "HueJitterAug" in names
+    assert "LightingAug" in names and "RandomGrayAug" in names
+    # utils
+    r = I.imrotate(img, 90)
+    assert r.shape == (24, 24, 3)
+    # 90° rotation of a flat gradient moves the bright corner
+    assert not onp.allclose(r.asnumpy(), img.asnumpy())
+    b = I.copyMakeBorder(img, 2, 2, 3, 3, value=0)
+    assert b.shape == (28, 30, 3)
+    assert I.scale_down((8, 10), (16, 20)) == (8, 10)
+    assert I.scale_down((100, 100), (16, 20)) == (16, 20)
+
+
+def test_imrotate_chw_contract_and_zoom():
+    from mxnet_tpu import image as I
+    import pytest
+
+    # CHW (upstream contract): rotating 90 deg twice == 180 flip
+    chw = onp.zeros((3, 8, 8), "f")
+    chw[:, 0, :] = 1.0                       # bright top row
+    r = I.imrotate(mx.nd.array(chw), 90).asnumpy()
+    assert r.shape == (3, 8, 8)
+    assert r[:, 0, :].sum() < r.sum()        # moved off the top row
+    # NCHW batch
+    out = I.imrotate(mx.nd.array(chw[None]), 45)
+    assert out.shape == (1, 3, 8, 8)
+    with pytest.raises(ValueError):
+        I.imrotate(mx.nd.array(chw), 30, zoom_in=True, zoom_out=True)
+    # zoom variants run and preserve shape
+    assert I.imrotate(mx.nd.array(chw), 30, zoom_in=True).shape == (3, 8, 8)
+    assert I.imrotate(mx.nd.array(chw), 30, zoom_out=True).shape == (3, 8, 8)
+    # replicate border + unsupported type
+    img = mx.nd.array(onp.ones((4, 4, 3), "f"))
+    b = I.copyMakeBorder(img, 1, 1, 1, 1, type=1)
+    assert b.shape == (6, 6, 3) and float(b.asnumpy().min()) == 1.0
+    with pytest.raises(NotImplementedError):
+        I.copyMakeBorder(img, 1, 1, 1, 1, type=4)
