@@ -235,8 +235,7 @@ class RandomGray(_NpTransform):
     def _apply(self, x):
         if onp.random.uniform() >= self._p:
             return x
-        gray = (x.astype("float32") @
-                onp.array([0.299, 0.587, 0.114], "float32"))
+        gray = x.astype("float32") @ _colorspace.GRAY_COEF
         out = onp.repeat(gray[..., None], 3, axis=-1)
         return out.clip(0, 255 if x.dtype == onp.uint8 else None)             .astype(x.dtype)
 

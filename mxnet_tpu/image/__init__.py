@@ -280,6 +280,12 @@ class RandomOrderAug(Augmenter):
         super().__init__()
         self.ts = list(ts)
 
+    def dumps(self):
+        """Serialize self plus children (upstream RandomOrderAug.dumps)."""
+        import json
+        return json.dumps([self.__class__.__name__,
+                           [json.loads(t.dumps()) for t in self.ts]])
+
     def __call__(self, src):
         for i in onp.random.permutation(len(self.ts)):
             src = self.ts[i](src)
@@ -305,7 +311,9 @@ class LightingAug(Augmenter):
     """PCA-based lighting noise (AlexNet-style; parity: image.LightingAug)."""
 
     def __init__(self, alphastd, eigval, eigvec):
-        super().__init__(alphastd=alphastd)
+        super().__init__(alphastd=alphastd,
+                         eigval=onp.asarray(eigval).tolist(),
+                         eigvec=onp.asarray(eigvec).tolist())
         self.alphastd = alphastd
         self.eigval = onp.asarray(eigval, onp.float32)
         self.eigvec = onp.asarray(eigvec, onp.float32)
@@ -317,7 +325,7 @@ class LightingAug(Augmenter):
 
 
 class RandomGrayAug(Augmenter):
-    _coef = _colorspace.GRAY_COEF
+    _coef = _colorspace.GRAY_COEF_IMAGE   # upstream image.py matrix
 
     def __init__(self, p):
         super().__init__(p=p)

@@ -1566,3 +1566,233 @@ def UpSampling(data, scale=2, sample_type="nearest", **kw):
         return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
 
     return invoke("UpSampling", f, [data])
+
+
+@_export
+def add_n(*args, **kw):
+    """Sum a list of arrays (parity: elemwise_sum/add_n)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    nds = [_as_nd(a) for a in args]
+    return invoke("add_n", lambda *xs: functools.reduce(jnp.add, xs), nds)
+
+
+@_export
+def diag(data, k=0, axis1=0, axis2=1):
+    """Parity: mx.nd.diag — extract diagonals (>=2-D) or build a diagonal
+    matrix (1-D)."""
+    data = _as_nd(data)
+
+    def f(x):
+        if x.ndim == 1:
+            return jnp.diag(x, k=k)
+        return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+    return invoke("diag", f, [data])
+
+
+@_export
+def unravel_index(data, shape):
+    data = _as_nd(data)
+    return invoke(
+        "unravel_index",
+        lambda i: jnp.stack(jnp.unravel_index(i.astype(jnp.int64),
+                                              tuple(shape))),
+        [data], differentiable=False)
+
+
+@_export
+def ravel_multi_index(data, shape):
+    data = _as_nd(data)
+
+    def f(m):
+        idx = tuple(m[i].astype(jnp.int64) for i in range(m.shape[0]))
+        return jnp.ravel_multi_index(idx, tuple(shape), mode="clip")
+
+    return invoke("ravel_multi_index", f, [data], differentiable=False)
+
+
+@_export
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    data = _as_nd(data)
+    return invoke("hard_sigmoid",
+                  lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), [data])
+
+
+@_export
+def relu6(data):
+    data = _as_nd(data)
+    return invoke("relu6", lambda x: jnp.clip(x, 0.0, 6.0), [data])
+
+
+@_export
+def selu(data):
+    data = _as_nd(data)
+    return invoke("selu", jax.nn.selu, [data])
+
+
+@_export
+def gelu(data):
+    data = _as_nd(data)
+    return invoke("gelu",
+                  functools.partial(jax.nn.gelu, approximate=False), [data])
+
+
+@_export
+def prelu(data, gamma):
+    data, gamma = _as_nd(data), _as_nd(gamma)
+
+    def f(x, g):
+        gshape = [1] * x.ndim
+        if x.ndim > 1:
+            gshape[1] = -1
+        return jnp.where(x >= 0, x, x * g.reshape(gshape))
+
+    return invoke("prelu", f, [data, gamma])
+
+
+random_negative_binomial = _sample_op(
+    "random_negative_binomial",
+    lambda key, shape, dt, k=1, p=1.0, **kw:
+    jax.random.poisson(
+        jax.random.fold_in(key, 1),
+        jax.random.gamma(key, k, shape) * (1 - p) / builtins.max(p, 1e-12),
+        shape).astype(dt))
+random_generalized_negative_binomial = _sample_op(
+    "random_generalized_negative_binomial",
+    lambda key, shape, dt, mu=1.0, alpha=1.0, **kw:
+    jax.random.poisson(
+        jax.random.fold_in(key, 1),
+        jax.random.gamma(key, 1.0 / builtins.max(alpha, 1e-12), shape)
+        * (alpha * mu), shape).astype(dt))
+
+
+def _param_sample_op(name, sampler):
+    """Per-distribution sampling: parameter ARRAYS, one draw-set per row
+    (parity: sample_uniform/sample_normal...)."""
+    def op(*params, shape=(), dtype="float32", ctx=None, **kw):
+        nds = [_as_nd(p) for p in params]
+        dt = jnp.dtype(_base.canonical_dtype(dtype))
+        sample_shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        key = _random.next_key(nds[0].context if nds else current_context())
+
+        def f(*ps):
+            full = ps[0].shape + sample_shape
+            broad = [p.reshape(p.shape + (1,) * len(sample_shape))
+                     for p in ps]
+            return sampler(key, full, dt, *broad)
+
+        return invoke(name, f, nds, differentiable=False)
+    op.__name__ = name
+    return _export(op)
+
+
+sample_uniform = _param_sample_op(
+    "sample_uniform",
+    lambda key, full, dt, low, high:
+    low + (high - low) * jax.random.uniform(key, full, dtype=dt))
+sample_normal = _param_sample_op(
+    "sample_normal",
+    lambda key, full, dt, mu, sigma:
+    mu + sigma * jax.random.normal(key, full, dtype=dt))
+sample_gamma = _param_sample_op(
+    "sample_gamma",
+    lambda key, full, dt, alpha, beta:
+    beta * jax.random.gamma(key, alpha, full, dtype=dt))
+sample_exponential = _param_sample_op(
+    "sample_exponential",
+    lambda key, full, dt, lam:
+    jax.random.exponential(key, full, dtype=dt) / lam)
+sample_poisson = _param_sample_op(
+    "sample_poisson",
+    lambda key, full, dt, lam:
+    jax.random.poisson(key, jnp.broadcast_to(lam, full), full).astype(dt))
+
+
+@_export
+def make_loss(data, **kw):
+    return MakeLoss(data, **kw)
+
+
+@_export
+def ROIPooling(data, rois, pooled_size, spatial_scale, **kw):
+    """Parity: src/operator/roi_pooling.cc — max-pool each ROI into a
+    fixed (ph, pw) grid.  rois are (R, 5): [batch_idx, x1, y1, x2, y2]
+    in image coords.  Upstream bin edges are floor/ceil of fractional
+    boundaries (bins can OVERLAP by one pixel) and coordinate rounding is
+    half-away-from-zero; each pixel scatter-maxes into its candidate bin
+    and the lower neighbor — one pass over the feature map per ROI
+    instead of a masked max per bin."""
+    data, rois = _as_nd(data), _as_nd(rois)
+    ph, pw = pooled_size
+
+    def f(x, r):
+        n, c, h, w = x.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def one(roi):
+            b = roi[0].astype(jnp.int32)
+            # C++ round: half away from zero (coords are non-negative)
+            x1 = jnp.floor(roi[1] * spatial_scale + 0.5)
+            y1 = jnp.floor(roi[2] * spatial_scale + 0.5)
+            x2 = jnp.floor(roi[3] * spatial_scale + 0.5)
+            y2 = jnp.floor(roi[4] * spatial_scale + 0.5)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            fm = x[b].reshape(c, h * w)               # (C, H*W)
+
+            def axis_bins(coords, lo, extent, nbins):
+                """primary bin of each coordinate + in-roi mask"""
+                j = jnp.floor((coords - lo) * nbins / extent)
+                inside = (coords >= lo) & (coords <= lo + extent - 1.0)
+                return j, inside
+
+            jy, in_y = axis_bins(ys, y1, rh, ph)
+            jx, in_x = axis_bins(xs, x1, rw, pw)
+
+            def bin_valid(j, coords, lo, extent, nbins):
+                """floor/ceil edge test: is coord inside bin j?"""
+                sy = jnp.floor(lo + j * extent / nbins)
+                ey = jnp.ceil(lo + (j + 1) * extent / nbins)
+                return (j >= 0) & (j < nbins) & (coords >= sy) & (coords < ey)
+
+            out = jnp.full((c, ph * pw + 1), -jnp.inf)
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    cy = jy - dy                       # candidate bins
+                    cx = jx - dx
+                    vy = in_y & bin_valid(cy, ys, y1, rh, ph)
+                    vx = in_x & bin_valid(cx, xs, x1, rw, pw)
+                    valid = vy[:, None] & vx[None, :]
+                    flat = (cy[:, None] * pw + cx[None, :])
+                    flat = jnp.where(valid, flat, ph * pw)  # dump bin
+                    out = out.at[:, flat.reshape(-1).astype(jnp.int32)]                         .max(fm)
+            out = out[:, :ph * pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0)                 .reshape(c, ph, pw)
+
+        return jax.vmap(one)(r)
+
+    return invoke("ROIPooling", f, [data, rois])
+
+
+@_export
+def Crop(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):
+    """Parity: mx.nd.Crop (v1 symbol era) — crop data (N,C,H,W) to the
+    spatial size of `like` (second input) or to `h_w`, at `offset` or
+    centered."""
+    data = _as_nd(data)
+    nds = [data]
+    if like:
+        nds.append(_as_nd(like[0]))
+
+    def f(x, *rest):
+        th, tw = (rest[0].shape[2], rest[0].shape[3]) if rest else h_w
+        if center_crop:
+            y0 = (x.shape[2] - th) // 2
+            x0 = (x.shape[3] - tw) // 2
+        else:
+            y0, x0 = offset
+        return x[:, :, y0:y0 + th, x0:x0 + tw]
+
+    return invoke("Crop", f, nds)

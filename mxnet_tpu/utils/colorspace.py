@@ -10,8 +10,11 @@ T_RGB = onp.array([[1.0, 0.956, 0.621],
                    [1.0, -0.272, -0.647],
                    [1.0, -1.107, 1.705]], onp.float32)
 
-# ITU-R BT.601 luma coefficients
+# ITU-R BT.601 luma coefficients (gluon transforms / torchvision-style)
 GRAY_COEF = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+# upstream mx.image.RandomGrayAug uses the BT.709-ish 0.21/0.72/0.07 mix
+GRAY_COEF_IMAGE = onp.array([0.21, 0.72, 0.07], onp.float32)
 
 # ImageNet PCA lighting (AlexNet; upstream CreateAugmenter defaults)
 IMAGENET_PCA_EIGVAL = onp.array([55.46, 4.794, 1.148], onp.float32)

@@ -214,3 +214,49 @@ def test_transformer_contrib_ops():
     att = nd.softmax(scores, axis=-1)
     out = nd.interleaved_matmul_selfatt_valatt(nd.array(qkv), att, heads=H)
     assert out.shape == (T, B, E)
+
+
+def test_parity_edge_ops():
+    """add_n/diag/unravel/ravel/activations/prelu/Crop/make_loss parity."""
+    assert nd.add_n([nd.ones((2,)), nd.ones((2,))]).asnumpy().tolist() \
+        == [2.0, 2.0]
+    onp.testing.assert_allclose(
+        nd.diag(nd.array([1.0, 2.0])).asnumpy(), onp.diag([1.0, 2.0]))
+    m = onp.arange(6, dtype="f").reshape(2, 3)
+    onp.testing.assert_allclose(nd.diag(nd.array(m), k=1).asnumpy(),
+                                onp.diag(m, k=1))
+    u = nd.unravel_index(nd.array([5, 1], dtype="int32"), (2, 3)).asnumpy()
+    onp.testing.assert_array_equal(u, onp.stack(
+        onp.unravel_index([5, 1], (2, 3))))
+    assert float(nd.relu6(nd.array([-1.0])).asscalar()) == 0.0
+    assert float(nd.hard_sigmoid(nd.array([10.0])).asscalar()) == 1.0
+    # prelu broadcasts gamma over channel dim 1
+    x = nd.array(onp.full((1, 2), -4.0, "f"))
+    onp.testing.assert_allclose(
+        nd.prelu(x, nd.array([0.5, 0.25])).asnumpy(), [[-2.0, -1.0]])
+    y = nd.Crop(nd.array(onp.arange(16, dtype="f").reshape(1, 1, 4, 4)),
+                offset=(1, 1), h_w=(2, 2))
+    onp.testing.assert_allclose(y.asnumpy().reshape(2, 2),
+                                [[5.0, 6.0], [9.0, 10.0]])
+
+
+def test_roi_pooling_matches_manual():
+    x = nd.array(onp.arange(16, dtype="f").reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3], [0, 2, 2, 3, 3]], dtype="float32")
+    out = nd.ROIPooling(x, rois, (2, 2), 1.0).asnumpy()
+    onp.testing.assert_allclose(out[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+    onp.testing.assert_allclose(out[1, 0], [[10.0, 11.0], [14.0, 15.0]])
+
+
+def test_param_array_samplers():
+    mx.random.seed(5)
+    s = nd.sample_uniform(nd.array([0.0, 100.0]), nd.array([1.0, 200.0]),
+                          shape=64)
+    a = s.asnumpy()
+    assert a.shape == (2, 64)
+    assert a[0].max() <= 1.0 and a[1].min() >= 100.0
+    g = nd.sample_gamma(nd.array([2.0]), nd.array([3.0]), shape=512)
+    assert 4.0 < g.asnumpy().mean() < 8.0       # mean = alpha*beta = 6
+    mx.random.seed(7)
+    nb = nd.random_negative_binomial(k=4, p=0.5, shape=(2000,))
+    assert 3.0 < float(nb.mean().asscalar()) < 5.0
