@@ -1718,11 +1718,12 @@ def make_loss(data, **kw):
 def ROIPooling(data, rois, pooled_size, spatial_scale, **kw):
     """Parity: src/operator/roi_pooling.cc — max-pool each ROI into a
     fixed (ph, pw) grid.  rois are (R, 5): [batch_idx, x1, y1, x2, y2]
-    in image coords.  Upstream bin edges are floor/ceil of fractional
-    boundaries (bins can OVERLAP by one pixel) and coordinate rounding is
-    half-away-from-zero; each pixel scatter-maxes into its candidate bin
-    and the lower neighbor — one pass over the feature map per ROI
-    instead of a masked max per bin."""
+    in image coords.  Coordinate rounding is half-away-from-zero and bin
+    edges are floor/ceil of fractional boundaries (bins may overlap, and
+    a narrow ROI can contribute one pixel to MANY bins).  A rectangle
+    max is separable, so each ROI costs O((ph+pw)*C*H*W): ph masked row
+    reductions then pw masked column reductions — exact for every
+    overlap case."""
     data, rois = _as_nd(data), _as_nd(rois)
     ph, pw = pooled_size
 
@@ -1740,36 +1741,26 @@ def ROIPooling(data, rois, pooled_size, spatial_scale, **kw):
             y2 = jnp.floor(roi[4] * spatial_scale + 0.5)
             rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
             rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
-            fm = x[b].reshape(c, h * w)               # (C, H*W)
+            fm = x[b]                                  # (C, H, W)
 
-            def axis_bins(coords, lo, extent, nbins):
-                """primary bin of each coordinate + in-roi mask"""
-                j = jnp.floor((coords - lo) * nbins / extent)
-                inside = (coords >= lo) & (coords <= lo + extent - 1.0)
-                return j, inside
+            row_maxes = []
+            for i in range(ph):
+                sy = jnp.floor(y1 + i * rh / ph)
+                ey = jnp.ceil(y1 + (i + 1) * rh / ph)
+                m = (ys >= sy) & (ys < ey)
+                row_maxes.append(
+                    jnp.where(m[None, :, None], fm, -jnp.inf).max(axis=1))
+            rowm = jnp.stack(row_maxes, axis=1)        # (C, ph, W)
 
-            jy, in_y = axis_bins(ys, y1, rh, ph)
-            jx, in_x = axis_bins(xs, x1, rw, pw)
-
-            def bin_valid(j, coords, lo, extent, nbins):
-                """floor/ceil edge test: is coord inside bin j?"""
-                sy = jnp.floor(lo + j * extent / nbins)
-                ey = jnp.ceil(lo + (j + 1) * extent / nbins)
-                return (j >= 0) & (j < nbins) & (coords >= sy) & (coords < ey)
-
-            out = jnp.full((c, ph * pw + 1), -jnp.inf)
-            for dy in (0, 1):
-                for dx in (0, 1):
-                    cy = jy - dy                       # candidate bins
-                    cx = jx - dx
-                    vy = in_y & bin_valid(cy, ys, y1, rh, ph)
-                    vx = in_x & bin_valid(cx, xs, x1, rw, pw)
-                    valid = vy[:, None] & vx[None, :]
-                    flat = (cy[:, None] * pw + cx[None, :])
-                    flat = jnp.where(valid, flat, ph * pw)  # dump bin
-                    out = out.at[:, flat.reshape(-1).astype(jnp.int32)]                         .max(fm)
-            out = out[:, :ph * pw]
-            return jnp.where(jnp.isfinite(out), out, 0.0)                 .reshape(c, ph, pw)
+            col_maxes = []
+            for j in range(pw):
+                sx = jnp.floor(x1 + j * rw / pw)
+                ex = jnp.ceil(x1 + (j + 1) * rw / pw)
+                m = (xs >= sx) & (xs < ex)
+                col_maxes.append(
+                    jnp.where(m[None, None, :], rowm, -jnp.inf).max(axis=2))
+            out = jnp.stack(col_maxes, axis=2)         # (C, ph, pw)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
 
         return jax.vmap(one)(r)
 

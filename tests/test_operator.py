@@ -260,3 +260,48 @@ def test_param_array_samplers():
     mx.random.seed(7)
     nb = nd.random_negative_binomial(k=4, p=0.5, shape=(2000,))
     assert 3.0 < float(nb.mean().asscalar()) < 5.0
+
+
+def test_roi_pooling_matches_bruteforce_reference():
+    """Randomized check against a direct implementation of
+    roi_pooling.cc's floor/ceil bin semantics — covers fractional and
+    overlapping bins and ROIs narrower than the pooled grid."""
+    def ref(x, rois, pooled, scale):
+        ph, pw = pooled
+        out = onp.zeros((len(rois), x.shape[1], ph, pw), "f")
+        for ri, roi in enumerate(rois):
+            b = int(roi[0])
+            x1 = onp.floor(roi[1] * scale + 0.5)
+            y1 = onp.floor(roi[2] * scale + 0.5)
+            x2 = onp.floor(roi[3] * scale + 0.5)
+            y2 = onp.floor(roi[4] * scale + 0.5)
+            rw = max(x2 - x1 + 1.0, 1.0)
+            rh = max(y2 - y1 + 1.0, 1.0)
+            for i in range(ph):
+                for j in range(pw):
+                    sy = int(onp.floor(y1 + i * rh / ph))
+                    ey = int(onp.ceil(y1 + (i + 1) * rh / ph))
+                    sx = int(onp.floor(x1 + j * rw / pw))
+                    ex = int(onp.ceil(x1 + (j + 1) * rw / pw))
+                    sy, ey = max(sy, 0), min(ey, x.shape[2])
+                    sx, ex = max(sx, 0), min(ex, x.shape[3])
+                    if ey > sy and ex > sx:
+                        out[ri, :, i, j] = \
+                            x[b, :, sy:ey, sx:ex].max(axis=(1, 2))
+        return out
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 3, 9, 11).astype("f")
+    rois = []
+    for _ in range(20):
+        b = rng.randint(0, 2)
+        x1, y1 = rng.uniform(0, 8, 2)
+        rois.append([b, x1, y1, x1 + rng.uniform(0, 12),
+                     y1 + rng.uniform(0, 10)])
+    rois = onp.array(rois, "f")
+    for pooled, scale in (((3, 3), 1.0), ((2, 4), 0.5), ((3, 1), 1 / 16)):
+        got = nd.ROIPooling(nd.array(x), nd.array(rois), pooled,
+                            scale).asnumpy()
+        onp.testing.assert_allclose(got, ref(x, rois, pooled, scale),
+                                    rtol=1e-5, atol=1e-6,
+                                    err_msg=f"{pooled} {scale}")
