@@ -20,6 +20,7 @@ import argparse
 import json
 import sys
 import time
+from builtins import max as builtins_max
 
 import numpy as onp
 
@@ -163,8 +164,6 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models.vision import get_resnet
 
-    ce_loss = _ce_loss
-
     if on_tpu:
         # batch 128: the MXU wants large convs — 64 measured ~10% MFU on
         # v5e; bigger per-chip batch is the first lever (tools/tpu_tune.py
@@ -181,7 +180,7 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
     batch = _fit_batch(batch_override or batch, mesh)
     with par.use_mesh(mesh):
         trainer = par.ShardedTrainer(
-            net, "sgd", loss=ce_loss,
+            net, "sgd", loss=_ce_loss,
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
             mesh=mesh)
         imgs = mx.nd.array(
@@ -212,8 +211,6 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
     from mxnet_tpu.models.vision import get_resnet
     from mxnet_tpu.recordio import IRHeader, MXRecordIO, pack_img
 
-    ce_loss = _ce_loss
-
     if on_tpu:
         batch, steps, warmup, size, n_img = 128, 20, 3, 224, 512
         net = get_resnet(1, 50, classes=1000)
@@ -225,6 +222,9 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
     net.initialize()
     mesh = par.make_mesh()
     batch = _fit_batch(batch_override or batch, mesh)
+    # the pipeline must be able to fill every batch (an empty epoch would
+    # loop forever in stream())
+    n_img = builtins_max(n_img, batch * 2)
 
     with tempfile.TemporaryDirectory() as tmp:
         rec = os.path.join(tmp, "bench.rec")
@@ -237,12 +237,11 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
         wr.close()
         it = mx.io.ImageRecordIter(
             path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-            shuffle=True, rand_crop=True, rand_mirror=True,
-            round_batch=True)
+            shuffle=True, rand_crop=True, rand_mirror=True)
 
         with par.use_mesh(mesh):
             trainer = par.ShardedTrainer(
-                net, "sgd", loss=ce_loss,
+                net, "sgd", loss=_ce_loss,
                 optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
                 mesh=mesh)
 

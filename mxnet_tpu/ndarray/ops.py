@@ -1596,7 +1596,7 @@ def unravel_index(data, shape):
     data = _as_nd(data)
     return invoke(
         "unravel_index",
-        lambda i: jnp.stack(jnp.unravel_index(i.astype(jnp.int64),
+        lambda i: jnp.stack(jnp.unravel_index(i.astype(jnp.int32),
                                               tuple(shape))),
         [data], differentiable=False)
 
@@ -1606,7 +1606,7 @@ def ravel_multi_index(data, shape):
     data = _as_nd(data)
 
     def f(m):
-        idx = tuple(m[i].astype(jnp.int64) for i in range(m.shape[0]))
+        idx = tuple(m[i].astype(jnp.int32) for i in range(m.shape[0]))
         return jnp.ravel_multi_index(idx, tuple(shape), mode="clip")
 
     return invoke("ravel_multi_index", f, [data], differentiable=False)

@@ -223,7 +223,8 @@ def test_multipart_native_writer_and_scan(tmp_path):
     for r in recs:
         wr.write(r)
     wr.close()
-    assert open(pn, "rb").read() == open(pp, "rb").read()
+    with open(pn, "rb") as fa, open(pp, "rb") as fb:
+        assert fa.read() == fb.read()
     # python reader reassembles the native file
     rd = MXRecordIO(pn, "r")
     got = []

@@ -81,7 +81,8 @@ def test_onnx_file_structure(tmp_path):
     net(x)
     p = str(tmp_path / "m.onnx")
     mx.onnx.export_model(net, p, (1, 4))
-    m = proto.parse_model(open(p, "rb").read())
+    with open(p, "rb") as f:
+        m = proto.parse_model(f.read())
     g = m["graph"]
     assert m["opset"] == 13
     assert g["inputs"][0][0] == "data"

@@ -26,7 +26,8 @@ def test_im2rec_list_and_pack_roundtrip(tmp_path):
             Image.fromarray(arr).save(root / cls / f"{i}.jpg")
     prefix = str(tmp_path / "data")
     im2rec.make_list(prefix, str(root))
-    lines = open(prefix + ".lst").read().strip().splitlines()
+    with open(prefix + ".lst") as f:
+        lines = f.read().strip().splitlines()
     assert len(lines) == 6
     im2rec.pack(prefix, str(root))
     assert os.path.exists(prefix + ".rec")
@@ -70,7 +71,8 @@ def test_launch_local_spawns_workers(tmp_path):
                                 env_extra={"OUT": str(out)})
     assert codes == [0, 0, 0]
     for r in range(3):
-        assert open(str(out) + str(r)).read() == "3"
+        with open(str(out) + str(r)) as f:
+            assert f.read() == "3"
 
 
 def test_opperf_runs_and_reports():

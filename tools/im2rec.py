@@ -63,8 +63,8 @@ def pack(prefix, root, resize=0, quality=95, color=1):
     for idx, labels, rel in read_list(prefix + ".lst"):
         p = os.path.join(root, rel)
         try:
-            img = Image.open(p)
-            img = img.convert("RGB" if color else "L")
+            with Image.open(p) as img_f:
+                img = img_f.convert("RGB" if color else "L")
             if resize:
                 w, h = img.size
                 s = resize / min(w, h)
