@@ -20,7 +20,6 @@ import argparse
 import json
 import sys
 import time
-from builtins import max as builtins_max
 
 import numpy as onp
 
@@ -224,7 +223,7 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
     batch = _fit_batch(batch_override or batch, mesh)
     # the pipeline must be able to fill every batch (an empty epoch would
     # loop forever in stream())
-    n_img = builtins_max(n_img, batch * 2)
+    n_img = max(n_img, batch * 2)
 
     with tempfile.TemporaryDirectory() as tmp:
         rec = os.path.join(tmp, "bench.rec")

@@ -1,3 +1,4 @@
 from . import transforms
 from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
-                       ImageFolderDataset, ImageRecordDataset)
+                       ImageFolderDataset, ImageRecordDataset,
+                       ImageListDataset)

@@ -16,7 +16,7 @@ import numpy as onp
 
 from ..dataset import ArrayDataset, Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+__all__ = ["ImageListDataset", "MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
            "ImageFolderDataset", "ImageRecordDataset"]
 
 
@@ -209,6 +209,50 @@ class ImageRecordDataset(Dataset):
         record = self._record[idx]
         header, img = unpack_img(record)
         label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """Dataset over an im2rec-style .lst file, or an in-memory list whose
+    entries are (label..., path) — the mx.image.ImageIter imglist order —
+    rooted at ``root`` (parity: vision.ImageListDataset)."""
+
+    def __init__(self, root=".", imglist=None, flag=1, transform=None):
+        from .... import image as _image
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parsed = _image.parse_lst_line(line)
+                    if parsed is None:
+                        continue
+                    path, label = parsed
+                    self.items.append(
+                        (os.path.join(self._root, path), label))
+        elif imglist is not None:
+            for entry in imglist:
+                # (label..., path): path LAST, like ImageIter's imglist
+                path = entry[-1]
+                labels = list(entry[:-1])
+                label = labels[0] if len(labels) == 1 else labels
+                self.items.append((os.path.join(self._root, path), label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        import numpy as _np
+
+        from .... import image as _image
+        path, label = self.items[idx]
+        img = _image.imread(path, self._flag)
+        label = _np.float32(label) if not isinstance(label, list) \
+            else _np.asarray(label, _np.float32)
         if self._transform is not None:
             return self._transform(img, label)
         return img, label

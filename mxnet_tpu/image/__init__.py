@@ -22,7 +22,19 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "CreateAugmenter", "Augmenter", "ImageIter",
            "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
            "HueJitterAug", "ColorJitterAug", "LightingAug", "RandomGrayAug",
-           "RandomOrderAug", "imrotate", "copyMakeBorder", "scale_down"]
+           "RandomOrderAug", "imrotate", "copyMakeBorder", "scale_down",
+           "parse_lst_line"]
+
+
+def parse_lst_line(line):
+    """Parse one im2rec .lst line 'idx\tlabel...\tpath' →
+    (path, label-or-list) or None for malformed lines (single source for
+    ImageIter / ImageListDataset / tools)."""
+    parts = line.strip().split("\t")
+    if len(parts) < 3:
+        return None
+    labels = [float(x) for x in parts[1:-1]]
+    return parts[-1], (labels[0] if len(labels) == 1 else labels)
 
 
 def _to_pil(img):

@@ -205,3 +205,27 @@ def test_imrotate_chw_contract_and_zoom():
     assert b.shape == (6, 6, 3) and float(b.asnumpy().min()) == 1.0
     with pytest.raises(NotImplementedError):
         I.copyMakeBorder(img, 1, 1, 1, 1, type=4)
+
+
+def test_image_list_dataset(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+
+    for i in range(4):
+        Image.fromarray((onp.random.rand(8, 8, 3) * 255).astype(
+            "uint8")).save(str(tmp_path / f"i{i}.png"))
+    lst = tmp_path / "d.lst"
+    lst.write_text("".join(f"{i}\t{float(i % 2)}\ti{i}.png\n"
+                           for i in range(4)))
+    ds = ImageListDataset(root=str(tmp_path), imglist=str(lst))
+    assert len(ds) == 4
+    img, lab = ds[3]
+    assert img.shape == (8, 8, 3) and lab == 1.0
+    # in-memory entries are (label..., path) — the ImageIter order
+    ds2 = ImageListDataset(root=str(tmp_path),
+                           imglist=[(0.0, "i0.png"), (5.0, "i2.png")])
+    assert len(ds2) == 2 and ds2[1][1] == 5.0
+    # transform receives (img, label) like the sibling datasets
+    ds3 = ImageListDataset(root=str(tmp_path), imglist=str(lst),
+                           transform=lambda im, lb: (im, lb + 1))
+    assert ds3[0][1] == 1.0
