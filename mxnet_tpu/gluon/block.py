@@ -340,7 +340,8 @@ class HybridBlock(Block):
         param_vals = [p.data().jax for _, p in param_items]
         _, unflatten = _flatten_in(self._export_args)
         pure = cop._make_pure(unflatten, False, len(param_vals),
-                              len(in_avals), param_items, None)
+                              len(in_avals), param_items, None,
+                              collect_aux=False)
         key = _random.next_key()
 
         def infer_fn(*flat):

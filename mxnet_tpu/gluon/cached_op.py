@@ -31,6 +31,9 @@ from ..ndarray import NDArray
 from ..ndarray.ndarray import swap_values
 
 
+_WARNED_FOREIGN_TRACE = False
+
+
 class CachedOp:
     def __init__(self, block, flags=None):
         self.block = block
@@ -51,9 +54,13 @@ class CachedOp:
         return items
 
     def _make_pure(self, structure, train: bool, n_params: int, n_inputs: int,
-                   param_objs, mutated_slots):
+                   param_objs, mutated_slots, collect_aux: bool = True):
         """Build the pure traced function.  `mutated_slots` is discovered on
-        the first trace (param indices rebound during forward)."""
+        the first trace (param indices rebound during forward).
+        `collect_aux=False` (export) still opens the aux-collection scope —
+        so layers record without warning — but discards the losses instead
+        of emitting extra outputs, keeping the exported graph's signature
+        exactly the declared out_tree."""
         block = self.block
         unflatten = structure
 
@@ -65,12 +72,32 @@ class CachedOp:
                 nds = [p._data for _, p in param_objs]
                 with swap_values(nds, param_vals) as saved:
                     args = unflatten(input_vals)
-                    with _base.training_mode(train):
-                        rec = _base.set_recording(False)
-                        try:
-                            out = block.forward(*args)
-                        finally:
-                            _base.set_recording(rec)
+                    # functionalized ambient aux losses (MoE router load
+                    # balancing): open a collection scope for the duration
+                    # of the trace and emit whatever was recorded as extra
+                    # outputs — the same pattern ShardedTrainer uses, so
+                    # hybridize() no longer drops router losses.
+                    aux_prev = _base.set_aux_collection(True)
+                    # the caller may already hold recorded aux losses in its
+                    # own record scope (imperative MoE layer earlier in the
+                    # same user step) — set them aside and restore after the
+                    # trace instead of destroying them
+                    outer_aux = _base.pop_aux_losses()
+                    try:
+                        with _base.training_mode(train):
+                            rec = _base.set_recording(False)
+                            try:
+                                out = block.forward(*args)
+                            finally:
+                                _base.set_recording(rec)
+                        drained = _base.pop_aux_losses()
+                        auxloss_vals = ([a.jax for a in drained]
+                                        if collect_aux else [])
+                    finally:
+                        _base.set_aux_collection(aux_prev)
+                        _base.pop_aux_losses()  # no tracer outlives the trace
+                        for a in outer_aux:
+                            _base.record_aux_loss(a)
                     outs, out_tree = _flatten_out(out)
                     out_vals = [o.jax for o in outs]
                     # functionalized aux-state updates: a param whose payload
@@ -85,7 +112,9 @@ class CachedOp:
                             aux_idx.append(i)
                     pure._out_tree = out_tree
                     pure._aux_idx = aux_idx
-                    return tuple(out_vals) + tuple(aux_vals)
+                    pure._n_auxloss = len(auxloss_vals)
+                    return (tuple(out_vals) + tuple(auxloss_vals)
+                            + tuple(aux_vals))
             finally:
                 _random.pop_trace_key()
 
@@ -116,9 +145,10 @@ class CachedOp:
             # prime: trace once to discover out_tree/aux_idx
             key = _random.next_key()
             _ = jax.eval_shape(pure, tuple(param_vals + input_vals), key)
-            entry = (jitted, pure._out_tree, pure._aux_idx, pure)
+            entry = (jitted, pure._out_tree, pure._aux_idx,
+                     pure._n_auxloss, pure)
             self._jit_cache[sig] = entry
-        jitted, out_tree, aux_idx, pure = entry
+        jitted, out_tree, aux_idx, n_auxloss, pure = entry
 
         key = _random.next_key()
         flat_args = tuple(param_vals + input_vals)
@@ -134,13 +164,16 @@ class CachedOp:
         else:
             out_all = jitted(flat_args, key)
 
-        n_out = len(out_all) - len(aux_idx)
-        out_vals = out_all[:n_out]
+        n_main = len(out_all) - n_auxloss - len(aux_idx)
+        n_out = n_main + n_auxloss   # tape-visible outputs
+        out_vals = out_all[:n_main]
+        auxloss_vals = out_all[n_main:n_out]
         aux_vals = out_all[n_out:]
 
         ctx = (flat_inputs[0].context if flat_inputs
                else param_objs[0][1].data().context)
         outs = [NDArray(v, ctx=ctx) for v in out_vals]
+        auxloss_nds = [NDArray(v, ctx=ctx) for v in auxloss_vals]
 
         if needs_grad:
             def _vjp_wrapper(cots, _vjp=vjp_fn, _aux=aux_vals, _n=n_out):
@@ -152,9 +185,26 @@ class CachedOp:
                 _vjp_wrapper,
                 diff_nodes, n_out, name=f"CachedOp({type(block).__name__})",
                 out_avals=[jax.ShapeDtypeStruct(v.shape, v.dtype)
-                           for v in out_vals])
-            for i, o in enumerate(outs):
+                           for v in list(out_vals) + list(auxloss_vals)])
+            for i, o in enumerate(outs + auxloss_nds):
                 o._node = OutRef(node, i)
+
+        # re-record the materialized aux losses into the ambient collector
+        # so loss functions drain them exactly as in the imperative path
+        global _WARNED_FOREIGN_TRACE
+        for a_nd, v in zip(auxloss_nds, auxloss_vals):
+            if isinstance(v, jax.core.Tracer):
+                if _base.aux_collection_active():
+                    _base.record_aux_loss(a_nd)
+                elif not _WARNED_FOREIGN_TRACE:
+                    import logging
+                    logging.warning(
+                        "aux loss from hybridized %s is dropped inside a "
+                        "foreign trace with no aux-collection scope",
+                        type(block).__name__)
+                    _WARNED_FOREIGN_TRACE = True
+            elif _base.is_recording() or _base.aux_collection_active():
+                _base.record_aux_loss(a_nd)
 
         # write back functionalized aux updates (moving stats)
         for i, v in zip(aux_idx, aux_vals):

@@ -156,9 +156,10 @@ class MoELayer(HybridBlock):
                          self.b1.data(), self.w2.data(), self.b2.data()])
         # record only when a loss will drain it within the same tape/trace:
         # eager autograd recording, or a trace whose owner opened an
-        # aux-collection scope (ShardedTrainer).  Tracers outside such a
-        # scope (e.g. a CachedOp forward whose loss runs eagerly) must NOT
-        # be recorded — they would leak out of their trace.
+        # aux-collection scope (ShardedTrainer, CachedOp — the latter
+        # functionalizes the losses as extra traced outputs).  Tracers
+        # outside such a scope must NOT be recorded — they would leak out
+        # of their trace.
         traced = isinstance(aux.jax, jax.core.Tracer)
         if traced and _base.aux_collection_active():
             _base.record_aux_loss(aux)
@@ -169,9 +170,9 @@ class MoELayer(HybridBlock):
             if not _WARNED_CACHED:
                 import logging
                 logging.warning(
-                    "MoE router aux loss is dropped under hybridize()/"
-                    "CachedOp (the loss runs outside the cached trace); "
-                    "train MoE models imperatively or with "
+                    "MoE router aux loss is dropped inside a foreign "
+                    "trace with no aux-collection scope; run the layer "
+                    "imperatively, via hybridize()/CachedOp, or under "
                     "parallel.ShardedTrainer to include it")
                 _WARNED_CACHED = True
         if self.dropout is not None:

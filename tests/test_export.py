@@ -76,6 +76,28 @@ def test_export_matches_after_reload_into_fresh_process_state(tmp_path):
                                 atol=1e-6)
 
 
+def test_export_moe_no_extra_outputs(tmp_path):
+    """Exported MoE graphs must carry exactly the declared outputs — the
+    CachedOp aux-loss functionalization is disabled under export so the
+    serialized signature matches the out_tree metadata."""
+    from jax import export as jexport
+
+    from mxnet_tpu.models import MoELayer
+    rs = onp.random.RandomState(0)
+    net = MoELayer(16, 32, num_experts=4, top_k=2)
+    net.initialize()
+    x = nd.array(rs.randn(2, 8, 16).astype("float32"))
+    ref = net(x)
+    sym_f, par_f = net.export(str(tmp_path / "moe"))
+    with open(str(tmp_path / "moe-symbol.bin"), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    assert len(exported.out_avals) == 1, \
+        f"MoE export must have 1 output, got {len(exported.out_avals)}"
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    onp.testing.assert_allclose(blk(x).asnumpy(), ref.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
 def test_cached_op_jit_cache_per_shape():
     """hybridize() compiles once per input signature and reuses it —
     static_alloc/static_shape economics (parity: CachedOp, SURVEY §2.2)."""
