@@ -87,14 +87,43 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         owner = leaf.owner()
         if owner is None or leaf.grad_req == "null":
             continue
-        if owner._grad is None:
+        from ..ndarray.sparse import RowSparseNDArray, _RowSparseCot
+        prev = owner._grad
+        if isinstance(g, _RowSparseCot):
+            # keep the gradient compact end-to-end: RowSparse buffers are
+            # updated IN PLACE (held handles stay live, exactly like the
+            # dense path's _rebind) so the optimizer's lazy row update and
+            # kvstore row_sparse paths never densify
+            if isinstance(prev, RowSparseNDArray):
+                if leaf.grad_req == "add" and prev._sp_data.shape[0]:
+                    g = g + _RowSparseCot(prev._sp_data, prev._sp_indices,
+                                          g.shape)
+                prev._sp_shape = tuple(g.shape)
+                prev._set_components(g.data, g.indices)
+            elif prev is not None:   # dense-typed buffer keeps its type
+                if leaf.grad_req == "add":
+                    prev._rebind(prev.jax + g.to_dense())
+                else:
+                    prev._rebind(jnp.asarray(g.to_dense(),
+                                             dtype=owner.jax.dtype))
+            else:
+                owner._grad = RowSparseNDArray.from_components(
+                    g.data, g.indices, g.shape, ctx=owner.context)
+            continue
+        if prev is None:
             from ..ndarray import ndarray as _nd
-            owner._grad = _nd.NDArray(jnp.zeros_like(owner.jax),
-                                      ctx=owner.context)
-        if leaf.grad_req == "add":
-            owner._grad._rebind(owner._grad.jax + g)
+            prev = owner._grad = _nd.NDArray(jnp.zeros_like(owner.jax),
+                                             ctx=owner.context)
+        if isinstance(prev, RowSparseNDArray):
+            # dense gradient into a row-sparse buffer (e.g. hybridize
+            # fallback): accumulate against its dense value, then rebind
+            # in place with every row present so held handles stay valid
+            base = prev.jax if leaf.grad_req == "add" else 0
+            prev._set_dense(jnp.asarray(base + g, dtype=owner.jax.dtype))
+        elif leaf.grad_req == "add":
+            prev._rebind(prev.jax + g)
         else:  # write
-            owner._grad._rebind(jnp.asarray(g, dtype=owner.jax.dtype))
+            prev._rebind(jnp.asarray(g, dtype=owner.jax.dtype))
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
@@ -111,11 +140,17 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         head_grads = [head_grads]
     leaf_grads = tape.backward_on(heads, head_grads)
     from ..ndarray import ndarray as _nd
+    from ..ndarray.sparse import RowSparseNDArray, _RowSparseCot
     outs = []
     for v in variables:
         node = v._node
         if isinstance(node, LeafNode) and id(node) in leaf_grads:
-            outs.append(_nd.NDArray(leaf_grads[id(node)][1], ctx=v.context))
+            g = leaf_grads[id(node)][1]
+            if isinstance(g, _RowSparseCot):
+                outs.append(RowSparseNDArray.from_components(
+                    g.data, g.indices, g.shape, ctx=v.context))
+            else:
+                outs.append(_nd.NDArray(g, ctx=v.context))
         else:
             outs.append(_nd.NDArray(jnp.zeros_like(v.jax), ctx=v.context))
     return outs

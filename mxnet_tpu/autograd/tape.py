@@ -143,6 +143,15 @@ def backward_on(heads, head_grads=None):
 def _acc(store, leaf: LeafNode, g):
     key = id(leaf)
     if key in store:
-        store[key] = (leaf, store[key][1] + g)
+        store[key] = (leaf, _cot_add(store[key][1], g))
     else:
         store[key] = (leaf, g)
+
+
+def _cot_add(a, b):
+    """Accumulate two cotangents; row-sparse compact cots (duck-typed via
+    `.to_dense`) drive the addition so dense + sparse never hits the jax
+    array's __add__ with a foreign type."""
+    if hasattr(b, "to_dense") and not hasattr(a, "to_dense"):
+        return b + a     # _RowSparseCot.__add__ densifies as needed
+    return a + b

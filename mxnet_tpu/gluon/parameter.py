@@ -34,6 +34,8 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self.grad_req = grad_req if differentiable else "null"
+        self.stype = stype
+        self.grad_stype = grad_stype
         self._data: Optional[NDArray] = None
         self._deferred_init = None  # (initializer, ctx)
         self._sharding = None       # jax.sharding.Sharding once mesh-placed
@@ -112,7 +114,8 @@ class Parameter:
     def _attach_grad(self):
         if self._data is None:
             return
-        self._data.attach_grad(grad_req=self.grad_req)
+        self._data.attach_grad(grad_req=self.grad_req,
+                               stype=self.grad_stype)
 
     # -- access ------------------------------------------------------------
     def data(self, ctx=None) -> NDArray:
@@ -158,7 +161,14 @@ class Parameter:
     def zero_grad(self):
         d = self._data
         if d is not None and d.grad is not None:
-            d.grad._rebind(jnp.zeros_like(d.grad.jax))
+            from ..ndarray.sparse import RowSparseNDArray
+            if isinstance(d.grad, RowSparseNDArray):
+                d.grad._set_components(
+                    jnp.zeros((0,) + tuple(d.grad._sp_shape[1:]),
+                              d.grad._sp_data.dtype),
+                    jnp.zeros((0,), jnp.int32))
+            else:
+                d.grad._rebind(jnp.zeros_like(d.grad.jax))
 
     def reset_ctx(self, ctx):
         if self._data is not None:

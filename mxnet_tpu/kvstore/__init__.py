@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 
 from .. import base as _base
 from ..ndarray import NDArray
@@ -136,12 +137,31 @@ class KVStore(KVStoreBase):
         return q
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray, _RowSparseCot
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
             vals = v if isinstance(v, list) else [v]
-            agg = self._reduce(k, vals)
             if k not in self._store:
                 raise _base.MXNetError(f"key {k} not initialized")
+            if self._updater is not None and not self._compression and \
+                    all(isinstance(x, RowSparseNDArray) for x in vals):
+                # keep row-sparse grads compact into the updater's lazy
+                # row-wise path (parity: kvstore_local's sparse push)
+                if len(vals) == 1:
+                    agg_rs = vals[0]
+                else:
+                    cot = _RowSparseCot(vals[0]._sp_data,
+                                        vals[0]._sp_indices,
+                                        vals[0]._sp_shape)
+                    for x in vals[1:]:
+                        cot = cot + _RowSparseCot(x._sp_data, x._sp_indices,
+                                                  x._sp_shape)
+                    agg_rs = RowSparseNDArray.from_components(
+                        cot.data, cot.indices, cot.shape,
+                        ctx=vals[0].context)
+                self._updater(k, agg_rs, self._store[k])
+                continue
+            agg = self._reduce(k, vals)
             if self._updater is not None:
                 # update_on_kvstore: run optimizer on aggregated grad
                 self._updater(k, NDArray(agg), self._store[k])
@@ -183,9 +203,40 @@ class KVStore(KVStoreBase):
         self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # TPU build keeps embeddings dense (gather/scatter-add shard well);
-        # honor the API by pulling the full value.
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows of a key (parity: upstream
+        KVStore::PullRowSparse over `src/kvstore/kvstore_local.cc`'s
+        unique-key gather).  `out` RowSparseNDArrays receive compact
+        (rows, indices) payloads — the full (vocab, dim) value is never
+        materialized on the pulling side.  Dense `out` (or no row_ids)
+        falls back to a full pull."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if row_ids is None:
+            self.pull(key, out=out, priority=priority)
+            return
+        keys, outs = _normalize(key, out)
+        rows_list = row_ids if isinstance(row_ids, list) else \
+            [row_ids] * len(keys)
+        for k, o, rids in zip(keys, outs, rows_list):
+            targets = o if isinstance(o, list) else [o]
+            src = self._store[k]
+            ids = rids.asnumpy() if isinstance(rids, NDArray) else \
+                onp.asarray(rids)
+            uniq = onp.unique(ids.astype("int64").reshape(-1))
+            if uniq.size and (uniq[0] < 0 or uniq[-1] >= src.shape[0]):
+                raise _base.MXNetError(
+                    f"row_sparse_pull row_ids out of range for key {k}: "
+                    f"[{uniq[0]}, {uniq[-1]}] vs {src.shape[0]} rows")
+            uniq_j = jnp.asarray(uniq, jnp.int32)
+            rows = src.jax[uniq_j]
+            for t in targets:
+                if isinstance(t, RowSparseNDArray):
+                    dev = t.context.jax_device
+                    t._sp_shape = tuple(src.shape)
+                    t._set_components(jax.device_put(rows, dev),
+                                      jax.device_put(uniq_j, dev))
+                else:
+                    t._rebind(jax.device_put(src.jax,
+                                             t.context.jax_device))
 
     # -- optimizer --------------------------------------------------------
     def set_optimizer(self, optimizer):

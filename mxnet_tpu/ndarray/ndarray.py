@@ -180,7 +180,14 @@ class NDArray:
     # ------------------------------------------------------------- autograd
     def attach_grad(self, grad_req: str = "write", stype=None):
         from ..autograd import tape
-        self._grad = NDArray(jnp.zeros_like(self.jax), ctx=self._ctx)
+        if stype == "row_sparse":
+            # compact zero: no (rows, dim) dense buffer is ever allocated
+            from .sparse import RowSparseNDArray
+            self._grad = RowSparseNDArray.from_components(
+                jnp.zeros((0,) + self.shape[1:], self.jax.dtype),
+                jnp.zeros((0,), jnp.int32), self.shape, ctx=self._ctx)
+        else:
+            self._grad = NDArray(jnp.zeros_like(self.jax), ctx=self._ctx)
         self._node = tape.LeafNode(self, grad_req)
 
     @property

@@ -953,7 +953,53 @@ def Embedding(data, weight, input_dim=None, output_dim=None,
     def f(idx, w):
         return jnp.take(w, idx.astype(jnp.int32), axis=0, mode="clip")
 
+    if sparse_grad:
+        r = _embedding_sparse_grad(data, weight, f)
+        if r is not None:
+            return r
     return invoke("Embedding", f, [data, weight])
+
+
+def _embedding_sparse_grad(data, weight, f):
+    """Eager sparse_grad=True lookup: the weight cotangent is emitted as a
+    compact RowSparse structure (unique rows + segment-sum) instead of a
+    dense scatter-add (parity: Embedding's sparse_grad path, SURVEY §2.3
+    `src/operator/tensor/indexing_op.*`).  Returns None — falling back to
+    the dense path — inside traces (whole-step vjp already yields dense
+    grads there) or when the weight is not a gradient leaf."""
+    from ..autograd.tape import LeafNode
+    if not _base.is_recording():
+        return None
+    wnode = node_of(weight)
+    if not isinstance(wnode, LeafNode):
+        return None
+    idx_val, w_val = data.jax, weight.jax
+    if isinstance(idx_val, jax.core.Tracer) or \
+            isinstance(w_val, jax.core.Tracer):
+        return None
+    out = f(idx_val, w_val)
+    res = NDArray(out, ctx=weight.context)
+    n_rows, row_shape = w_val.shape[0], w_val.shape[1:]
+    flat_idx = onp.clip(onp.asarray(idx_val).astype("int64").reshape(-1),
+                        0, n_rows - 1)
+    uniq, inv = onp.unique(flat_idx, return_inverse=True)
+    inv_j = jnp.asarray(inv, jnp.int32)
+    uniq_j = jnp.asarray(uniq, jnp.int32)
+
+    def vjp_fn(cot):
+        from .sparse import _RowSparseCot
+        rows = jax.ops.segment_sum(
+            cot.reshape((-1,) + row_shape), inv_j, num_segments=len(uniq))
+        return (None, _RowSparseCot(rows, uniq_j, w_val.shape))
+
+    node = OpNode(
+        vjp_fn, [None, wnode], 1, name="Embedding(sparse_grad)",
+        out_avals=[jax.ShapeDtypeStruct(out.shape, out.dtype)])
+    res._node = OutRef(node, 0)
+    if _invoke_hooks:
+        for h in tuple(_invoke_hooks):
+            h("Embedding", [res])
+    return res
 
 
 def _conv_dim_numbers(ndim):

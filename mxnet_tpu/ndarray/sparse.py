@@ -27,12 +27,37 @@ __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
 
 
 class BaseSparseNDArray(NDArray):
-    """Common base: dense value materialized lazily from components."""
+    """Common base: dense value materialized lazily from components.
+
+    The dense payload is built on first ``.jax`` access and cached — a
+    row-sparse gradient that only ever meets the lazy-update optimizer
+    path never allocates its (vocab, dim) dense form.
+    """
 
     __slots__ = ()
 
-    def __init__(self, dense_value, ctx=None):
-        super().__init__(dense_value, ctx=ctx)
+    def __init__(self, ctx=None):
+        super().__init__(None, ctx=ctx)
+
+    def _materialize(self):
+        raise NotImplementedError
+
+    @property
+    def jax(self):
+        if self._data is None:
+            self._data = self._materialize()
+        return self._data
+
+    # metadata must come from the components — reading .jax here would
+    # silently materialize (and cache) the full dense buffer on an
+    # incidental shape/dtype inspection
+    @property
+    def shape(self):
+        return tuple(self._sp_shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._sp_data.dtype)
 
     @property
     def stype(self):
@@ -62,9 +87,11 @@ class CSRNDArray(BaseSparseNDArray):
         self._sp_indices = jnp.asarray(indices, jnp.int32)
         self._sp_indptr = jnp.asarray(indptr, jnp.int32)
         self._sp_shape = tuple(shape)
-        dense = _csr_to_dense(self._sp_data, self._sp_indices,
-                              self._sp_indptr, self._sp_shape)
-        super().__init__(dense, ctx=ctx)
+        super().__init__(ctx=ctx)
+
+    def _materialize(self):
+        return _csr_to_dense(self._sp_data, self._sp_indices,
+                             self._sp_indptr, self._sp_shape)
 
     @property
     def stype(self):
@@ -97,9 +124,28 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._sp_data = jnp.asarray(data)
         self._sp_indices = jnp.asarray(indices, jnp.int32)
         self._sp_shape = tuple(shape)
-        dense = jnp.zeros(self._sp_shape, self._sp_data.dtype).at[
+        super().__init__(ctx=ctx)
+
+    def _materialize(self):
+        return jnp.zeros(self._sp_shape, self._sp_data.dtype).at[
             self._sp_indices].set(self._sp_data)
-        super().__init__(dense, ctx=ctx)
+
+    def _set_components(self, data, indices):
+        """Rebind the compact payload in place (used by row_sparse_pull and
+        in-place gradient writes); invalidates any cached dense
+        materialization."""
+        self._sp_data = jnp.asarray(data)
+        self._sp_indices = jnp.asarray(indices, jnp.int32)
+        self._data = None
+
+    def _set_dense(self, full):
+        """Rebind from a dense value in place (every row present) — the
+        dense-gradient-into-row-sparse-buffer fallback, keeping held
+        handles and the declared stype valid."""
+        self._sp_shape = tuple(full.shape)
+        self._sp_indices = jnp.arange(full.shape[0], dtype=jnp.int32)
+        self._sp_data = full
+        self._data = full
 
     @property
     def stype(self):
@@ -116,6 +162,61 @@ class RowSparseNDArray(BaseSparseNDArray):
     def __repr__(self):
         return (f"<RowSparseNDArray {self._sp_shape} "
                 f"rows={int(self._sp_indices.shape[0])}>")
+
+    @classmethod
+    def from_components(cls, data, indices, shape, ctx=None):
+        """Build directly from device arrays without a host round-trip
+        (the gradient-path constructor — stays compact until ``.jax``)."""
+        obj = cls.__new__(cls)
+        obj._sp_data = data if _is_jax(data) else jnp.asarray(data)
+        obj._sp_indices = (indices if _is_jax(indices)
+                           else jnp.asarray(indices, jnp.int32))
+        obj._sp_shape = tuple(shape)
+        NDArray.__init__(obj, None, ctx=ctx)
+        return obj
+
+
+def _is_jax(x):
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+class _RowSparseCot:
+    """Compact row-sparse cotangent flowing through the autograd tape
+    (parity: the RowSparse gradient stype of Embedding(sparse_grad=True),
+    SURVEY §2.3 `src/operator/tensor/indexing_op.*`).
+
+    `data` is (n_rows, ...) jax, `indices` (n_rows,) int32 with UNIQUE
+    entries, `shape` the full dense shape.  Supports `+` against both
+    other cots (compact merge) and dense arrays (densify) because the
+    tape accumulates with plain addition.
+    """
+
+    __slots__ = ("data", "indices", "shape")
+
+    def __init__(self, data, indices, shape):
+        self.data = data
+        self.indices = indices
+        self.shape = tuple(shape)
+
+    def to_dense(self):
+        return jnp.zeros(self.shape, self.data.dtype).at[
+            self.indices].add(self.data)
+
+    def __add__(self, other):
+        if isinstance(other, _RowSparseCot):
+            idx = onp.concatenate([onp.asarray(self.indices),
+                                   onp.asarray(other.indices)])
+            uniq, inv = onp.unique(idx, return_inverse=True)
+            data = jax.ops.segment_sum(
+                jnp.concatenate([self.data, other.data], axis=0),
+                jnp.asarray(inv, jnp.int32), num_segments=len(uniq))
+            return _RowSparseCot(data, jnp.asarray(uniq, jnp.int32),
+                                 self.shape)
+        if other is None or (isinstance(other, int) and other == 0):
+            return self
+        return self.to_dense() + other
+
+    __radd__ = __add__
 
 
 def _csr_to_dense(data, indices, indptr, shape):

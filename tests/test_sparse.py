@@ -120,3 +120,226 @@ def test_lazy_sparse_adam_update():
     out = w.asnumpy()
     assert onp.allclose(out[0], 1.0) and onp.allclose(out[4], 1.0)
     assert not onp.allclose(out[2], 1.0)
+
+
+# ------------------------------------------------- row-sparse gradient path
+
+def test_embedding_sparse_grad_is_row_sparse_and_compact():
+    """Embedding(sparse_grad=True) must produce a RowSparseNDArray grad
+    with unique gathered rows, without ever materializing the dense
+    (vocab, dim) buffer (parity: indexing_op.* sparse_grad path)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    V, D = 50000, 16
+    emb = nn.Embedding(V, D, sparse_grad=True)
+    emb.initialize()
+    x = nd.array(onp.array([[3, 7, 3], [9, 7, 1]]), dtype="int32")
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert g._data is None, "dense buffer must not be materialized"
+    assert onp.asarray(g._sp_indices).tolist() == [1, 3, 7, 9]
+    assert g._sp_data.shape == (4, D)
+
+    # values match the dense-path gradient on the touched rows
+    emb_d = nn.Embedding(V, D, sparse_grad=False)
+    emb_d.initialize()
+    emb_d.weight.set_data(emb.weight.data())
+    with autograd.record():
+        loss_d = (emb_d(x) ** 2).sum()
+    loss_d.backward()
+    gd = emb_d.weight.grad().asnumpy()
+    onp.testing.assert_allclose(onp.asarray(g._sp_data),
+                                gd[onp.asarray(g._sp_indices)], rtol=1e-6)
+    assert onp.abs(gd).sum() == pytest.approx(
+        onp.abs(onp.asarray(g._sp_data)).sum(), rel=1e-6)
+
+
+def test_sparse_embedding_trainer_step_matches_dense():
+    """A momentum-SGD step through the lazy row-wise update must match the
+    dense path numerically and leave untouched rows bit-identical."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    V, D = 20000, 8
+    emb_s = nn.Embedding(V, D, sparse_grad=True)
+    emb_s.initialize()
+    emb_d = nn.Embedding(V, D, sparse_grad=False)
+    emb_d.initialize()
+    emb_d.weight.set_data(emb_s.weight.data())
+    w0 = emb_s.weight.data().asnumpy().copy()
+    x = nd.array(onp.array([[11, 4999, 11, 0]]), dtype="int32")
+
+    tr_s = gluon.Trainer(emb_s.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    tr_d = gluon.Trainer(emb_d.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for _ in range(3):
+        with autograd.record():
+            l_s = (emb_s(x) ** 2).sum()
+        l_s.backward()
+        tr_s.step(1)
+        with autograd.record():
+            l_d = (emb_d(x) ** 2).sum()
+        l_d.backward()
+        tr_d.step(1)
+
+    w_s = emb_s.weight.data().asnumpy()
+    onp.testing.assert_allclose(w_s, emb_d.weight.data().asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+    untouched = onp.setdiff1d(onp.arange(V), [0, 11, 4999])[:200]
+    onp.testing.assert_array_equal(w_s[untouched], w0[untouched])
+    assert emb_s.weight.grad()._data is None, \
+        "optimizer path must not densify the row-sparse grad"
+
+
+def test_tied_embedding_lookups_accumulate_row_sparse():
+    """Two lookups of the same sparse_grad weight in one loss merge their
+    compact cotangents (union of rows), matching the dense gradient."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    V, D = 1000, 4
+    emb = nn.Embedding(V, D, sparse_grad=True)
+    emb.initialize()
+    x1 = nd.array(onp.array([1, 2]), dtype="int32")
+    x2 = nd.array(onp.array([2, 5]), dtype="int32")
+    with autograd.record():
+        loss = (emb(x1) ** 2).sum() + (3 * emb(x2)).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert onp.asarray(g._sp_indices).tolist() == [1, 2, 5]
+
+    w = emb.weight.data().asnumpy()
+    expect_r1 = 2 * w[1]
+    expect_r2 = 2 * w[2] + 3
+    expect_r5 = onp.full((D,), 3.0, "float32")
+    got = onp.asarray(g._sp_data)
+    onp.testing.assert_allclose(got[0], expect_r1, rtol=1e-6)
+    onp.testing.assert_allclose(got[1], expect_r2, rtol=1e-6)
+    onp.testing.assert_allclose(got[2], expect_r5, rtol=1e-6)
+
+
+def test_kvstore_row_sparse_pull_compact():
+    """row_sparse_pull with row_ids returns only the requested rows in a
+    compact RowSparseNDArray (parity: KVStore::PullRowSparse)."""
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = kvs.create("local")
+    rs = onp.random.RandomState(0)
+    full = rs.randn(1000, 8).astype("float32")
+    kv.init(3, nd.array(full))
+    out = sp.zeros("row_sparse", (1000, 8))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array([17, 4, 17, 901]))
+    assert out._data is None, "pull must stay compact"
+    assert onp.asarray(out._sp_indices).tolist() == [4, 17, 901]
+    onp.testing.assert_allclose(onp.asarray(out._sp_data),
+                                full[[4, 17, 901]], rtol=1e-6)
+
+
+def test_sparse_grad_zero_grad_stays_compact():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(100, 4, sparse_grad=True)
+    emb.initialize()
+    x = nd.array(onp.array([5, 6]), dtype="int32")
+    with autograd.record():
+        (emb(x) ** 2).sum().backward()
+    emb.weight.zero_grad()
+    g = emb.weight.grad()
+    assert g._data is None and g._sp_data.shape[0] == 0
+    # grad works again after zeroing
+    with autograd.record():
+        (emb(x) ** 2).sum().backward()
+    assert onp.asarray(emb.weight.grad()._sp_indices).tolist() == [5, 6]
+
+
+def test_embedding_sparse_grad_dense_fallback_under_trace():
+    """Under hybridize the whole step is traced — sparse_grad falls back to
+    the dense vjp path and training still works."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(100, 4, sparse_grad=True)
+    emb.initialize()
+    emb.hybridize()
+    x = nd.array(onp.array([5, 6]), dtype="int32")
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert onp.abs(g.asnumpy()[5]).sum() > 0
+    assert onp.abs(g.asnumpy()[6]).sum() > 0
+
+
+def test_sparse_grad_metadata_does_not_materialize():
+    """shape/dtype/size/ndim on a row-sparse grad must come from the
+    components — not silently build the (vocab, dim) dense buffer."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(30000, 8, sparse_grad=True)
+    emb.initialize()
+    with autograd.record():
+        (emb(nd.array(onp.array([1, 2]), dtype="int32")) ** 2).sum().backward()
+    g = emb.weight.grad()
+    assert (g.shape, g.dtype, g.size, g.ndim) == \
+        ((30000, 8), onp.dtype("float32"), 240000, 2)
+    assert g._data is None, "metadata access must not materialize dense"
+
+
+def test_sparse_grad_buffer_updated_in_place():
+    """A handle to the grad buffer taken before backward() must observe the
+    gradient afterwards (parity with the dense path's in-place _rebind)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(100, 4, sparse_grad=True)
+    emb.initialize()
+    handle = emb.weight.grad()        # pre-backward buffer handle
+    with autograd.record():
+        (emb(nd.array(onp.array([3, 9]), dtype="int32")) ** 2).sum().backward()
+    assert handle is emb.weight.grad()
+    assert onp.asarray(handle._sp_indices).tolist() == [3, 9]
+
+
+def test_grad_add_mixed_sparse_then_dense_accumulates():
+    """grad_req='add': a dense gradient landing after a row-sparse one must
+    accumulate, not clobber (eager micro-batch then hybridized micro-batch)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(50, 4, sparse_grad=True)
+    emb.initialize()
+    emb.weight.grad_req = "add"
+    emb.weight._attach_grad()
+    x = nd.array(onp.array([7, 8]), dtype="int32")
+    with autograd.record():
+        (emb(x).sum()).backward()     # eager -> row-sparse grad
+    s1 = float(onp.abs(emb.weight.grad().asnumpy()).sum())
+    emb.hybridize()
+    with autograd.record():
+        (emb(x).sum()).backward()     # hybridized -> dense grad
+    s2 = float(onp.abs(emb.weight.grad().asnumpy()).sum())
+    assert s2 == pytest.approx(2 * s1, rel=1e-5), \
+        f"accumulation lost: {s1} then {s2}"
+
+
+def test_row_sparse_pull_rejects_out_of_range():
+    from mxnet_tpu import base as _base
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = kvs.create("local")
+    kv.init(9, nd.array(onp.zeros((10, 2), "float32")))
+    out = sp.zeros("row_sparse", (10, 2))
+    with pytest.raises(_base.MXNetError):
+        kv.row_sparse_pull(9, out=out, row_ids=nd.array([99]))
