@@ -113,7 +113,7 @@ class FixedBucketSampler(Sampler):
     """
 
     def __init__(self, lengths, batch_size, num_buckets=10, shuffle=False,
-                 bucket_keys=None):
+                 bucket_keys=None, seed=None):
         import numpy as onp
 
         self._lengths = [max(l) if isinstance(l, (tuple, list)) else l
@@ -142,7 +142,13 @@ class FixedBucketSampler(Sampler):
                         "code would truncate it")
                 buckets[self.bucket_keys[-1]].append(i)
         self._buckets = buckets
-        self._rng = onp.random.RandomState(0)
+        # seed=None follows the global mx.random state (upstream gluonnlp
+        # draws from the global RNG); an explicit seed pins the order
+        if seed is None:
+            from ... import random as _random
+            self._rng = _random.host_rng()
+        else:
+            self._rng = onp.random.RandomState(int(seed))
 
     def __iter__(self):
         batches = []

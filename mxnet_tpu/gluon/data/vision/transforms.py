@@ -75,31 +75,58 @@ class Normalize(_NpTransform):
         return (x - mean) / std
 
 
-def _resize_hwc(x, size):
-    """Nearest-neighbor resize without external deps (OpenCV replacement for
-    the pure-python path; the C++ pipeline handles JPEG decode+bilinear)."""
+def _resize_hwc(x, size, interpolation=1):
+    """Dependency-free resize (OpenCV replacement for the pure-python
+    path; the C++ pipeline handles JPEG decode+resize).  `interpolation`
+    follows the cv2 codes: 0 = nearest, 1 = bilinear (default); other
+    codes (cubic/area) fall back to bilinear."""
     if isinstance(size, int):
         size = (size, size)
     w, h = size
     src_h, src_w = x.shape[:2]
-    rows = (onp.arange(h) * (src_h / h)).astype(onp.int64).clip(0, src_h - 1)
-    cols = (onp.arange(w) * (src_w / w)).astype(onp.int64).clip(0, src_w - 1)
-    return x[rows][:, cols]
+    if (src_h, src_w) == (h, w):
+        return x
+    if interpolation == 0:
+        rows = (onp.arange(h) * (src_h / h)).astype(onp.int64) \
+            .clip(0, src_h - 1)
+        cols = (onp.arange(w) * (src_w / w)).astype(onp.int64) \
+            .clip(0, src_w - 1)
+        return x[rows][:, cols]
+    ry = ((onp.arange(h) + 0.5) * (src_h / h) - 0.5).clip(0, src_h - 1)
+    rx = ((onp.arange(w) + 0.5) * (src_w / w) - 0.5).clip(0, src_w - 1)
+    y0 = onp.floor(ry).astype(onp.int64)
+    x0 = onp.floor(rx).astype(onp.int64)
+    y1 = onp.minimum(y0 + 1, src_h - 1)
+    x1 = onp.minimum(x0 + 1, src_w - 1)
+    wy = (ry - y0).astype(onp.float32)[:, None]
+    wx = (rx - x0).astype(onp.float32)[None, :]
+    if x.ndim == 3:
+        wy, wx = wy[..., None], wx[..., None]
+    xf = x.astype(onp.float32)
+    top = xf[y0][:, x0] * (1 - wx) + xf[y0][:, x1] * wx
+    bot = xf[y1][:, x0] * (1 - wx) + xf[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if onp.issubdtype(x.dtype, onp.integer):
+        info = onp.iinfo(x.dtype)
+        out = onp.rint(out).clip(info.min, info.max)
+    return out.astype(x.dtype)
 
 
 class Resize(_NpTransform):
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
         self._size = size
+        self._interp = interpolation
 
     def _apply(self, x):
-        return _resize_hwc(x, self._size)
+        return _resize_hwc(x, self._size, self._interp)
 
 
 class CenterCrop(_NpTransform):
     def __init__(self, size, interpolation=1):
         super().__init__()
         self._size = (size, size) if isinstance(size, int) else size
+        self._interp = interpolation
 
     def _apply(self, x):
         w, h = self._size
@@ -108,7 +135,7 @@ class CenterCrop(_NpTransform):
         x0 = max(0, (src_w - w) // 2)
         out = x[y0:y0 + h, x0:x0 + w]
         if out.shape[0] != h or out.shape[1] != w:
-            out = _resize_hwc(out, (w, h))
+            out = _resize_hwc(out, (w, h), self._interp)
         return out
 
 
@@ -119,6 +146,7 @@ class RandomResizedCrop(_NpTransform):
         self._size = (size, size) if isinstance(size, int) else size
         self._scale = scale
         self._ratio = ratio
+        self._interp = interpolation
 
     def _apply(self, x):
         src_h, src_w = x.shape[:2]
@@ -133,8 +161,8 @@ class RandomResizedCrop(_NpTransform):
                 x0 = onp.random.randint(0, src_w - w + 1)
                 y0 = onp.random.randint(0, src_h - h + 1)
                 crop = x[y0:y0 + h, x0:x0 + w]
-                return _resize_hwc(crop, self._size)
-        return _resize_hwc(x, self._size)
+                return _resize_hwc(crop, self._size, self._interp)
+        return _resize_hwc(x, self._size, self._interp)
 
 
 class RandomFlipLeftRight(_NpTransform):
@@ -249,6 +277,7 @@ class RandomCrop(_NpTransform):
         self._size = (size, size) if isinstance(size, int) else tuple(size)
         self._pad = pad
         self._pad_value = pad_value
+        self._interp = interpolation
 
     def _apply(self, x):
         if self._pad:
@@ -259,7 +288,7 @@ class RandomCrop(_NpTransform):
         w, h = self._size
         src_h, src_w = x.shape[:2]
         if src_h < h or src_w < w:
-            return _resize_hwc(x, (w, h))
+            return _resize_hwc(x, (w, h), self._interp)
         y0 = onp.random.randint(0, src_h - h + 1)
         x0 = onp.random.randint(0, src_w - w + 1)
         return x[y0:y0 + h, x0:x0 + w]
@@ -271,13 +300,15 @@ class CropResize(_NpTransform):
     def __init__(self, x0, y0, width, height, size=None, interpolation=1):
         super().__init__()
         self._box = (int(x0), int(y0), int(width), int(height))
-        self._size = ((size, size) if isinstance(size, int) else size)             if size is not None else None
+        self._size = ((size, size) if isinstance(size, int) else size) \
+            if size is not None else None
+        self._interp = interpolation
 
     def _apply(self, x):
         x0, y0, w, h = self._box
         out = x[y0:y0 + h, x0:x0 + w]
         if self._size is not None:
-            out = _resize_hwc(out, self._size)
+            out = _resize_hwc(out, self._size, self._interp)
         return out
 
 

@@ -122,9 +122,18 @@ class _GPT2Decoding:
                 continue
             seen.add(id(p))
             items.append(p)
-        param_nds = [p._data for p in items]
-        param_vals = tuple(d.jax for d in param_nds)
+        param_vals = tuple(p._data.jax for p in items)
         net = self
+
+        # params may live sharded on a mesh (post-ShardedTrainer): an
+        # op-derived (committed) prompt on a different device set raises
+        # 'incompatible devices' — replicate it onto the params' mesh
+        wsh = getattr(param_vals[0], "sharding", None) if param_vals else None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+        if isinstance(wsh, NamedSharding):
+            prompt_j = jax.device_put(prompt_j,
+                                      NamedSharding(wsh.mesh, _P()))
 
         # cache the jitted program per decode SHAPE — jax.jit caches by
         # function object, so a fresh closure per call would recompile
@@ -142,7 +151,12 @@ class _GPT2Decoding:
 
             @jax.jit
             def run(param_vals, prompt_j, key, temp):
-                with swap_values(param_nds, param_vals):
+                # re-capture the LIVE payload objects at trace time: if
+                # reset_ctx/astype replaced Parameter._data since the last
+                # trace, swapping into the stale objects would bake the
+                # then-current weights in as constants
+                live_nds = [p._data for p in items]
+                with swap_values(live_nds, param_vals):
                     with _base.training_mode(False):
                         rec = _base.set_recording(False)
                         try:

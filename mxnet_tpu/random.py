@@ -25,7 +25,7 @@ import numpy as onp
 from .context import Context, current_context
 
 __all__ = ["seed", "next_key", "RandomState", "push_trace_key",
-           "pop_trace_key", "get_state"]
+           "pop_trace_key", "get_state", "host_rng"]
 
 _tls = threading.local()
 
@@ -47,6 +47,12 @@ class RandomState:
                     continue
                 self._keys[c] = jax.random.PRNGKey(int(seed_) + hash(c) % 2**16)
                 self._counters[c] = 0
+            if ctx is None:
+                # host-side RNG for data-pipeline shuffling (samplers);
+                # reseeded together with the device keys so mx.random.seed
+                # controls epoch orders too
+                self._host_rng = onp.random.RandomState(
+                    int(seed_) & 0x7FFFFFFF)
 
     def _root(self, ctx: Context) -> jax.Array:
         if ctx not in self._keys:
@@ -113,3 +119,9 @@ def seed(seed_state: int, ctx: Optional[Context] = None):
 
 def next_key(ctx: Optional[Context] = None) -> jax.Array:
     return _STATE.next_key(ctx)
+
+
+def host_rng() -> onp.random.RandomState:
+    """The process-global host-side RandomState (follows mx.random.seed);
+    used by data samplers for shuffle order."""
+    return _STATE._host_rng

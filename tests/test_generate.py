@@ -59,3 +59,30 @@ def test_generate_guards():
     moe(p2)
     with pytest.raises(ValueError):
         moe.generate(p2, 4)
+
+
+@pytest.mark.slow
+def test_generate_after_sharded_training():
+    """Mesh-sharded params (post-ShardedTrainer) + an op-derived committed
+    prompt must not raise 'incompatible devices': generate replicates the
+    prompt onto the params' mesh."""
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import gpt2_lm_loss
+
+    net = get_gpt2("gpt2_124m", vocab_size=96, units=32, num_layers=3,
+                   num_heads=4, max_length=64, dropout=0.0)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    toks = mx.nd.array(rs.randint(0, 96, (8, 16)), dtype="int32")
+    labels = mx.nd.array(rs.randint(0, 96, (8, 16)), dtype="int32")
+    mesh = par.make_mesh(dp=4, tp=2)
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                optimizer_params={"learning_rate": 1e-3},
+                                mesh=mesh)
+        tr.step(toks, labels)
+    # op-derived prompt => committed to the default device
+    base = mx.nd.array(rs.randint(0, 96, (2, 5)), dtype="int32")
+    prompt = base + mx.nd.zeros((2, 5), dtype="int32")
+    out = net.generate(prompt, max_new_tokens=6, temperature=0).asnumpy()
+    assert out.shape == (2, 11)

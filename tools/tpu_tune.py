@@ -25,8 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench as _bench
 
 _TABLE = {"gpt2": _bench.bench_gpt2, "gpt2_long": _bench.bench_gpt2_long,
-          "resnet50": _bench.bench_resnet50, "bert": _bench.bench_bert,
-          "nmt": _bench.bench_nmt}
+          "resnet50": _bench.bench_resnet50,
+          "resnet50_io": _bench.bench_resnet50_io,
+          "bert": _bench.bench_bert, "nmt": _bench.bench_nmt}
 
 
 def main():
