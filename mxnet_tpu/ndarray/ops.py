@@ -430,9 +430,9 @@ def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     A, B = _as_nd(A), _as_nd(B)
 
     def f(a, b):
-        return alpha * jax.scipy.linalg.solve_triangular(
-            a, b, trans=1 if transpose else 0, lower=lower,
-            left_side=not rightside)
+        return alpha * jax.lax.linalg.triangular_solve(
+            a, b, left_side=not rightside, lower=lower,
+            transpose_a=transpose)
 
     return invoke("linalg_trsm", f, [A, B])
 
