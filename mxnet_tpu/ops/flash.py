@@ -37,6 +37,38 @@ DEFAULT_BLOCK_K = 1024
 _MASK = -1e30
 _LANES = 128
 
+# ~16 MiB VMEM per v4/v5e core; budget leaves headroom for compiler
+# temporaries/semaphores so the clamp errs safe rather than tight.
+_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def _vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
+    """Working-set model of one grid step, sized for the WORST of the
+    three kernels (the bwd dq/dkv kernels stream four tiles — q, k, v,
+    do — where fwd streams three): two live (bq, bk) f32 score-tile
+    temporaries (s→p and dp→ds are reused in place), double-buffered
+    input tiles, double-buffered output tile(s), and the larger of the
+    fwd/dkv f32 accumulator scratch sets."""
+    score = 2 * 4 * bq * bk
+    tiles = 2 * itemsize * d * 2 * (bq + bk)      # dq/dkv stream 4 tiles
+    outs = 2 * itemsize * bq * d
+    scratch = 4 * max(bq * d + 2 * bq * _LANES,   # fwd: acc + m + l
+                      2 * bk * d)                 # dkv: dk_acc + dv_acc
+    return score + tiles + outs + scratch
+
+
+def _clamp_blocks(bq: int, bk: int, d: int, itemsize: int):
+    """Shrink (block_q, block_k) until the working set fits the VMEM
+    budget — head-dim/dtype aware, so d=64 bf16 keeps the measured-fast
+    1024x1024 while d=256 f32 lands on a safe smaller tile."""
+    while _vmem_bytes(bq, bk, d, itemsize) > _VMEM_BUDGET and \
+            (bq > 128 or bk > 128):
+        if bk >= bq and bk > 128:
+            bk //= 2
+        else:
+            bq //= 2
+    return bq, bk
+
 
 def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -337,6 +369,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
+    block_q, block_k = _clamp_blocks(block_q, block_k, d,
+                                     jnp.dtype(q.dtype).itemsize)
     # halve until the block divides the sequence (any T that is a multiple
     # of 128 lands on a legal block by 128 at the latest)
     while block_q > 128 and tq % block_q:

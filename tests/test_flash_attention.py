@@ -106,3 +106,41 @@ def test_use_flash_rejects_cross_attention_shapes(monkeypatch):
     assert att._use_flash(q, True, None, 0.0, q)            # self: ok
     assert not att._use_flash(q, True, None, 0.0, (2, 300, 4, 64))
     assert not att._use_flash(q, False, None, 0.0, (2, 1536, 4, 64))
+
+
+def test_vmem_clamp_head_dim_aware():
+    """Block policy: d=64 keeps the measured-fast 1024x1024; big head dims
+    shrink until the modeled working set fits the VMEM budget."""
+    from mxnet_tpu.ops.flash import _VMEM_BUDGET, _clamp_blocks, _vmem_bytes
+
+    assert _clamp_blocks(1024, 1024, 64, 2) == (1024, 1024)
+    assert _clamp_blocks(1024, 1024, 64, 4) == (1024, 1024)
+    for d in (128, 256):
+        for itemsize in (2, 4):
+            bq, bk = _clamp_blocks(1024, 1024, d, itemsize)
+            assert _vmem_bytes(bq, bk, d, itemsize) <= _VMEM_BUDGET
+            assert bq >= 128 and bk >= 128
+    # d=256 f32 must NOT run at the full 1024x1024
+    assert _clamp_blocks(1024, 1024, 256, 4) != (1024, 1024)
+
+
+@pytest.mark.parametrize("d", [128, 256])
+def test_flash_large_head_dim_matches_ref(d):
+    b, t, h = 1, 256, 2
+    q, k, v = (_rand((b, t, h, d), s) for s in (9, 10, 11))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _attention_ref(q, k, v, causal=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-2, atol=2e-2)
+
+    def f(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def g(q, k, v):
+        return jnp.sum(_attention_ref(q, k, v, causal=True) ** 2)
+
+    for a, r in zip(jax.grad(f, (0, 1, 2))(q, k, v),
+                    jax.grad(g, (0, 1, 2))(q, k, v)):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(r),
+                                    rtol=5e-2, atol=5e-2)

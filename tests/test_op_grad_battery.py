@@ -334,10 +334,27 @@ def test_numeric_gradient(name):
 @pytest.mark.parametrize("name", sorted(n for n in SPECS
                                         if hasattr(OPS, n)))
 def test_consistency(name):
+    """On a TPU host: cpu-vs-tpu f32 with gradients.  On a CPU-only host
+    the default single config compares nothing, so force an f32-vs-bf16
+    dtype axis (forward-only; bf16 grads of norm-style ops are
+    legitimately loose) — the same degraded mode tools/tpu_consistency.py
+    uses."""
     fn, inputs, _ = SPECS[name]
 
     def first(*xs):
         out = fn(*xs)
         return out[0] if isinstance(out, (tuple, list)) else out
 
-    check_consistency(first, [onp.array(a) for a in inputs])
+    from mxnet_tpu import context as ctx_mod
+    if ctx_mod.num_tpus():
+        check_consistency(first, [onp.array(a) for a in inputs])
+    else:
+        if name in _NO_BF16:
+            pytest.skip("no bf16 kernel on the CPU backend")
+        check_consistency(first, [onp.array(a) for a in inputs],
+                          dtypes=["float32", "bfloat16"], grad=False,
+                          rtol=4e-2, atol=4e-2)
+
+
+# ops whose CPU backend has no bf16 kernel (LAPACK-backed)
+_NO_BF16 = {"linalg_potrf"}

@@ -21,6 +21,18 @@ import numpy as onp
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
+def _flash_case(q, k, v):
+    """Pallas flash kernel as an NDArray op (interpret off-TPU, compiled
+    on-chip) so check_consistency covers the kernel across backends."""
+    from mxnet_tpu.ndarray.ops import invoke
+    from mxnet_tpu.ops.flash import flash_attention
+
+    def f(qj, kj, vj):
+        return flash_attention(qj, kj, vj, causal=True)
+
+    return invoke("flash_attention", f, [q, k, v])
+
+
 def battery():
     from mxnet_tpu.ndarray import ops as F
     from mxnet_tpu.ops import dot_product_attention
@@ -65,6 +77,14 @@ def battery():
         "attention": (lambda q, k, v: dot_product_attention(
             q, k, v, causal=True), [r(2, 128, 2, 64), r(2, 128, 2, 64),
                                     r(2, 128, 2, 64)]),
+        # flash path across supported head dims — the VMEM-aware block
+        # clamp (ops/flash.py) must be safe at d=128/256 on the real chip
+        "flash_d64": (_flash_case, [r(1, 256, 2, 64), r(1, 256, 2, 64),
+                                    r(1, 256, 2, 64)]),
+        "flash_d128": (_flash_case, [r(1, 256, 2, 128), r(1, 256, 2, 128),
+                                     r(1, 256, 2, 128)]),
+        "flash_d256": (_flash_case, [r(1, 256, 2, 256), r(1, 256, 2, 256),
+                                     r(1, 256, 2, 256)]),
         "gelu": (lambda x: F.Activation(x, act_type="gelu"), [r(8, 32)]),
         "logsumexp": (lambda x: F.logsumexp(x, axis=-1), [r(6, 40)]),
     }
