@@ -77,8 +77,12 @@ Device = Context  # 2.x name
 
 
 def _backend_devices(platform: str) -> List[jax.Device]:
+    """PROCESS-LOCAL devices of a platform: MXNet context semantics are
+    per-worker (each worker's cpu(0)/tpu(0) is its own), and in a
+    multi-process job placing eager arrays on another process's device is
+    both wrong and unsupported."""
     try:
-        return jax.devices(platform)
+        return list(jax.local_devices(backend=platform))
     except RuntimeError:
         return []
 
@@ -90,7 +94,7 @@ def accelerator_devices() -> List[jax.Device]:
     """All non-host devices (TPU chips), else empty."""
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
         _ACCEL_CACHE = devs
     return _ACCEL_CACHE
 

@@ -144,6 +144,17 @@ class KVStore(KVStoreBase):
             if k not in self._store:
                 raise _base.MXNetError(f"key {k} not initialized")
             if self._updater is not None and not self._compression and \
+                    self._type.startswith("dist_async"):
+                # async PS semantics (kvstore_dist async mode): NO merge
+                # barrier — each pushed value applies its own optimizer
+                # update as it "arrives", so stateful optimizers see a
+                # sequence of small updates instead of one merged one
+                for x in vals:
+                    g = x if isinstance(x, RowSparseNDArray) \
+                        else NDArray(x.jax)
+                    self._updater(k, g, self._store[k])
+                continue
+            if self._updater is not None and not self._compression and \
                     all(isinstance(x, RowSparseNDArray) for x in vals):
                 # keep row-sparse grads compact into the updater's lazy
                 # row-wise path (parity: kvstore_local's sparse push)

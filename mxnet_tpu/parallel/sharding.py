@@ -9,6 +9,7 @@ mapping, so data-parallel MXNet semantics fall out as the default.
 """
 from __future__ import annotations
 
+import functools as _functools
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -59,6 +60,36 @@ def logical_axes_of(param) -> Optional[Tuple[Optional[str], ...]]:
     return getattr(param, "_logical_axes", None)
 
 
+def mesh_device_put(value, sharding):
+    """``jax.device_put`` that also works onto MULTI-PROCESS meshes.
+
+    A process-local committed array cannot be device_put to
+    non-addressable devices (no raw DCN transport on the CPU/test
+    backends), so it hops through host memory — every process holds the
+    full value and materializes its own shards (the standard multihost
+    ingest pattern).  An already-GLOBAL array cannot be fetched to host
+    either; it is resharded inside a compiled identity whose collectives
+    ride the coordination service/ICI/DCN."""
+    if isinstance(value, jax.Array) and \
+            not getattr(sharding, "is_fully_addressable", True):
+        if getattr(value, "sharding", None) == sharding:
+            return value
+        if value.is_fully_addressable:
+            import numpy as onp
+            value = onp.asarray(value)
+        else:
+            return _reshard_fn(sharding)(value)
+    return jax.device_put(value, sharding)
+
+
+@_functools.lru_cache(maxsize=None)
+def _reshard_fn(sharding):
+    """One cached compiled identity per target sharding (jax.jit caches by
+    function identity — a fresh lambda per call would recompile every
+    state-leaf reshard)."""
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
 def param_sharding(param, mesh: Mesh,
                    rules: Optional[ShardingRules] = None) -> NamedSharding:
     rules = rules or ShardingRules()
@@ -76,7 +107,7 @@ def shard_params(block, mesh: Mesh, rules: Optional[ShardingRules] = None):
             continue
         sh = NamedSharding(mesh, rules.spec(logical_axes_of(p)))
         p._sharding = sh
-        p._data._rebind(jax.device_put(p._data.jax, sh))
+        p._data._rebind(mesh_device_put(p._data.jax, sh))
     return block
 
 

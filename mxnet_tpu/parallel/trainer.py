@@ -24,7 +24,8 @@ from .. import random as _random
 from ..ndarray import NDArray
 from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
-from .sharding import ShardingRules, batch_spec, logical_axes_of, shard_params
+from .sharding import (ShardingRules, batch_spec, logical_axes_of,
+                       mesh_device_put as _mesh_device_put, shard_params)
 
 
 def _flatten_state(state) -> Tuple[List[NDArray], Any]:
@@ -138,6 +139,11 @@ class ShardedTrainer:
 
         with _base.training_mode(False):
             rec = _base.set_recording(False)
+            # settling runs MoE layers inside eval_shape traces: open a
+            # collection scope so the router doesn't warn about a foreign
+            # trace, and drain whatever gets recorded (shape settling
+            # computes no loss)
+            aux_prev = _base.set_aux_collection(True)
             try:
                 import jax
                 before = {id(p): p._data.jax
@@ -172,6 +178,8 @@ class ShardedTrainer:
                         net(*data)
             finally:
                 _base.set_recording(rec)
+                _base.set_aux_collection(aux_prev)
+                _base.pop_aux_losses()
         seen = set()
         for name, p in net.collect_params().items():
             if id(p) in seen:
@@ -201,7 +209,7 @@ class ShardedTrainer:
                 self._state_shardings.append(
                     psh if tuple(l.shape) == tuple(p.shape) else repl)
         for st, sh in zip(self._state_flat, self._state_shardings):
-            st._rebind(jax.device_put(st.jax, sh))
+            st._rebind(_mesh_device_put(st.jax, sh))
         self._state_trees = [_flatten_state(st)[1] for st in self._states]
         self._state_counts = [len(_state_leaves(st)) for st in self._states]
         self._compile(data, labels)
@@ -335,8 +343,8 @@ class ShardedTrainer:
         aux_vals = tuple(p._data.jax for _, p in self._aux)
         state_vals = tuple(l.jax for l in self._state_flat)
         batch_vals = tuple(
-            jax.device_put(x.jax if isinstance(x, NDArray) else jnp.asarray(x),
-                           sh)
+            _mesh_device_put(x.jax if isinstance(x, NDArray)
+                             else jnp.asarray(x), sh)
             for x, sh in zip(tuple(data) + tuple(labels),
                              self._batch_shardings))
 
@@ -452,6 +460,6 @@ class ShardedTrainer:
         flat_idx = 0
         for i, st in enumerate(self._states):
             for j, l in enumerate(_state_leaves(st)):
-                l._rebind(jax.device_put(loaded[f"state_{i}_{j}"].jax,
+                l._rebind(_mesh_device_put(loaded[f"state_{i}_{j}"].jax,
                                          self._state_shardings[flat_idx]))
                 flat_idx += 1

@@ -113,3 +113,35 @@ def test_trainer_with_compression_params_converges():
         tr.step(1)          # loss is already a mean over the batch
         loss_prev = float(l.asscalar())
     assert loss_prev < 0.1, loss_prev
+
+
+def test_dist_async_updates_per_push_no_merge_barrier():
+    """dist_async applies one optimizer update PER pushed value (async PS
+    semantics) while dist_sync merges first — distinguishable through a
+    stateful optimizer (momentum): two sequential updates != one merged
+    update (parity: kvstore_dist async mode)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu import nd
+
+    def run(kv_type):
+        kv = kvs.create(kv_type)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                          rescale_grad=1.0))
+        w = nd.array(onp.zeros((4,), "float32"))
+        kv.init(0, w)
+        g1 = nd.array(onp.full((4,), 1.0, "float32"))
+        g2 = nd.array(onp.full((4,), 2.0, "float32"))
+        kv.push(0, [g1, g2])
+        out = nd.array(onp.zeros((4,), "float32"))
+        kv.pull(0, out=out)
+        return out.asnumpy()
+
+    w_sync = run("dist_sync")
+    w_async = run("dist_async")
+    # sync: one update with merged grad 3 -> w = -0.3
+    onp.testing.assert_allclose(w_sync, onp.full((4,), -0.3), rtol=1e-6)
+    # async: two sequential momentum updates: m1=1, w=-0.1; m2=.9*1+2=2.9,
+    # w=-0.1-0.29=-0.39
+    onp.testing.assert_allclose(w_async, onp.full((4,), -0.39), rtol=1e-5)
+    assert not onp.allclose(w_sync, w_async)
