@@ -1833,3 +1833,421 @@ def Crop(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):
         return x[:, :, y0:y0 + th, x0:x0 + tw]
 
     return invoke("Crop", f, nds)
+
+
+# ------------------------------------------------------- long-tail op sweep
+# (VERDICT r2 missing #4: ops off the main model path that upstream scripts
+# reach for — vision kernels, LRN-era layers, linalg, detection utilities.
+# Parity: src/operator/{nn/lrn,contrib/*,tensor/la_op}*)
+
+
+@_export
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    """Local response normalization across channels (parity: mx.nd.LRN,
+    src/operator/nn/lrn.cc; the AlexNet-era layer)."""
+    data = _as_nd(data)
+
+    def f(x):
+        sq = x * x
+        half = nsize // 2
+        # sum over a channel window via padded sliding window
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (half, half)
+        sqp = jnp.pad(sq, pad)
+        acc = builtins.sum(
+            jax.lax.slice_in_dim(sqp, i, i + x.shape[1], axis=1)
+            for i in range(nsize))
+        # upstream lrn-inl.h normalizes alpha by the window size
+        return x / jnp.power(knorm + (alpha / nsize) * acc, beta)
+
+    return invoke("LRN", f, [data])
+
+
+@_export
+def SoftmaxActivation(data, mode="instance", **kw):
+    """Deprecated-but-used softmax layer (parity: mx.nd.SoftmaxActivation):
+    mode='instance' softmaxes over all non-batch dims flattened;
+    mode='channel' softmaxes over axis 1."""
+    data = _as_nd(data)
+
+    def f(x):
+        if mode == "channel":
+            return jax.nn.softmax(x, axis=1)
+        flat = x.reshape(x.shape[0], -1)
+        return jax.nn.softmax(flat, axis=-1).reshape(x.shape)
+
+    return invoke("SoftmaxActivation", f, [data])
+
+
+@_export
+def depth_to_space(data, block_size, **kw):
+    """(N, C*b*b, H, W) → (N, C, H*b, W*b) (parity: mx.nd.depth_to_space,
+    DCR order like the upstream kernel)."""
+    data = _as_nd(data)
+    b = int(block_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, b, b, c // (b * b), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+        return x.reshape(n, c // (b * b), h * b, w * b)
+
+    return invoke("depth_to_space", f, [data])
+
+
+@_export
+def space_to_depth(data, block_size, **kw):
+    """(N, C, H*b, W*b) → (N, C*b*b, H, W) (parity: mx.nd.space_to_depth)."""
+    data = _as_nd(data)
+    b = int(block_size)
+
+    def f(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // b, b, w // b, b)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * b * b, h // b, w // b)
+
+    return invoke("space_to_depth", f, [data])
+
+
+@_export
+def batch_take(a, indices, **kw):
+    """Per-row element pick: out[i] = a[i, indices[i]] (parity:
+    mx.nd.batch_take)."""
+    a, indices = _as_nd(a), _as_nd(indices)
+
+    def f(x, idx):
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+    return invoke("batch_take", f, [a, indices])
+
+
+@_export
+def cumsum(a, axis=None, dtype=None, **kw):
+    """Parity: mx.np.cumsum exposed on the nd namespace too."""
+    a = _as_nd(a)
+
+    def f(x):
+        y = jnp.cumsum(x.ravel() if axis is None else x, axis=0 if axis is
+                       None else axis)
+        return y.astype(_base.canonical_dtype(dtype)) if dtype else y
+
+    return invoke("cumsum", f, [a])
+
+
+@_export
+def cumprod(a, axis=None, dtype=None, **kw):
+    a = _as_nd(a)
+
+    def f(x):
+        y = jnp.cumprod(x.ravel() if axis is None else x, axis=0 if axis is
+                        None else axis)
+        return y.astype(_base.canonical_dtype(dtype)) if dtype else y
+
+    return invoke("cumprod", f, [a])
+
+
+@_export
+def moments(data, axes=None, keepdims=False, **kw):
+    """(mean, variance) over `axes` (parity: mx.nd.moments)."""
+    data = _as_nd(data)
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+
+    def f(x):
+        m = jnp.mean(x, axis=ax, keepdims=keepdims)
+        v = jnp.mean(
+            (x - jnp.mean(x, axis=ax, keepdims=True)) ** 2,
+            axis=ax, keepdims=keepdims)
+        return m, v
+
+    return invoke("moments", f, [data], nout=2)
+
+
+# ---- linalg long tail (parity: src/operator/tensor/la_op.cc) ----
+
+@_export
+def linalg_det(A, **kw):
+    A = _as_nd(A)
+    return invoke("linalg_det", jnp.linalg.det, [A])
+
+
+@_export
+def linalg_slogdet(A, **kw):
+    A = _as_nd(A)
+
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return sign, logabs
+
+    return invoke("linalg_slogdet", f, [A], nout=2)
+
+
+@_export
+def linalg_inverse(A, **kw):
+    A = _as_nd(A)
+    return invoke("linalg_inverse", jnp.linalg.inv, [A])
+
+
+@_export
+def linalg_extractdiag(A, offset=0, **kw):
+    A = _as_nd(A)
+    return invoke("linalg_extractdiag",
+                  lambda a: jnp.diagonal(a, offset=offset, axis1=-2,
+                                         axis2=-1), [A])
+
+
+@_export
+def linalg_makediag(A, offset=0, **kw):
+    A = _as_nd(A)
+
+    def f(a):
+        n = a.shape[-1] + builtins.abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        return out.at[..., r, c].set(a)
+
+    return invoke("linalg_makediag", f, [A])
+
+
+# ---- spatial sampling (parity: src/operator/bilinear_sampler.cc,
+#      grid_generator, spatial_transformer, contrib/roi_align) ----
+
+def _bilinear_sample(fm, gx, gy):
+    """Sample fm (C, H, W) at normalized grid coords gx/gy in [-1, 1]
+    (Ho, Wo) with zero padding outside — the BilinearSampler contract."""
+    c, h, w = fm.shape
+    x = (gx + 1.0) * (w - 1) / 2.0
+    y = (gy + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def at(yy, xx):
+        inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = fm[:, yc, xc]                      # (C, Ho, Wo)
+        return jnp.where(inside[None], v, 0.0)
+
+    return (at(y0, x0) * (1 - wx) * (1 - wy)
+            + at(y0, x0 + 1) * wx * (1 - wy)
+            + at(y0 + 1, x0) * (1 - wx) * wy
+            + at(y0 + 1, x0 + 1) * wx * wy)
+
+
+@_export
+def BilinearSampler(data, grid, **kw):
+    """Sample (N, C, H, W) at grid (N, 2, Ho, Wo) of normalized coords
+    (parity: mx.nd.BilinearSampler — the STN sampling stage)."""
+    data, grid = _as_nd(data), _as_nd(grid)
+
+    def f(x, g):
+        return jax.vmap(
+            lambda fm, gg: _bilinear_sample(fm, gg[0], gg[1]))(x, g)
+
+    return invoke("BilinearSampler", f, [data, grid])
+
+
+@_export
+def GridGenerator(data, transform_type="affine", target_shape=None, **kw):
+    """Generate a sampling grid from 6-dof affine params (N, 6) or use
+    direct flow (N, 2, H, W) (parity: mx.nd.GridGenerator)."""
+    data = _as_nd(data)
+
+    def f(t):
+        if transform_type == "warp":
+            n, _, h, w = t.shape
+            xs, ys = jnp.meshgrid(jnp.arange(w, dtype=jnp.float32),
+                                  jnp.arange(h, dtype=jnp.float32))
+            gx = (xs[None] + t[:, 0]) * 2.0 / (w - 1) - 1.0
+            gy = (ys[None] + t[:, 1]) * 2.0 / (h - 1) - 1.0
+            return jnp.stack([gx, gy], axis=1)
+        h, w = target_shape
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, HW)
+        theta = t.reshape(-1, 2, 3)
+        out = jnp.einsum("nij,jk->nik", theta, src)             # (N, 2, HW)
+        return out.reshape(-1, 2, h, w)
+
+    return invoke("GridGenerator", f, [data])
+
+
+@_export
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine",
+                       sampler_type="bilinear", **kw):
+    """STN: affine grid from `loc` then bilinear sampling (parity:
+    mx.nd.SpatialTransformer)."""
+    grid = GridGenerator(loc, transform_type=transform_type,
+                         target_shape=target_shape)
+    return BilinearSampler(data, grid)
+
+
+# ---- detection utilities (parity: src/operator/contrib/bounding_box.cc,
+#      roi_align.cc) ----
+
+@_export
+def box_iou(lhs, rhs, format="corner", **kw):
+    """Pairwise IoU of (..., N, 4) x (..., M, 4) boxes (parity:
+    mx.nd.contrib.box_iou)."""
+    lhs, rhs = _as_nd(lhs), _as_nd(rhs)
+
+    def corners(b):
+        if format == "center":
+            cx, cy, w, h = (b[..., 0], b[..., 1], b[..., 2], b[..., 3])
+            return (cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2)
+        return (b[..., 0], b[..., 1], b[..., 2], b[..., 3])
+
+    def f(a, b):
+        ax1, ay1, ax2, ay2 = (t[..., :, None] for t in corners(a))
+        bx1, by1, bx2, by2 = (t[..., None, :] for t in corners(b))
+        iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
+        ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
+        inter = iw * ih
+        area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+        area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+        return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+    return invoke("box_iou", f, [lhs, rhs])
+
+
+@_export
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner", **kw):
+    """Greedy non-max suppression with static shapes (parity:
+    mx.nd.contrib.box_nms).  Suppressed rows become -1, preserving the
+    upstream contract.  O(N^2) mask matrix + lax.scan over score order —
+    static shapes keep XLA happy."""
+    data = _as_nd(data)
+
+    def f(x):
+        shape = x.shape
+        batched = x.ndim == 3
+        xb = x if batched else x[None]
+
+        def one(rows):
+            scores = rows[:, score_index]
+            boxes = rows[:, coord_start:coord_start + 4]
+            if in_format == "center":
+                cx, cy, w, h = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
+                                boxes[:, 3])
+                boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                                   cy + h / 2], axis=1)
+            valid = scores > valid_thresh
+            order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+            x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
+                              boxes[:, 3])
+            iw = jnp.maximum(
+                jnp.minimum(x2[:, None], x2[None]) -
+                jnp.maximum(x1[:, None], x1[None]), 0)
+            ih = jnp.maximum(
+                jnp.minimum(y2[:, None], y2[None]) -
+                jnp.maximum(y1[:, None], y1[None]), 0)
+            inter = iw * ih
+            area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+            iou = inter / jnp.maximum(area[:, None] + area[None] - inter,
+                                      1e-12)
+            same_cls = jnp.ones_like(iou, bool) if (
+                force_suppress or id_index < 0) else (
+                rows[:, id_index][:, None] == rows[:, id_index][None])
+            sup_pair = (iou > overlap_thresh) & same_cls
+
+            n = rows.shape[0]
+            kmax = n if topk is None or topk < 0 else builtins.min(topk, n)
+
+            def body(suppressed, oi):
+                i = order[oi]
+                # upstream truncates the CANDIDATE set at score rank k
+                # before NMS — ranks beyond k are discarded outright
+                ok = valid[i] & ~suppressed[i] & (oi < kmax)
+                suppressed = jnp.where(
+                    ok, suppressed | sup_pair[i], suppressed)
+                suppressed = jnp.where(
+                    ok, suppressed.at[i].set(False), suppressed)
+                keep = jnp.where(ok, False, True)
+                return suppressed, keep
+
+            suppressed, dropped = jax.lax.scan(
+                body, jnp.zeros((n,), bool), jnp.arange(n))
+            # a row survives if valid, within the top-k candidates, not
+            # suppressed by a kept row, and was itself kept
+            kept_mask = jnp.zeros((n,), bool).at[order].set(~dropped)
+            kept_mask = kept_mask & valid & ~suppressed
+            # `boxes` is corner-format here regardless of in_format;
+            # rewrite the coord columns only when the encoding changes
+            out_rows = rows
+            if out_format != in_format:
+                if out_format == "corner":
+                    b4 = boxes
+                else:
+                    b4 = jnp.stack(
+                        [(boxes[:, 0] + boxes[:, 2]) / 2,
+                         (boxes[:, 1] + boxes[:, 3]) / 2,
+                         boxes[:, 2] - boxes[:, 0],
+                         boxes[:, 3] - boxes[:, 1]], axis=1)
+                out_rows = rows.at[
+                    :, coord_start:coord_start + 4].set(b4)
+            return jnp.where(kept_mask[:, None], out_rows,
+                             jnp.full_like(rows, -1.0))
+
+        out = jax.vmap(one)(xb)
+        return out if batched else out.reshape(shape)
+
+    return invoke("box_nms", f, [data])
+
+
+@_export
+def ROIAlign(data, rois, pooled_size=None, spatial_scale=1.0,
+             sample_ratio=2, position_sensitive=False, **kw):
+    """ROI Align with bilinear sampling (parity:
+    mx.nd.contrib.ROIAlign, src/operator/contrib/roi_align.cc)."""
+    data, rois = _as_nd(data), _as_nd(rois)
+    ph, pw = pooled_size
+    sr = builtins.max(int(sample_ratio), 1)
+
+    def f(x, r):
+        def one(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = roi[1] * spatial_scale
+            y1 = roi[2] * spatial_scale
+            x2 = roi[3] * spatial_scale
+            y2 = roi[4] * spatial_scale
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            fm = x[b]                                     # (C, H, W)
+            h, w = fm.shape[1], fm.shape[2]
+            bin_h, bin_w = rh / ph, rw / pw
+            # sr x sr sample points per output bin, averaged
+            iy = jnp.arange(ph * sr, dtype=jnp.float32)
+            ix = jnp.arange(pw * sr, dtype=jnp.float32)
+            sy = y1 + (iy + 0.5) * bin_h / sr             # (ph*sr,)
+            sx = x1 + (ix + 0.5) * bin_w / sr             # (pw*sr,)
+            gy = sy * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+            gx = sx * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+            gyy = jnp.broadcast_to(gy[:, None], (ph * sr, pw * sr))
+            gxx = jnp.broadcast_to(gx[None, :], (ph * sr, pw * sr))
+            sampled = _bilinear_sample(fm, gxx, gyy)      # (C, ph*sr, pw*sr)
+            c = sampled.shape[0]
+            pooled = sampled.reshape(c, ph, sr, pw, sr).mean(axis=(2, 4))
+            if position_sensitive:
+                # PS-ROIAlign (R-FCN): bin (i, j) pools its OWN channel
+                # group — C = c_out * ph * pw
+                c_out = c // (ph * pw)
+                g = pooled.reshape(c_out, ph, pw, ph, pw)
+                ii = jnp.arange(ph)[:, None]
+                jj = jnp.arange(pw)[None, :]
+                return g[:, ii, jj, ii, jj]               # (c_out, ph, pw)
+            return pooled
+
+        return jax.vmap(one)(r)
+
+    return invoke("ROIAlign", f, [data, rois])

@@ -200,6 +200,53 @@ SPECS["SequenceMask"] = (
         x, sequence_length=nd.array([1, 2], dtype="int32"),
         use_sequence_length=True),
     [R(3, 2, 2)], {})
+# long-tail sweep ops
+SPECS["LRN"] = (lambda x: OPS.LRN(x, nsize=3), [R(1, 4, 3, 3)], {})
+SPECS["SoftmaxActivation"] = (lambda x: OPS.SoftmaxActivation(x),
+                              [R(2, 3, 2)], {})
+SPECS["depth_to_space"] = (lambda x: OPS.depth_to_space(x, 2),
+                           [R(1, 4, 2, 2)], {})
+SPECS["space_to_depth"] = (lambda x: OPS.space_to_depth(x, 2),
+                           [R(1, 1, 4, 4)], {})
+SPECS["batch_take"] = (
+    lambda x: OPS.batch_take(x, nd.array([1, 0], dtype="int32")),
+    [R(2, 3)], {})
+SPECS["cumsum"] = (lambda x: OPS.cumsum(x, axis=1), [R(2, 3)], {})
+SPECS["cumprod"] = (lambda x: OPS.cumprod(x, axis=1), [NZ(2, 3)], {})
+SPECS["moments"] = (lambda x: OPS.moments(x, axes=(0,))[0] +
+                    OPS.moments(x, axes=(0,))[1], [R(3, 4)], {})
+SPECS["linalg_det"] = (lambda a: OPS.linalg_det(a), [_spd(3)],
+                       {"rtol": 0.05, "atol": 0.05})
+SPECS["linalg_inverse"] = (lambda a: OPS.linalg_inverse(a), [_spd(3)],
+                           {"rtol": 0.05, "atol": 0.02})
+SPECS["linalg_slogdet"] = (lambda a: OPS.linalg_slogdet(a)[1], [_spd(3)],
+                           {"rtol": 0.05, "atol": 0.01})
+SPECS["linalg_extractdiag"] = (lambda a: OPS.linalg_extractdiag(a),
+                               [R(3, 3)], {})
+SPECS["linalg_makediag"] = (lambda a: OPS.linalg_makediag(a), [R(3)], {})
+SPECS["box_iou"] = (
+    lambda a, b: OPS.box_iou(a, b),
+    [onp.array([[0.1, 0.1, 0.9, 0.8]], "f"),
+     onp.array([[0.2, 0.0, 0.8, 0.7]], "f")], {"rtol": 0.05, "atol": 0.01})
+_GRID = onp.stack(onp.meshgrid(onp.linspace(-0.9, 0.9, 4),
+                               onp.linspace(-0.9, 0.9, 4)),
+                  axis=0)[None].astype("f")
+SPECS["BilinearSampler"] = (
+    lambda x: OPS.BilinearSampler(x, nd.array(_GRID)),
+    [R(1, 2, 4, 4)], {"rtol": 0.05, "atol": 0.01})
+SPECS["GridGenerator"] = (
+    lambda t: OPS.GridGenerator(t, target_shape=(3, 3)),
+    [onp.array([[1.1, 0.1, 0.0, -0.1, 0.9, 0.1]], "f")], {})
+SPECS["SpatialTransformer"] = (
+    lambda x, t: OPS.SpatialTransformer(x, t, target_shape=(4, 4)),
+    [R(1, 2, 4, 4),
+     onp.array([[0.9, 0.05, 0.0, 0.05, 0.9, 0.0]], "f")],
+    {"rtol": 0.05, "atol": 0.02})
+SPECS["ROIAlign"] = (
+    lambda x: OPS.ROIAlign(x, nd.array([[0, 1.0, 1.0, 6.0, 6.0]]),
+                           pooled_size=(2, 2)),
+    [R(1, 2, 8, 8)], {"rtol": 0.05, "atol": 0.02})
+
 SPECS["dot"] = (lambda a, b: OPS.dot(a, b), [R(2, 3), R(3, 2)], {})
 SPECS["batch_dot"] = (lambda a, b: OPS.batch_dot(a, b),
                       [R(2, 2, 3), R(2, 3, 2)], {})
@@ -297,6 +344,8 @@ NONDIFF = {
     "Cast", "cast", "zeros_like", "ones_like", "arange_like",
     # index scatter (int index input drives the op)
     "scatter_nd",
+    # NMS: output is a keep/-1 row masking (piecewise-constant selection)
+    "box_nms",
     # stateful recurrent wrapper (covered by dedicated RNN tests)
     "RNN",
     # max-pool over generated ROIs (kink-dominated; dedicated exact test
@@ -357,4 +406,5 @@ def test_consistency(name):
 
 
 # ops whose CPU backend has no bf16 kernel (LAPACK-backed)
-_NO_BF16 = {"linalg_potrf"}
+_NO_BF16 = {"linalg_potrf", "linalg_inverse", "linalg_slogdet",
+            "linalg_det"}

@@ -1,0 +1,217 @@
+"""Long-tail op sweep (VERDICT r2 missing #4): vision-era layers, linalg,
+detection utilities.  Parity references:
+src/operator/nn/lrn.cc, src/operator/tensor/la_op.cc,
+src/operator/bilinear_sampler.cc, src/operator/spatial_transformer.cc,
+src/operator/contrib/{bounding_box,roi_align}.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_rs = onp.random.RandomState(11)
+
+
+def test_lrn_matches_manual():
+    x = _rs.rand(2, 7, 3, 3).astype("f")
+    alpha, beta, knorm, nsize = 1e-3, 0.75, 2.0, 5
+    out = nd.LRN(nd.array(x), alpha=alpha, beta=beta, knorm=knorm,
+                 nsize=nsize).asnumpy()
+    half = nsize // 2
+    ref = onp.empty_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + half + 1)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        # upstream normalizes alpha by nsize (lrn-inl.h salpha)
+        ref[:, c] = x[:, c] / (knorm + alpha / nsize * acc) ** beta
+    onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_activation_modes():
+    x = _rs.randn(2, 4, 3).astype("f")
+    inst = nd.SoftmaxActivation(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(inst.reshape(2, -1).sum(-1), [1, 1],
+                                rtol=1e-5)
+    chan = nd.SoftmaxActivation(nd.array(x), mode="channel").asnumpy()
+    onp.testing.assert_allclose(chan.sum(axis=1), onp.ones((2, 3)),
+                                rtol=1e-5)
+
+
+def test_depth_space_roundtrip():
+    x = _rs.randn(2, 8, 3, 4).astype("f")
+    d = nd.depth_to_space(nd.array(x), 2)
+    assert d.shape == (2, 2, 6, 8)
+    back = nd.space_to_depth(d, 2).asnumpy()
+    onp.testing.assert_array_equal(back, x)
+
+
+def test_batch_take():
+    x = _rs.randn(3, 5).astype("f")
+    idx = onp.array([4, 0, 2], "int32")
+    out = nd.batch_take(nd.array(x), nd.array(idx, dtype="int32")).asnumpy()
+    onp.testing.assert_array_equal(out, x[onp.arange(3), idx])
+
+
+def test_cumsum_cumprod():
+    x = _rs.rand(3, 4).astype("f") + 0.5
+    onp.testing.assert_allclose(nd.cumsum(nd.array(x), axis=1).asnumpy(),
+                                onp.cumsum(x, axis=1), rtol=1e-6)
+    onp.testing.assert_allclose(nd.cumsum(nd.array(x)).asnumpy(),
+                                onp.cumsum(x), rtol=1e-6)
+    onp.testing.assert_allclose(nd.cumprod(nd.array(x), axis=0).asnumpy(),
+                                onp.cumprod(x, axis=0), rtol=1e-5)
+
+
+def test_moments():
+    x = _rs.randn(4, 5, 6).astype("f")
+    m, v = nd.moments(nd.array(x), axes=(0, 2))
+    onp.testing.assert_allclose(m.asnumpy(), x.mean(axis=(0, 2)),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(v.asnumpy(), x.var(axis=(0, 2)),
+                                rtol=1e-4, atol=1e-6)
+
+
+def test_linalg_long_tail():
+    a = _rs.randn(3, 3).astype("f")
+    a = a @ a.T + 3 * onp.eye(3, dtype="f")
+    onp.testing.assert_allclose(nd.linalg_det(nd.array(a)).asnumpy(),
+                                onp.linalg.det(a), rtol=1e-4)
+    onp.testing.assert_allclose(nd.linalg_inverse(nd.array(a)).asnumpy(),
+                                onp.linalg.inv(a), rtol=1e-3, atol=1e-5)
+    sign, logab = nd.linalg_slogdet(nd.array(a))
+    s_ref, l_ref = onp.linalg.slogdet(a)
+    assert float(sign.asscalar()) == pytest.approx(s_ref)
+    assert float(logab.asscalar()) == pytest.approx(l_ref, rel=1e-4)
+    d = nd.linalg_extractdiag(nd.array(a)).asnumpy()
+    onp.testing.assert_allclose(d, onp.diag(a), rtol=1e-6)
+    md = nd.linalg_makediag(nd.array(d)).asnumpy()
+    onp.testing.assert_allclose(md, onp.diag(onp.diag(a)), rtol=1e-6)
+    off = nd.linalg_makediag(nd.array(d), offset=1).asnumpy()
+    assert off.shape == (4, 4)
+    onp.testing.assert_allclose(onp.diagonal(off, 1), onp.diag(a),
+                                rtol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid():
+    x = _rs.randn(2, 3, 5, 7).astype("f")
+    gy, gx = onp.meshgrid(onp.linspace(-1, 1, 5), onp.linspace(-1, 1, 7),
+                          indexing="ij")
+    grid = onp.stack([gx, gy], axis=0)[None].repeat(2, axis=0).astype("f")
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    onp.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_identity_affine():
+    x = _rs.randn(2, 3, 6, 6).astype("f")
+    theta = onp.tile(onp.array([1, 0, 0, 0, 1, 0], "f"), (2, 1))
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(6, 6)).asnumpy()
+    onp.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+    # grid generator alone: identity theta -> linspace grid
+    g = nd.GridGenerator(nd.array(theta), target_shape=(4, 4)).asnumpy()
+    onp.testing.assert_allclose(g[0, 0, 0], onp.linspace(-1, 1, 4),
+                                rtol=1e-5)
+
+
+def test_box_iou():
+    a = onp.array([[0, 0, 2, 2]], "f")
+    b = onp.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]], "f")
+    iou = nd.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [cls, score, x1, y1, x2, y2]
+    rows = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],   # overlaps the first -> suppressed
+        [0, 0.7, 5, 5, 7, 7],           # far away -> kept
+        [0, 0.0, 8, 8, 9, 9],           # below valid_thresh -> dropped
+    ], "f")
+    out = nd.box_nms(nd.array(rows), overlap_thresh=0.5,
+                     valid_thresh=0.05).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)
+    assert (out[1] == -1).all()
+    assert out[2, 1] == pytest.approx(0.7)
+    assert (out[3] == -1).all()
+    # per-class: different id -> no cross-class suppression
+    rows2 = rows.copy()
+    rows2[1, 0] = 1
+    out2 = nd.box_nms(nd.array(rows2), overlap_thresh=0.5,
+                      valid_thresh=0.05, id_index=0).asnumpy()
+    assert out2[1, 1] == pytest.approx(0.8)
+    # force_suppress ignores class ids again
+    out3 = nd.box_nms(nd.array(rows2), overlap_thresh=0.5,
+                      valid_thresh=0.05, id_index=0,
+                      force_suppress=True).asnumpy()
+    assert (out3[1] == -1).all()
+
+
+def test_roi_align_constant_and_grad():
+    from mxnet_tpu import autograd
+    x = onp.full((1, 2, 8, 8), 3.5, "f")
+    rois = onp.array([[0, 0, 0, 7, 7]], "f")
+    out = nd.ROIAlign(nd.array(x), nd.array(rois),
+                      pooled_size=(4, 4)).asnumpy()
+    onp.testing.assert_allclose(out, onp.full((1, 2, 4, 4), 3.5),
+                                rtol=1e-5)
+    # differentiable w.r.t. the feature map
+    data = nd.array(_rs.randn(1, 2, 8, 8).astype("f"))
+    data.attach_grad()
+    with autograd.record():
+        y = nd.ROIAlign(data, nd.array(rois), pooled_size=(2, 2))
+        loss = (y * y).sum()
+    loss.backward()
+    assert onp.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_longtail_reachable_via_contrib():
+    assert mx.nd.contrib.box_iou is nd.box_iou
+    assert mx.nd.contrib.ROIAlign is nd.ROIAlign
+    assert mx.nd.contrib.box_nms is nd.box_nms
+
+
+def test_box_nms_topk_truncates_candidates_before_nms():
+    """Upstream semantics: topk truncates the CANDIDATE set by score rank
+    BEFORE suppression — a suppressed candidate still consumes a slot."""
+    rows = onp.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],  # rank 2: suppressed by rank 1
+        [0, 0.7, 5, 5, 7, 7],          # rank 3: beyond topk=2 -> dropped
+    ], "f")
+    out = nd.box_nms(nd.array(rows), overlap_thresh=0.5, topk=2,
+                     valid_thresh=0.05).asnumpy()
+    assert out[0, 1] == pytest.approx(0.9)
+    assert (out[1] == -1).all()
+    assert (out[2] == -1).all(), "rank-3 candidate must not enter NMS"
+
+
+def test_box_nms_out_format_conversion():
+    rows = onp.array([[0, 0.9, 1.0, 1.0, 2.0, 2.0]], "f")  # center format
+    out = nd.box_nms(nd.array(rows), in_format="center",
+                     out_format="corner", valid_thresh=0.05).asnumpy()
+    onp.testing.assert_allclose(out[0, 2:], [0, 0, 2, 2], rtol=1e-5)
+    back = nd.box_nms(nd.array(out), in_format="corner",
+                      out_format="center", valid_thresh=0.05).asnumpy()
+    onp.testing.assert_allclose(back[0, 2:], [1, 1, 2, 2], rtol=1e-5)
+
+
+def test_ps_roi_align():
+    """position_sensitive=True pools bin (i, j) from channel group
+    i*pw + j (R-FCN PS-ROIAlign)."""
+    ph = pw = 2
+    c_out = 3
+    # feature map where channel k has constant value k
+    x = onp.tile(onp.arange(c_out * ph * pw, dtype="f")[None, :, None, None],
+                 (1, 1, 8, 8))
+    rois = onp.array([[0, 0, 0, 7, 7]], "f")
+    out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(ph, pw),
+                      position_sensitive=True).asnumpy()
+    assert out.shape == (1, c_out, ph, pw)
+    for co in range(c_out):
+        for i in range(ph):
+            for j in range(pw):
+                expect = co * ph * pw + i * pw + j
+                assert out[0, co, i, j] == pytest.approx(expect), \
+                    (co, i, j)
