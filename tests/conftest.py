@@ -24,8 +24,8 @@ if os.environ.get("MXNET_TPU_TEST_PLATFORM", "cpu") != "tpu":
 
 import jax  # noqa: E402
 
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_cache")
+_CACHE_DIR = os.environ.get("MXNET_TPU_TEST_CACHE_DIR") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 try:
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -55,6 +55,7 @@ def test_consistency_int_inputs_pass_through():
                       dtypes=["float32", "float16"], rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_battery_runs_on_cpu():
     """The tools/ battery is importable and runs clean on CPU."""
     import importlib.util

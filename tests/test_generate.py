@@ -15,6 +15,7 @@ def _net():
     return net
 
 
+@pytest.mark.slow
 def test_kv_cache_greedy_matches_full_recompute():
     net = _net()
     prompt = onp.random.randint(0, 97, (2, 5)).astype("int32")
@@ -45,6 +46,7 @@ def test_generate_sampling_seeded_and_prompt_preserved():
     assert d.shape == (2, 9)
 
 
+@pytest.mark.slow
 def test_generate_guards():
     net = _net()
     prompt = mx.nd.array(onp.zeros((1, 60)), dtype="int32")

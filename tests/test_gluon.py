@@ -259,6 +259,7 @@ def test_explicit_initializer_honored():
     assert_almost_equal(p.data(), ref)
 
 
+@pytest.mark.slow
 def test_ctc_loss_matches_manual():
     """CTCLoss vs a hand-computed simple alignment case + shape/layout
     checks (parity: gluon.loss.CTCLoss, blank=0)."""
@@ -308,6 +309,7 @@ def test_poisson_nll_loss():
     assert abs(got - expect) < 1e-5
 
 
+@pytest.mark.slow
 def test_model_zoo_upstream_path():
     """mx.gluon.model_zoo.vision.get_model — the GluonCV-era import path."""
     import mxnet_tpu as mx

@@ -166,6 +166,7 @@ def _toy_data(n=96, seed=0):
     return X, y
 
 
+@pytest.mark.slow
 def test_module_train():
     from mxnet_tpu.module import Module
     _, loss = _mlp_symbol()

@@ -28,6 +28,7 @@ def test_attention_causality():
     assert not onp.allclose(out1[:, 4:], out2[:, 4:])
 
 
+@pytest.mark.slow
 def test_gpt2_forward_and_grad():
     net = models.get_gpt2("gpt2_124m", vocab_size=128, units=32,
                           num_layers=2, num_heads=2, max_length=64,
@@ -95,6 +96,7 @@ def test_bert_pretrain_heads():
     assert nsp.shape == (2, 2)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2"])
 def test_resnet_forward(name):
     net = models.get_model(name, classes=10)
@@ -104,6 +106,7 @@ def test_resnet_forward(name):
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet50_structure():
     net = models.vision.resnet50_v1(classes=7)
     net.initialize()

@@ -54,6 +54,7 @@ def test_moe_capacity_drops_tokens():
     assert (onp.abs(y.reshape(32, 8)).sum(-1) == 0).any()
 
 
+@pytest.mark.slow
 def test_moe_eager_autograd_router_grads():
     rs = onp.random.RandomState(2)
     x = nd.array(rs.randn(2, 8, 16).astype("float32"))

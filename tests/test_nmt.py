@@ -23,6 +23,7 @@ def test_nmt_shapes():
     assert out.shape == (2, 5, 40)
 
 
+@pytest.mark.slow
 def test_nmt_decoder_causality():
     """Changing future target tokens must not change earlier logits."""
     net = _tiny()
@@ -63,6 +64,7 @@ def test_nmt_loss_masks_padding():
     assert abs(half - full) > 1e-7
 
 
+@pytest.mark.slow
 def test_nmt_copy_task_convergence():
     """Learn to copy the source sequence — loss drops and greedy decode
     reproduces the source (the minimal seq2seq end-to-end check)."""
@@ -138,6 +140,7 @@ def test_nmt_decoder_remat_matches_plain():
     onp.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_nmt_beam_search_matches_or_beats_greedy():
     """Beam decode must at least match greedy on the trained copy task and
     produce the same tokens for a near-deterministic model."""
@@ -191,6 +194,7 @@ def test_contrib_concurrent_layers():
     assert cnn.Identity is not None and cnn.SyncBatchNorm is not None
 
 
+@pytest.mark.slow
 def test_nmt_bucketed_shapes_share_one_trainer():
     """Variable-length buckets (Sockeye's bucketing discipline): one
     ShardedTrainer serves multiple sequence lengths — each bucket shape

@@ -148,6 +148,7 @@ def test_box_nms_suppresses_overlaps():
     assert (out3[1] == -1).all()
 
 
+@pytest.mark.slow
 def test_roi_align_constant_and_grad():
     from mxnet_tpu import autograd
     x = onp.full((1, 2, 8, 8), 3.5, "f")
@@ -215,3 +216,49 @@ def test_ps_roi_align():
                 expect = co * ph * pw + i * pw + j
                 assert out[0, co, i, j] == pytest.approx(expect), \
                     (co, i, j)
+
+
+def test_conv_pool_nhwc_layout_matches_nchw():
+    """layout='NHWC' conv/pool must agree with the NCHW path (same
+    (O, I, kH, kW) weights — checkpoints are layout-portable; upstream
+    convolution.cc accepts NHWC too).  TPU-first: channels-last puts C
+    on the lane dim so the conv needs no edge transposes."""
+    from mxnet_tpu.gluon import nn as gnn
+
+    x = _rs.randn(2, 3, 8, 8).astype("f")
+    conv = gnn.Conv2D(5, kernel_size=3, padding=1, strides=2,
+                      layout="NHWC")
+    conv.initialize()
+    out_nhwc = conv(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    ref = nd.Convolution(
+        nd.array(x), conv.weight.data(), conv.bias.data(),
+        kernel=(3, 3), num_filter=5, pad=(1, 1), stride=(2, 2)).asnumpy()
+    onp.testing.assert_allclose(out_nhwc.transpose(0, 3, 1, 2), ref,
+                                rtol=1e-4, atol=1e-5)
+
+    pool = gnn.MaxPool2D(2, 2, layout="NHWC")
+    p_nhwc = pool(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    p_ref = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max").asnumpy()
+    onp.testing.assert_allclose(p_nhwc.transpose(0, 3, 1, 2), p_ref,
+                                rtol=1e-6)
+    gp = gnn.GlobalAvgPool2D(layout="NHWC")
+    g_nhwc = gp(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    onp.testing.assert_allclose(g_nhwc[:, 0, 0], x.mean(axis=(2, 3)),
+                                rtol=1e-5)
+
+
+def test_layout_validation():
+    from mxnet_tpu import base as _base
+    from mxnet_tpu.gluon import nn as gnn
+
+    x = nd.array(_rs.randn(1, 4, 4, 3).astype("f"))
+    with pytest.raises(_base.MXNetError):
+        nd.Pooling(x, kernel=(2, 2), layout="NHCW")     # typo layout
+    with pytest.raises(_base.MXNetError):
+        nd.Pooling(x, kernel=(2,), layout="NWC")        # ndim mismatch
+    with pytest.raises(_base.MXNetError):
+        gnn.Conv2DTranspose(4, 3, layout="NHWC")        # unsupported
+    with pytest.raises(_base.MXNetError):
+        nd.Convolution(x, nd.zeros((2, 3, 3, 3)), kernel=(3, 3),
+                       num_filter=2, layout="NHCW")

@@ -230,6 +230,7 @@ def test_quantized_pooling_triple():
         assert onp.abs(deq - ref).max() < 0.05
 
 
+@pytest.mark.slow
 def test_quantized_resnet18_top1_delta():
     """VERDICT #4 done-criterion: quantize_net on resnet18 runs int8 convs
     with int32 accumulation and keeps top-1 within 1% of fp32 on a

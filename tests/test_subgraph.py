@@ -38,6 +38,7 @@ def test_builtin_backends_registered():
         optimize_for(_conv_bn_net(), "NO_SUCH_BACKEND")
 
 
+@pytest.mark.slow
 def test_fuse_bn_preserves_outputs():
     rs = onp.random.RandomState(0)
     net = _conv_bn_net()
@@ -54,6 +55,7 @@ def test_fuse_bn_preserves_outputs():
     assert net[0].bias is not None
 
 
+@pytest.mark.slow
 def test_optimize_for_block_api():
     """HybridBlock.optimize_for(backend=...) rewrites + hybridizes."""
     rs = onp.random.RandomState(1)
@@ -101,6 +103,7 @@ def test_custom_backend_registration():
 
 
 
+@pytest.mark.slow
 def test_fuse_bn_dense():
     rs = onp.random.RandomState(3)
     net = nn.HybridSequential()

@@ -82,6 +82,7 @@ def test_softmax_output_backward_is_p_minus_onehot():
                                 rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_module_fit_with_classic_symbol():
     """The full 1.x idiom: auto-var symbol + SoftmaxOutput + Module.fit
     (with the upstream rescale_grad=1/batch default)."""
@@ -170,6 +171,7 @@ def test_loss_head_label_shape_inferred():
     assert tuple(ex.arg_dict["softmax_label"].shape) == (32,)
 
 
+@pytest.mark.slow
 def test_batchnorm_module_train_updates_moving_stats():
     """Symbolic BN: training updates moving stats (batch_norm.cc's aux
     mutation) so inference normalizes correctly — val accuracy survives

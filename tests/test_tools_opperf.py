@@ -59,6 +59,7 @@ def test_parse_log_speedometer_lines(tmp_path):
         12.34)
 
 
+@pytest.mark.slow
 def test_launch_local_spawns_workers(tmp_path):
     sys.path.insert(0, os.path.join(_ROOT, "tools"))
     import launch
