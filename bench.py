@@ -394,6 +394,10 @@ def main():
     ap.add_argument("--workload", default="gpt2",
                     choices=["gpt2", "gpt2_long", "resnet50", "resnet50_io",
                              "bert", "nmt", "all"])
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of each workload "
+                         "into DIR (for the on-chip where-does-time-go "
+                         "analysis)")
     args = ap.parse_args()
 
     platform = _init_platform()
@@ -408,8 +412,18 @@ def main():
     table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
              "resnet50": bench_resnet50, "resnet50_io": bench_resnet50_io,
              "bert": bench_bert, "nmt": bench_nmt}
+    import contextlib
+    import os
     for name in names:
-        rec = table[name](on_tpu)
+        if args.profile:
+            import jax
+            d = os.path.join(args.profile, name)
+            os.makedirs(d, exist_ok=True)
+            cm = jax.profiler.trace(d)
+        else:
+            cm = contextlib.nullcontext()
+        with cm:
+            rec = table[name](on_tpu)
         print(json.dumps(rec), flush=True)
 
 

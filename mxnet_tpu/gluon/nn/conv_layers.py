@@ -35,6 +35,11 @@ class _Conv(HybridBlock):
         self._dilation = _tuplify(dilation, ndim)
         self._groups = groups
         self._layout = layout
+        if transpose and layout in ("NWC", "NHWC", "NDHWC"):
+            from ... import base as _base
+            raise _base.MXNetError(
+                "channels-last layout is not supported for transpose "
+                "convolutions (Deconvolution runs NCHW)")
         self._activation = activation
         self._ndim = ndim
         self._transpose = transpose
@@ -52,7 +57,8 @@ class _Conv(HybridBlock):
             allow_deferred_init=True) if use_bias else None
 
     def infer_shape(self, x, *args):
-        c_in = x.shape[1]
+        c_in = x.shape[-1] if self._layout in ("NWC", "NHWC", "NDHWC") \
+            else x.shape[1]
         if self._transpose:
             self.weight._set_shape(
                 (c_in, self._channels // self._groups) + self._kernel)
@@ -74,7 +80,7 @@ class _Conv(HybridBlock):
                 x, weight, bias, kernel=self._kernel, stride=self._strides,
                 dilate=self._dilation, pad=self._padding,
                 num_filter=self._channels, num_group=self._groups,
-                no_bias=bias is None)
+                no_bias=bias is None, layout=self._layout)
         if self._activation:
             out = F.Activation(out, act_type=self._activation)
         return out
@@ -167,6 +173,7 @@ class _Pool(HybridBlock):
         self._ceil = ceil_mode
         self._global = global_pool
         self._pool_type = pool_type
+        self._layout = layout
         self._count_include_pad = count_include_pad
 
     def hybrid_forward(self, F, x):
@@ -175,7 +182,8 @@ class _Pool(HybridBlock):
             global_pool=self._global, stride=self._strides,
             pad=self._padding,
             pooling_convention="full" if self._ceil else "valid",
-            count_include_pad=self._count_include_pad)
+            count_include_pad=self._count_include_pad,
+            layout=self._layout)
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "

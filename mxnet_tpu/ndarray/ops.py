@@ -1002,20 +1002,35 @@ def _embedding_sparse_grad(data, weight, f):
     return res
 
 
-def _conv_dim_numbers(ndim):
-    if ndim == 3:
-        return ("NCH", "OIH", "NCH")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    return ("NCDHW", "OIDHW", "NCDHW")
+def _conv_dim_numbers(ndim, layout=None):
+    """MXNet layout string → lax dimension numbers.  Weights stay in the
+    upstream (O, I, kH, kW) layout for BOTH data layouts so checkpoints
+    are layout-portable; XLA relaids them internally."""
+    if layout in (None, "NCW", "NCHW", "NCDHW"):
+        if ndim == 3:
+            return ("NCH", "OIH", "NCH")
+        if ndim == 4:
+            return ("NCHW", "OIHW", "NCHW")
+        return ("NCDHW", "OIDHW", "NCDHW")
+    if layout == "NWC" and ndim == 3:
+        return ("NHC", "OIH", "NHC")
+    if layout == "NHWC" and ndim == 4:
+        # feature dim last = TPU lane dim: the conv needs no edge
+        # transposes (src/operator/nn/convolution.cc accepts NHWC too)
+        return ("NHWC", "OIHW", "NHWC")
+    if layout == "NDHWC" and ndim == 5:
+        return ("NDHWC", "OIDHW", "NDHWC")
+    raise _base.MXNetError(f"unsupported conv layout {layout!r} for "
+                           f"{ndim}-d input")
 
 
 @_export
 def Convolution(data, weight, bias=None, kernel=None, stride=None,
                 dilate=None, pad=None, num_filter=None, num_group=1,
                 no_bias=False, layout=None, **kw):
-    """Parity: src/operator/nn/convolution.cc — NCHW layout, (O,I,kH,kW)
-    weights.  Lowers to lax.conv_general_dilated → MXU."""
+    """Parity: src/operator/nn/convolution.cc — NCHW default or NHWC via
+    ``layout`` (TPU-preferred: channels on the lane dim), (O,I,kH,kW)
+    weights either way.  Lowers to lax.conv_general_dilated → MXU."""
     data = _as_nd(data)
     weight = _as_nd(weight)
     nds = [data, weight]
@@ -1026,7 +1041,8 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
     stride = tuple(stride) if stride else (1,) * nd_spatial
     dilate = tuple(dilate) if dilate else (1,) * nd_spatial
     pad_ = tuple(pad) if pad else (0,) * nd_spatial
-    dn = _conv_dim_numbers(data.ndim)
+    dn = _conv_dim_numbers(data.ndim, layout)
+    channels_last = layout in ("NWC", "NHWC", "NDHWC")
 
     def f(x, w, *b):
         # no preferred_element_type: the MXU accumulates bf16 convs in f32
@@ -1038,7 +1054,8 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
             rhs_dilation=dilate, dimension_numbers=dn,
             feature_group_count=num_group)
         if b:
-            bshape = (1, -1) + (1,) * nd_spatial
+            bshape = ((1,) + (1,) * nd_spatial + (-1,)) if channels_last \
+                else ((1, -1) + (1,) * nd_spatial)
             y = y + b[0].reshape(bshape)
         return y
 
@@ -1091,33 +1108,51 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
 @_export
 def Pooling(data, kernel=None, pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
-            count_include_pad=True, **kw):
-    """Parity: src/operator/nn/pooling.cc (max/avg/sum/lp)."""
+            count_include_pad=True, layout=None, **kw):
+    """Parity: src/operator/nn/pooling.cc (max/avg/sum/lp); NCHW default
+    or channels-last via ``layout`` (NWC/NHWC/NDHWC)."""
     data = _as_nd(data)
     nd_spatial = data.ndim - 2
+    _LAYOUT_NDIM = {"NCW": 3, "NWC": 3, "NCHW": 4, "NHWC": 4,
+                    "NCDHW": 5, "NDHWC": 5}
+    if layout is not None:
+        if layout not in _LAYOUT_NDIM:
+            raise _base.MXNetError(f"unsupported pooling layout {layout!r}")
+        if _LAYOUT_NDIM[layout] != data.ndim:
+            raise _base.MXNetError(
+                f"pooling layout {layout!r} expects "
+                f"{_LAYOUT_NDIM[layout]}-d input, got {data.ndim}-d")
+    channels_last = layout in ("NWC", "NHWC", "NDHWC")
+    sp0 = 1 if channels_last else 2          # first spatial axis
 
     def f(x):
         if global_pool:
-            axes = tuple(range(2, x.ndim))
+            axes = tuple(range(sp0, sp0 + nd_spatial))
             if pool_type == "max":
                 return jnp.max(x, axis=axes, keepdims=True)
             return jnp.mean(x, axis=axes, keepdims=True)
         k = tuple(kernel)
         s = tuple(stride) if stride else k
         p = tuple(pad) if pad else (0,) * nd_spatial
-        window = (1, 1) + k
-        strides = (1, 1) + s
+
+        def lay(spatial, fill):
+            sp = list(spatial)
+            return ((fill, *sp, fill) if channels_last
+                    else (fill, fill, *sp))
+
+        window = lay(k, 1)
+        strides = lay(s, 1)
         if pooling_convention == "full":
             # ceil-mode: pad upper side enough for a final partial window
-            pads = [(0, 0), (0, 0)]
+            sp_pads = []
             for i in range(nd_spatial):
-                in_sz = x.shape[2 + i] + 2 * p[i]
+                in_sz = x.shape[sp0 + i] + 2 * p[i]
                 out_sz = int(math.ceil((in_sz - k[i]) / s[i])) + 1
                 need = (out_sz - 1) * s[i] + k[i] - in_sz
-                pads.append((p[i], p[i] + builtins.max(need, 0)))
+                sp_pads.append((p[i], p[i] + builtins.max(need, 0)))
         else:
-            pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
-        pads = tuple(pads)
+            sp_pads = [(pi, pi) for pi in p]
+        pads = tuple(lay(sp_pads, (0, 0)))
         if pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
                 jnp.iinfo(x.dtype).min
