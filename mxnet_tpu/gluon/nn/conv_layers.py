@@ -35,7 +35,8 @@ class _Conv(HybridBlock):
         self._dilation = _tuplify(dilation, ndim)
         self._groups = groups
         self._layout = layout
-        if transpose and layout in ("NWC", "NHWC", "NDHWC"):
+        from ...ndarray.ops import _CHANNELS_LAST_LAYOUTS
+        if transpose and layout in _CHANNELS_LAST_LAYOUTS:
             from ... import base as _base
             raise _base.MXNetError(
                 "channels-last layout is not supported for transpose "
@@ -57,7 +58,8 @@ class _Conv(HybridBlock):
             allow_deferred_init=True) if use_bias else None
 
     def infer_shape(self, x, *args):
-        c_in = x.shape[-1] if self._layout in ("NWC", "NHWC", "NDHWC") \
+        from ...ndarray.ops import _CHANNELS_LAST_LAYOUTS
+        c_in = x.shape[-1] if self._layout in _CHANNELS_LAST_LAYOUTS \
             else x.shape[1]
         if self._transpose:
             self.weight._set_shape(

@@ -1002,6 +1002,9 @@ def _embedding_sparse_grad(data, weight, f):
     return res
 
 
+_CHANNELS_LAST_LAYOUTS = ("NWC", "NHWC", "NDHWC")
+
+
 def _conv_dim_numbers(ndim, layout=None):
     """MXNet layout string → lax dimension numbers.  Weights stay in the
     upstream (O, I, kH, kW) layout for BOTH data layouts so checkpoints
@@ -1042,7 +1045,7 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
     dilate = tuple(dilate) if dilate else (1,) * nd_spatial
     pad_ = tuple(pad) if pad else (0,) * nd_spatial
     dn = _conv_dim_numbers(data.ndim, layout)
-    channels_last = layout in ("NWC", "NHWC", "NDHWC")
+    channels_last = layout in _CHANNELS_LAST_LAYOUTS
 
     def f(x, w, *b):
         # no preferred_element_type: the MXU accumulates bf16 convs in f32
@@ -1066,6 +1069,10 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
 def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
                   dilate=None, pad=None, adj=None, num_filter=None,
                   num_group=1, no_bias=True, layout=None, **kw):
+    if layout in _CHANNELS_LAST_LAYOUTS:
+        raise _base.MXNetError(
+            "channels-last layout is not supported for Deconvolution "
+            "(runs NCHW)")
     data, weight = _as_nd(data), _as_nd(weight)
     nds = [data, weight]
     has_bias = bias is not None and not no_bias
@@ -1122,7 +1129,7 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
             raise _base.MXNetError(
                 f"pooling layout {layout!r} expects "
                 f"{_LAYOUT_NDIM[layout]}-d input, got {data.ndim}-d")
-    channels_last = layout in ("NWC", "NHWC", "NDHWC")
+    channels_last = layout in _CHANNELS_LAST_LAYOUTS
     sp0 = 1 if channels_last else 2          # first spatial axis
 
     def f(x):

@@ -219,13 +219,17 @@ class _RowSparseCot:
     __radd__ = __add__
 
 
+def _csr_row_ids(indptr, nnz):
+    """Row id per nnz entry from the CSR indptr (searchsorted over the
+    nnz positions)."""
+    return jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right")
+
+
 def _csr_to_dense(data, indices, indptr, shape):
-    n_rows = shape[0]
-    # row id per nnz from indptr (searchsorted over the nnz positions)
     nnz = data.shape[0]
     if nnz == 0:
         return jnp.zeros(shape, data.dtype)
-    rows = jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right")
+    rows = _csr_row_ids(indptr, nnz)
     dense = jnp.zeros(shape, data.dtype)
     return dense.at[rows, indices].set(data)
 
@@ -311,24 +315,38 @@ def empty(stype, shape, ctx=None, dtype="float32"):
 # --------------------------------------------------------------- operators
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
-    """Sparse-aware dot.  csr·dense uses gather+segment-sum (XLA-native);
-    everything else goes through the dense path."""
-    if isinstance(lhs, CSRNDArray) and not transpose_a \
-            and isinstance(rhs, NDArray) and not isinstance(rhs,
-                                                            BaseSparseNDArray):
+    """Sparse-aware dot.  csr·dense (and csrᵀ·dense — the gradient/
+    embedding-bag direction) use gather+segment-sum (XLA-native) WITHOUT
+    densifying the csr side, dispatched through ``invoke`` so the dense
+    operand gets a normal autograd pullback (the classic MXNet pattern:
+    csr features are data, the dense rhs is the parameter).  Everything
+    else goes through the dense path."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
+            not isinstance(rhs, BaseSparseNDArray):
+        from . import ops as _ops
         data, indices, indptr = (lhs._sp_data, lhs._sp_indices,
                                  lhs._sp_indptr)
         nnz = data.shape[0]
-        n_rows = lhs._sp_shape[0]
-        r = rhs.jax
-        if transpose_b:
-            r = r.T
+        n_rows, n_cols = lhs._sp_shape
+        out_rows = n_cols if transpose_a else n_rows
         if nnz == 0:
-            return from_jax(jnp.zeros((n_rows, r.shape[1]), data.dtype))
-        rows = jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right")
-        gathered = r[indices] * data[:, None]       # (nnz, N)
-        out = jax.ops.segment_sum(gathered, rows, num_segments=n_rows)
-        return from_jax(out)
+            out_cols = rhs.shape[0] if transpose_b else rhs.shape[1]
+            return from_jax(jnp.zeros((out_rows, out_cols), data.dtype))
+        rows = _csr_row_ids(indptr, nnz)
+
+        def f(r):
+            if transpose_b:
+                r = r.T
+            if transpose_a:
+                # outᵀ[j] = Σ_{k: col(k)=j} data[k] * r[row(k)]
+                gathered = r[rows] * data[:, None]
+                return jax.ops.segment_sum(gathered, indices,
+                                           num_segments=n_cols)
+            gathered = r[indices] * data[:, None]   # (nnz, N)
+            return jax.ops.segment_sum(gathered, rows,
+                                       num_segments=n_rows)
+
+        return _ops.invoke("sparse_dot", f, [rhs])
     from . import ops as _ops
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     rr = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
@@ -336,6 +354,15 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
 
 
 def sparse_add(lhs, rhs):
+    """Elementwise add; RowSparse + RowSparse stays COMPACT (merged
+    unique rows), anything else goes dense."""
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray) and \
+            lhs._sp_shape == rhs._sp_shape:
+        cot = _RowSparseCot(lhs._sp_data, lhs._sp_indices, lhs._sp_shape) \
+            + _RowSparseCot(rhs._sp_data, rhs._sp_indices, rhs._sp_shape)
+        return RowSparseNDArray.from_components(
+            cot.data, cot.indices, cot.shape, ctx=lhs.context)
     from . import ops as _ops
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs

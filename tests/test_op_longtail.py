@@ -262,3 +262,11 @@ def test_layout_validation():
     with pytest.raises(_base.MXNetError):
         nd.Convolution(x, nd.zeros((2, 3, 3, 3)), kernel=(3, 3),
                        num_filter=2, layout="NHCW")
+
+
+def test_deconvolution_rejects_channels_last():
+    from mxnet_tpu import base as _base
+    x = nd.array(_rs.randn(1, 4, 4, 3).astype("f"))
+    with pytest.raises(_base.MXNetError):
+        nd.Deconvolution(x, nd.zeros((3, 2, 2, 2)), kernel=(2, 2),
+                         num_filter=2, layout="NHWC")

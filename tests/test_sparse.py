@@ -343,3 +343,42 @@ def test_row_sparse_pull_rejects_out_of_range():
     out = sp.zeros("row_sparse", (10, 2))
     with pytest.raises(_base.MXNetError):
         kv.row_sparse_pull(9, out=out, row_ids=nd.array([99]))
+
+
+def test_csr_dot_transpose_and_grad():
+    """csrᵀ·dense matches the dense path, and the dense rhs gets an
+    autograd pullback without densifying the csr operand (the classic
+    sparse-features + dense-weights training pattern)."""
+    from mxnet_tpu import autograd
+
+    dense = _rand_csr((5, 7), 0.4, seed=3)
+    a = sparse.csr_matrix(dense)
+    w = nd.array(onp.random.RandomState(4).randn(5, 2).astype("f"))
+    out_t = sparse.dot(a, w, transpose_a=True).asnumpy()
+    onp.testing.assert_allclose(out_t, dense.T @ w.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+    # gradient through the rhs
+    w2 = nd.array(onp.random.RandomState(5).randn(7, 3).astype("f"))
+    w2.attach_grad()
+    with autograd.record():
+        y = sparse.dot(a, w2)
+        loss = (y * y).sum()
+    loss.backward()
+    y_np = dense @ w2.asnumpy()
+    expect = 2 * dense.T @ y_np
+    onp.testing.assert_allclose(w2.grad.asnumpy(), expect,
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_add_row_sparse_stays_compact():
+    a = sparse.row_sparse_array(
+        (onp.ones((2, 3), "f"), onp.array([1, 4])), shape=(8, 3))
+    b = sparse.row_sparse_array(
+        (2 * onp.ones((2, 3), "f"), onp.array([4, 6])), shape=(8, 3))
+    c = sparse.sparse_add(a, b)
+    assert isinstance(c, sparse.RowSparseNDArray)
+    assert c._data is None, "compact add must not densify"
+    assert onp.asarray(c._sp_indices).tolist() == [1, 4, 6]
+    ref = a.asnumpy() + b.asnumpy()
+    onp.testing.assert_allclose(c.asnumpy(), ref)
