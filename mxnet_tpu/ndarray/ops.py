@@ -1010,6 +1010,10 @@ def _conv_dim_numbers(ndim, layout=None):
     upstream (O, I, kH, kW) layout for BOTH data layouts so checkpoints
     are layout-portable; XLA relaids them internally."""
     if layout in (None, "NCW", "NCHW", "NCDHW"):
+        if layout is not None and len(layout) != ndim:
+            raise _base.MXNetError(
+                f"conv layout {layout!r} expects {len(layout)}-d input, "
+                f"got {ndim}-d")
         if ndim == 3:
             return ("NCH", "OIH", "NCH")
         if ndim == 4:
@@ -1069,11 +1073,12 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None,
 def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
                   dilate=None, pad=None, adj=None, num_filter=None,
                   num_group=1, no_bias=True, layout=None, **kw):
+    data, weight = _as_nd(data), _as_nd(weight)
+    _conv_dim_numbers(data.ndim, layout)   # validate the layout string
     if layout in _CHANNELS_LAST_LAYOUTS:
         raise _base.MXNetError(
             "channels-last layout is not supported for Deconvolution "
             "(runs NCHW)")
-    data, weight = _as_nd(data), _as_nd(weight)
     nds = [data, weight]
     has_bias = bias is not None and not no_bias
     if has_bias:

@@ -328,10 +328,9 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
                                  lhs._sp_indptr)
         nnz = data.shape[0]
         n_rows, n_cols = lhs._sp_shape
-        out_rows = n_cols if transpose_a else n_rows
-        if nnz == 0:
-            out_cols = rhs.shape[0] if transpose_b else rhs.shape[1]
-            return from_jax(jnp.zeros((out_rows, out_cols), data.dtype))
+        # nnz == 0 flows through the same invoke path (empty gather +
+        # segment_sum = zeros) so the output is ALWAYS on the tape — an
+        # all-empty batch must not silently skip the grad edge
         rows = _csr_row_ids(indptr, nnz)
 
         def f(r):

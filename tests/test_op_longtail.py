@@ -270,3 +270,13 @@ def test_deconvolution_rejects_channels_last():
     with pytest.raises(_base.MXNetError):
         nd.Deconvolution(x, nd.zeros((3, 2, 2, 2)), kernel=(2, 2),
                          num_filter=2, layout="NHWC")
+
+
+def test_deconvolution_layout_validation():
+    from mxnet_tpu import base as _base
+    x = nd.array(_rs.randn(1, 3, 4, 4).astype("f"))
+    w = nd.zeros((3, 2, 2, 2))
+    with pytest.raises(_base.MXNetError):
+        nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2, layout="NHCW")
+    with pytest.raises(_base.MXNetError):
+        nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2, layout="NCW")

@@ -382,3 +382,19 @@ def test_sparse_add_row_sparse_stays_compact():
     assert onp.asarray(c._sp_indices).tolist() == [1, 4, 6]
     ref = a.asnumpy() + b.asnumpy()
     onp.testing.assert_allclose(c.asnumpy(), ref)
+
+
+def test_csr_dot_empty_batch_stays_on_tape():
+    """An all-empty csr batch must still produce a tape-connected output
+    (zero grads, not a crash or a stale gradient)."""
+    from mxnet_tpu import autograd
+
+    empty = sparse.zeros("csr", (4, 6))
+    w = nd.array(onp.ones((6, 2), "float32"))
+    w.attach_grad()
+    with autograd.record():
+        y = sparse.dot(empty, w)
+        loss = (y * y).sum()
+    loss.backward()
+    onp.testing.assert_array_equal(y.asnumpy(), onp.zeros((4, 2)))
+    onp.testing.assert_array_equal(w.grad.asnumpy(), onp.zeros((6, 2)))
