@@ -2298,3 +2298,188 @@ def ROIAlign(data, rois, pooled_size=None, spatial_scale=1.0,
         return jax.vmap(one)(r)
 
     return invoke("ROIAlign", f, [data, rois])
+
+
+# ---- SSD MultiBox triad (parity: src/operator/contrib/multibox_prior.cc,
+#      multibox_target.cc, multibox_detection.cc — the GluonCV-era SSD ops)
+
+
+@_export
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchor boxes per feature-map pixel → (1, H*W*A, 4) corners in
+    [0, 1], A = len(sizes) + len(ratios) - 1 (all sizes at ratio[0], then
+    size[0] at the remaining ratios — upstream's enumeration)."""
+    data = _as_nd(data)
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+
+    def f(x):
+        h, w = x.shape[2], x.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+        wh = []
+        for s in sizes:
+            r = ratios[0]
+            wh.append((s * math.sqrt(r), s / math.sqrt(r)))
+        for r in ratios[1:]:
+            s = sizes[0]
+            wh.append((s * math.sqrt(r), s / math.sqrt(r)))
+        wh_j = jnp.asarray(wh, jnp.float32)              # (A, 2)
+        cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")   # (H, W)
+        centers = jnp.stack([cxx, cyy], axis=-1).reshape(-1, 1, 2)
+        half = wh_j[None, :, :] / 2.0                    # (1, A, 2)
+        mins = centers - half                            # (HW, A, 2)
+        maxs = centers + half
+        out = jnp.concatenate([mins, maxs], axis=-1).reshape(1, -1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return invoke("MultiBoxPrior", f, [data])
+
+
+def _corner_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    return (b[..., 0] + w / 2, b[..., 1] + h / 2, w, h)
+
+
+@_export
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5,
+                   variances=(0.1, 0.1, 0.2, 0.2), **kw):
+    """SSD training targets: match anchors to GT boxes and encode offsets
+    (parity: multibox_target.cc).  label is (B, M, 5) [cls, x1, y1, x2,
+    y2] with -1 padding rows.  Returns (loc_target (B, A*4), loc_mask
+    (B, A*4), cls_target (B, A)) — cls_target 0 = background, k+1 = GT
+    class k."""
+    anchor, label, cls_pred = (_as_nd(anchor), _as_nd(label),
+                               _as_nd(cls_pred))
+    nds = [anchor, label, cls_pred]
+    v = tuple(float(x) for x in variances)
+
+    def f(anc, lab, cp):
+        a = anc.reshape(-1, 4)                           # (A, 4)
+        na = a.shape[0]
+
+        def one(rows, cpb):
+            m_gt = rows.shape[0]
+            valid = rows[:, 0] >= 0                      # (M,)
+            gt = rows[:, 1:5]                            # (M, 4)
+            ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+            gx1, gy1, gx2, gy2 = (gt[:, 0], gt[:, 1], gt[:, 2], gt[:, 3])
+            iw = jnp.maximum(jnp.minimum(ax2[:, None], gx2[None]) -
+                             jnp.maximum(ax1[:, None], gx1[None]), 0)
+            ih = jnp.maximum(jnp.minimum(ay2[:, None], gy2[None]) -
+                             jnp.maximum(ay1[:, None], gy1[None]), 0)
+            inter = iw * ih
+            area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+            area_g = jnp.maximum(gx2 - gx1, 0) * jnp.maximum(gy2 - gy1, 0)
+            iou = inter / jnp.maximum(
+                area_a[:, None] + area_g[None] - inter, 1e-12)
+            iou = jnp.where(valid[None, :], iou, -1.0)   # (A, M)
+
+            best_gt = jnp.argmax(iou, axis=1)            # per anchor
+            best_iou = jnp.max(iou, axis=1)
+            # force-match: each VALID GT claims its best anchor; padding
+            # rows scatter into a spill slot so they cannot clobber a real
+            # GT's forced match (duplicate-index .at[].set is unordered)
+            best_anchor = jnp.argmax(iou, axis=0)        # (M,)
+            scatter_to = jnp.where(valid, best_anchor, na)
+            forced = jnp.zeros((na + 1,), bool).at[
+                scatter_to].set(True)[:na]
+            forced_gt = jnp.zeros((na + 1,), jnp.int32).at[
+                scatter_to].set(jnp.arange(m_gt, dtype=jnp.int32))[:na]
+            matched = forced | (best_iou >= overlap_threshold)
+            gt_idx = jnp.where(forced, forced_gt, best_gt)
+
+            cls_t = jnp.where(
+                matched, rows[gt_idx, 0].astype(jnp.float32) + 1.0, 0.0)
+            if negative_mining_ratio > 0:
+                # hard negative mining (multibox_target.cc): keep only the
+                # top ratio*n_pos hardest negatives as background; the
+                # rest are ignore_label and drop out of the cls loss
+                neg_cand = (~matched) & \
+                    (best_iou < negative_mining_thresh)
+                hardness = jnp.max(cpb[1:, :], axis=0)   # max fg score
+                hardness = jnp.where(neg_cand, hardness, -jnp.inf)
+                n_pos = jnp.sum(matched.astype(jnp.int32))
+                k = jnp.minimum(
+                    (negative_mining_ratio * n_pos).astype(jnp.int32),
+                    jnp.sum(neg_cand.astype(jnp.int32)))
+                order = jnp.argsort(-hardness)
+                rank = jnp.zeros((na,), jnp.int32).at[order].set(
+                    jnp.arange(na, dtype=jnp.int32))
+                mined = neg_cand & (rank < k)
+                cls_t = jnp.where(matched, cls_t,
+                                  jnp.where(mined, 0.0,
+                                            float(ignore_label)))
+            acx, acy, aw, ah = _corner_to_center(a)
+            m = gt[gt_idx]
+            gcx, gcy, gw, gh = _corner_to_center(m)
+            lt = jnp.stack([
+                (gcx - acx) / jnp.maximum(aw, 1e-12) / v[0],
+                (gcy - acy) / jnp.maximum(ah, 1e-12) / v[1],
+                jnp.log(jnp.maximum(gw, 1e-12) /
+                        jnp.maximum(aw, 1e-12)) / v[2],
+                jnp.log(jnp.maximum(gh, 1e-12) /
+                        jnp.maximum(ah, 1e-12)) / v[3]], axis=1)
+            mask = matched.astype(jnp.float32)[:, None]
+            return (lt * mask).reshape(-1), \
+                jnp.broadcast_to(mask, (na, 4)).reshape(-1), cls_t
+
+        lt, lm, ct = jax.vmap(one)(lab, cp)
+        return lt, lm, ct
+
+    return invoke("MultiBoxTarget", f, nds, nout=3, differentiable=False)
+
+
+@_export
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True,
+                      threshold=0.01, nms_threshold=0.5,
+                      force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **kw):
+    """Decode SSD predictions and suppress duplicates (parity:
+    multibox_detection.cc).  cls_prob (B, C+1, A) with class 0 =
+    background; returns (B, A, 6) rows [cls_id, score, x1, y1, x2, y2],
+    suppressed rows -1."""
+    cls_prob, loc_pred, anchor = (_as_nd(cls_prob), _as_nd(loc_pred),
+                                  _as_nd(anchor))
+    v = tuple(float(x) for x in variances)
+
+    def f(cp, lp, anc):
+        a = anc.reshape(-1, 4)
+        acx, acy, aw, ah = _corner_to_center(a)
+
+        def one(cpb, lpb):
+            loc = lpb.reshape(-1, 4)
+            cx = loc[:, 0] * v[0] * aw + acx
+            cy = loc[:, 1] * v[1] * ah + acy
+            w = jnp.exp(loc[:, 2] * v[2]) * aw
+            h = jnp.exp(loc[:, 3] * v[3]) * ah
+            boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                               cx + w / 2, cy + h / 2], axis=1)
+            if clip:
+                boxes = jnp.clip(boxes, 0.0, 1.0)
+            scores = cpb[1:, :]                          # (C, A)
+            cls_id = jnp.argmax(scores, axis=0)          # (A,)
+            score = jnp.max(scores, axis=0)
+            keep = score > threshold
+            rows = jnp.concatenate([
+                jnp.where(keep, cls_id.astype(jnp.float32), -1.0)[:, None],
+                jnp.where(keep, score, -1.0)[:, None], boxes], axis=1)
+            return rows
+
+        rows = jax.vmap(one)(cp, lp)
+        return rows
+
+    decoded = invoke("MultiBoxDetection_decode", f,
+                     [cls_prob, loc_pred, anchor], differentiable=False)
+    return box_nms(decoded, overlap_thresh=nms_threshold,
+                   valid_thresh=threshold, topk=nms_topk,
+                   coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)

@@ -171,6 +171,7 @@ _SIMPLE_OPS = [
     "corrcoef", "cov", "convolve", "correlate", "interp", "gradient", "diff",
     "ediff1d", "polyval", "polyfit", "vander", "around", "round",
     "gcd", "lcm", "trim_zeros", "apply_along_axis", "apply_over_axes",
+    "divmod", "modf", "block", "cumulative_sum",
     # type utilities
     "result_type", "can_cast", "promote_types", "iinfo", "finfo", "isscalar",
     "ndim", "shape", "size",
@@ -194,6 +195,16 @@ if "in1d" not in globals() and "isin" in globals():
                                 invert=invert)
         return globals()["ravel"](res)
     __all__.append("in1d")
+if "row_stack" not in globals():          # numpy alias jnp dropped
+    row_stack = globals()["vstack"]
+    __all__.append("row_stack")
+if "cumulative_sum" not in globals():     # numpy 2.0 name
+    cumulative_sum = globals()["cumsum"]
+    __all__.append("cumulative_sum")
+round_ = globals()["round"]               # legacy numpy alias
+__all__.append("round_")
+# np.fix == truncate toward zero (jnp.fix is deprecated in favor of trunc)
+fix = _wrap_np_op("fix", _jnp.trunc, differentiable=False)
 
 abs = globals()["abs"]  # noqa: A001 — numpy parity shadows builtin here
 round = globals()["round"]  # noqa: A001

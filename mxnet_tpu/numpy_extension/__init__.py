@@ -92,7 +92,9 @@ _DELEGATED = [
     "broadcast_like", "arange_like", "shape_array", "slice", "slice_axis",
     "slice_like", "sequence_mask", "batch_dot",
     # misc
-    "smooth_l1", "multibox_detection", "sample_multinomial",
+    "smooth_l1", "multibox_detection", "multibox_prior",
+    "multibox_target", "sample_multinomial", "batch_flatten",
+    "roi_pooling",
 ]
 
 _ALIAS_TO_ND = {
@@ -111,6 +113,11 @@ _ALIAS_TO_ND = {
     "leaky_relu": "LeakyReLU",
     "l2_normalization": "L2Normalization",
     "sequence_mask": "SequenceMask",
+    "multibox_detection": "MultiBoxDetection",
+    "multibox_prior": "MultiBoxPrior",
+    "multibox_target": "MultiBoxTarget",
+    "batch_flatten": "Flatten",
+    "roi_pooling": "ROIPooling",
 }
 
 for _name in _DELEGATED:
@@ -166,5 +173,131 @@ def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
     return _nd.invoke("masked_softmax", f, nds)
 
 
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    """log-softmax over unmasked positions; masked positions get -inf
+    (parity: npx.masked_log_softmax)."""
+    import jax.numpy as jnp
+
+    nds = [_nd._as_nd(data)]
+    has_mask = mask is not None
+    if has_mask:
+        nds.append(_nd._as_nd(mask))
+
+    def f(x, *m):
+        import jax
+        x = x / temperature
+        if m:
+            x = jnp.where(m[0].astype(bool), x, -1e30)
+        out = x - jax.nn.logsumexp(x, axis=axis, keepdims=True)
+        if m:
+            out = jnp.where(m[0].astype(bool), out, -jnp.inf)
+        return out
+
+    return _nd.invoke("masked_log_softmax", f, nds)
+
+
+def _npx_reshape_shape(in_shape, newshape):
+    """Resolve the MXNet 2.x npx.reshape special codes (parity:
+    NumpyXReshapeInferShape, src/operator/numpy/np_matrix_op.cc):
+    -1 infer, -2 copy input dim, -3 skip a size-1 input dim, -4 copy all
+    remaining input dims, -5 fuse two consecutive input dims, -6 split
+    an input dim into the following two entries (one may be -1), 0 is a
+    literal zero-size dim."""
+    out, i, j = [], 0, 0
+    ns = list(newshape)
+    infer_pos = None
+    while j < len(ns):
+        s = int(ns[j])
+        if s >= 0:
+            out.append(s)
+            i += 1
+        elif s == -1:
+            if infer_pos is not None:
+                raise _base.MXNetError("npx.reshape: at most one -1")
+            infer_pos = len(out)
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.append(in_shape[i])
+            i += 1
+        elif s == -3:
+            if in_shape[i] != 1:
+                raise _base.MXNetError(
+                    f"npx.reshape: -3 skips a size-1 dim, input dim {i} "
+                    f"has size {in_shape[i]}")
+            i += 1
+        elif s == -4:
+            out.extend(in_shape[i:])
+            i = len(in_shape)
+        elif s == -5:
+            out.append(in_shape[i] * in_shape[i + 1])
+            i += 2
+        elif s == -6:
+            a, b = int(ns[j + 1]), int(ns[j + 2])
+            j += 2
+            d = in_shape[i]
+            i += 1
+            if a == -1:
+                a = d // b
+            elif b == -1:
+                b = d // a
+            if a * b != d:
+                raise _base.MXNetError(
+                    f"npx.reshape: cannot split dim of size {d} into "
+                    f"({ns[j - 1]}, {ns[j]})")
+            out.extend([a, b])
+        else:
+            raise _base.MXNetError(
+                f"npx.reshape: unknown special value {s}")
+        j += 1
+    if infer_pos is not None:
+        total = 1
+        for d in in_shape:
+            total *= d
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        out[infer_pos] = total // max(known, 1)
+    return tuple(out)
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """MXNet 2.x npx.reshape — NOT the legacy nd.reshape dialect (the
+    special-value codes differ; see _npx_reshape_shape)."""
+    import jax.numpy as jnp
+
+    a_nd = _nd._as_nd(a)
+    in_shape = tuple(a_nd.shape)
+    if reverse:
+        if any(int(s) == -6 for s in newshape):
+            raise _base.MXNetError(
+                "npx.reshape: reverse=True with -6 is not supported")
+        shape = _npx_reshape_shape(in_shape[::-1],
+                                   list(newshape)[::-1])[::-1]
+    else:
+        shape = _npx_reshape_shape(in_shape, newshape)
+    return _nd.invoke("npx_reshape", lambda x: jnp.reshape(x, shape),
+                      [a_nd])
+
+
+def nonzero(a):
+    """Indices of nonzero elements as an (N, ndim) int64 array (parity:
+    npx.nonzero).  Eager-only: the output shape is data-dependent, so it
+    cannot run inside jit/hybridize traces."""
+    import jax
+    import numpy as onp
+
+    a_nd = _nd._as_nd(a)
+    if isinstance(a_nd.jax, jax.core.Tracer):
+        raise _base.MXNetError(
+            "npx.nonzero has a data-dependent output shape and cannot be "
+            "traced (jit/hybridize); call it eagerly")
+    idx = onp.nonzero(onp.asarray(a_nd.jax))
+    from ..ndarray.ndarray import array as _array
+    return _array(onp.stack(idx, axis=1).astype("int64"), dtype="int64")
+
+
 __all__ += ["save", "load", "waitall", "seed", "cpu", "gpu", "num_gpus",
-            "current_device", "masked_softmax"]
+            "current_device", "masked_softmax", "masked_log_softmax",
+            "nonzero", "reshape"]

@@ -280,3 +280,128 @@ def test_deconvolution_layout_validation():
         nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2, layout="NHCW")
     with pytest.raises(_base.MXNetError):
         nd.Deconvolution(x, w, kernel=(2, 2), num_filter=2, layout="NCW")
+
+
+# -------------------------------------------------- SSD MultiBox triad
+
+def test_multibox_prior_anchors():
+    x = nd.zeros((1, 3, 2, 2))
+    out = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1.0, 2.0))
+    A = 2 + 2 - 1
+    assert out.shape == (1, 2 * 2 * A, 4)
+    a = out.asnumpy()[0]
+    # first anchor of first pixel: size .5 ratio 1 centered at (.25, .25)
+    onp.testing.assert_allclose(a[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # ratio-2 anchor: w = .5*sqrt(2), h = .5/sqrt(2)
+    w = 0.5 * onp.sqrt(2.0)
+    h = 0.5 / onp.sqrt(2.0)
+    onp.testing.assert_allclose(a[2], [0.25 - w / 2, 0.25 - h / 2,
+                                       0.25 + w / 2, 0.25 + h / 2],
+                                rtol=1e-5)
+    clipped = nd.MultiBoxPrior(x, sizes=(0.9,), clip=True).asnumpy()
+    assert clipped.min() >= 0 and clipped.max() <= 1
+
+
+def test_multibox_target_matching_and_encoding():
+    # two anchors: one perfectly on the GT, one far away
+    anchors = nd.array(onp.array([[[0.1, 0.1, 0.4, 0.4],
+                                   [0.6, 0.6, 0.9, 0.9]]], "f"))
+    # one GT of class 2 exactly equal to anchor 0; one padding row
+    label = nd.array(onp.array([[[2, 0.1, 0.1, 0.4, 0.4],
+                                 [-1, 0, 0, 0, 0]]], "f"))
+    cls_pred = nd.zeros((1, 3, 2))
+    lt, lm, ct = nd.MultiBoxTarget(anchors, label, cls_pred)
+    onp.testing.assert_array_equal(ct.asnumpy(), [[3.0, 0.0]])
+    lt = lt.asnumpy().reshape(1, 2, 4)
+    lm = lm.asnumpy().reshape(1, 2, 4)
+    onp.testing.assert_allclose(lt[0, 0], onp.zeros(4), atol=1e-5)
+    onp.testing.assert_array_equal(lm[0], [[1, 1, 1, 1], [0, 0, 0, 0]])
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = nd.array(onp.array([[[0.1, 0.1, 0.4, 0.4],
+                                   [0.11, 0.11, 0.41, 0.41],
+                                   [0.6, 0.6, 0.9, 0.9]]], "f"))
+    # zero offsets -> boxes == anchors
+    loc = nd.zeros((1, 12))
+    # class probs (B, C+1, A): anchor0 strongly class 0, anchor1 weaker
+    # same class (overlaps -> suppressed), anchor2 class 1
+    cp = onp.array([[[0.05, 0.2, 0.1],
+                     [0.9, 0.7, 0.1],
+                     [0.05, 0.1, 0.8]]], "f")
+    out = nd.MultiBoxDetection(nd.array(cp), loc, anchors,
+                               nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    by_cls = {int(r[0]): r for r in kept}
+    onp.testing.assert_allclose(by_cls[0][1], 0.9, rtol=1e-5)
+    onp.testing.assert_allclose(by_cls[0][2:], [0.1, 0.1, 0.4, 0.4],
+                                atol=1e-5)
+    onp.testing.assert_allclose(by_cls[1][1], 0.8, rtol=1e-5)
+
+
+def test_npx_gap_fills():
+    from mxnet_tpu import npx
+    x = nd.array(_rs.randn(2, 3, 4).astype("f"))
+    assert npx.batch_flatten(x).shape == (2, 12)
+    assert npx.multibox_prior is nd.MultiBoxPrior
+    assert npx.roi_pooling is nd.ROIPooling
+    m = nd.array(onp.array([[1, 1, 0]], "f"))
+    ls = npx.masked_log_softmax(nd.array(onp.array([[1., 2., 3.]])),
+                                m).asnumpy()
+    assert onp.isneginf(ls[0, 2])
+    onp.testing.assert_allclose(onp.exp(ls[0, :2]).sum(), 1.0, rtol=1e-5)
+    nz = npx.nonzero(nd.array(onp.array([[1, 0], [0, 2]], "f")))
+    onp.testing.assert_array_equal(nz.asnumpy(), [[0, 0], [1, 1]])
+
+
+def test_multibox_target_force_match_survives_padding():
+    """Padding rows (-1) in the label must not clobber a real GT's
+    force-match (GT below overlap_threshold is matched only via
+    force-matching)."""
+    anchors = nd.array(onp.array([[[0.0, 0.0, 0.35, 0.35],
+                                   [0.6, 0.6, 0.9, 0.9]]], "f"))
+    label = nd.array(onp.array([[[1, 0.05, 0.05, 0.5, 0.5],
+                                 [-1, 0, 0, 0, 0]]], "f"))
+    cls_pred = nd.zeros((1, 3, 2))
+    lt, lm, ct = nd.MultiBoxTarget(anchors, label, cls_pred)
+    onp.testing.assert_array_equal(ct.asnumpy(), [[2.0, 0.0]])
+    assert lm.asnumpy().reshape(2, 4)[0].sum() == 4
+
+
+def test_multibox_target_hard_negative_mining():
+    """negative_mining_ratio keeps only the hardest negatives as
+    background; the rest become ignore_label and drop out of the loss."""
+    anchors = nd.array(onp.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.6, 0.6],
+          [0.7, 0.7, 0.8, 0.8], [0.85, 0.85, 0.95, 0.95]]], "f"))
+    label = nd.array(onp.array([[[0, 0.1, 0.1, 0.4, 0.4]]], "f"))
+    # anchor 2 is the "hardest" negative (highest fg score)
+    cp = onp.zeros((1, 2, 4), "f")
+    cp[0, 1] = [0.0, 0.1, 0.9, 0.2]
+    lt, lm, ct = nd.MultiBoxTarget(anchors, label, nd.array(cp),
+                                   negative_mining_ratio=1.0)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0                      # matched -> class 0 + 1
+    assert ct[2] == 0.0                      # mined hard negative
+    assert ct[1] == -1.0 and ct[3] == -1.0   # ignored easy negatives
+
+
+def test_npx_reshape_2x_dialect():
+    from mxnet_tpu import base as _base
+    from mxnet_tpu import npx
+    x = nd.array(_rs.randn(2, 3, 4).astype("f"))
+    assert npx.reshape(x, (-5, 4)).shape == (6, 4)        # fuse
+    assert npx.reshape(x, (-2, -1)).shape == (2, 12)      # copy + infer
+    assert npx.reshape(x, (-4,)).shape == (2, 3, 4)       # copy rest
+    assert npx.reshape(x, (-6, 1, 2, -4)).shape == (1, 2, 3, 4)  # split
+    assert npx.reshape(x, (-2, -6, -1, 3, -2)).shape == (2, 1, 3, 4)
+    y = nd.array(_rs.randn(1, 5).astype("f"))
+    assert npx.reshape(y, (-3, -2)).shape == (5,)         # skip size-1
+    with pytest.raises(_base.MXNetError):
+        npx.reshape(x, (-3, -2, -2))                      # skip size-3 dim
+    with pytest.raises(_base.MXNetError):
+        npx.reshape(x, (-6, 5, -1, -4))                   # bad split
+    # values preserved
+    onp.testing.assert_array_equal(
+        npx.reshape(x, (-5, 4)).asnumpy(), x.asnumpy().reshape(6, 4))

@@ -45,19 +45,24 @@ def main() -> int:
     md_path = os.path.join(REPO, f"BENCH_TPU_r{args.round:02d}.md")
 
     # bench: run as a subprocess WITHOUT a timeout (a killed TPU client
-    # wedges the tunnel server-side for hours) and stream its output
-    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+    # wedges the tunnel server-side for hours), streaming stdout line by
+    # line so the operator can tell progress from a wedged tunnel
+    cmd = [sys.executable, "-u", os.path.join(REPO, "bench.py"),
            "--workload", args.workload, "--profile", prof_dir]
     print("running:", " ".join(cmd), flush=True)
-    r = subprocess.run(cmd, capture_output=True, text=True)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
     records = []
-    for line in r.stdout.splitlines():
+    for line in proc.stdout:
+        print("bench|", line, end="", flush=True)
         line = line.strip()
         if line.startswith("{"):
             try:
                 records.append(json.loads(line))
             except json.JSONDecodeError:
                 pass
+    stderr_txt = proc.stderr.read()
+    rc = proc.wait()
     battery_out = ""
     if not args.skip_battery:
         rb = subprocess.run(
@@ -70,7 +75,7 @@ def main() -> int:
         for rec in records:
             f.write(json.dumps(rec) + "\n")
         f.write(json.dumps({
-            "summary": True, "ts": stamp, "rc": r.returncode,
+            "summary": True, "ts": stamp, "rc": rc,
             "n_records": len(records),
             "on_tpu": all(rec.get("platform") == "tpu"
                           for rec in records) and bool(records),
@@ -78,7 +83,7 @@ def main() -> int:
 
     lines = [f"# On-chip bench evidence — round {args.round}",
              "", f"Captured {stamp}Z by `tools/chip_evidence.py` "
-             f"(bench rc={r.returncode}).", "",
+             f"(bench rc={rc}).", "",
              "| metric | value | unit | vs_baseline | platform | batch |",
              "|---|---|---|---|---|---|"]
     for rec in records:
@@ -88,9 +93,9 @@ def main() -> int:
             f"{rec.get('platform')} | {rec.get('batch', '')} |")
     lines += ["", f"Profiler traces: `{os.path.relpath(prof_dir, REPO)}/"
               "<workload>/` (jax.profiler; open with TensorBoard).", ""]
-    if r.stderr.strip():
+    if stderr_txt.strip():
         lines += ["## bench stderr (tail)", "```",
-                  r.stderr[-2000:], "```", ""]
+                  stderr_txt[-2000:], "```", ""]
     if battery_out:
         lines += ["## cpu-vs-tpu consistency battery", "```",
                   battery_out, "```", ""]
@@ -99,7 +104,7 @@ def main() -> int:
     print(f"wrote {json_path} and {md_path}; commit them", flush=True)
     for rec in records:
         print(json.dumps(rec))
-    return 0 if r.returncode == 0 else 1
+    return 0 if rc == 0 else 1
 
 
 if __name__ == "__main__":
