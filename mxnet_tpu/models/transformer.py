@@ -18,6 +18,8 @@ from ..ops import dot_product_attention
 from ..parallel.sharding import annotate
 from .. import parallel as _par
 
+_WARNED_ULYSSES_FALLBACK = False
+
 
 class MultiHeadAttention(HybridBlock):
     """Self-attention with per-head tensor parallelism.
@@ -29,11 +31,19 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, attention_dropout=0.0,
-                 use_bias=True, causal=False, **kwargs):
+                 use_bias=True, causal=False, seq_parallel=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise ValueError(f"units {units} not divisible by heads "
                              f"{num_heads}")
+        if seq_parallel is None:
+            import os
+            seq_parallel = os.environ.get("MXNET_TPU_SEQ_PARALLEL", "ring")
+        if seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel must be 'ring' or 'ulysses', "
+                f"got {seq_parallel!r}")
+        self._seq_parallel = seq_parallel
         self._units = units
         self._num_heads = num_heads
         self._head_dim = units // num_heads
@@ -73,10 +83,31 @@ class MultiHeadAttention(HybridBlock):
                      and h % _par.axis_size(mesh, "tp") == 0)
         if divisible and mask is None and memory is None \
                 and self._att_dropout == 0.0:
-            # sequence parallel: K/V chunks ride the ICI ring instead of
-            # an all-gather of the full sequence per device
-            from ..ops import nd_ring_attention
-            out = nd_ring_attention(q, k, v, causal=self._causal, mesh=mesh)
+            # sequence parallel: either K/V chunks ride the ICI ring, or
+            # (Ulysses) two all-to-alls re-shard seq<->heads so each
+            # device runs FULL-sequence flash attention on its head group
+            if self._seq_parallel == "ulysses":
+                if (h // _par.axis_size(mesh, "tp")) % sp == 0:
+                    from ..ops import nd_ulysses_attention
+                    out = nd_ulysses_attention(q, k, v,
+                                               causal=self._causal,
+                                               mesh=mesh)
+                else:
+                    global _WARNED_ULYSSES_FALLBACK
+                    if not _WARNED_ULYSSES_FALLBACK:
+                        import logging
+                        logging.warning(
+                            "seq_parallel='ulysses' needs local heads "
+                            "(%d/|tp|) divisible by |sp|=%d; falling "
+                            "back to ring attention", h, sp)
+                        _WARNED_ULYSSES_FALLBACK = True
+                    from ..ops import nd_ring_attention
+                    out = nd_ring_attention(q, k, v, causal=self._causal,
+                                            mesh=mesh)
+            else:
+                from ..ops import nd_ring_attention
+                out = nd_ring_attention(q, k, v, causal=self._causal,
+                                        mesh=mesh)
         else:
             out = dot_product_attention(
                 q, k, v, causal=self._causal, mask=mask,

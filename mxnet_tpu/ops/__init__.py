@@ -12,8 +12,10 @@ from .attention import (dot_product_attention, flash_attention,
                         interleaved_matmul_selfatt_qk,
                         interleaved_matmul_selfatt_valatt)
 from .ring import nd_ring_attention, ring_attention
+from .ulysses import nd_ulysses_attention, ulysses_attention
 
 __all__ = ["dot_product_attention", "flash_attention",
            "interleaved_matmul_selfatt_qk",
            "interleaved_matmul_selfatt_valatt",
-           "nd_ring_attention", "ring_attention"]
+           "nd_ring_attention", "ring_attention",
+           "nd_ulysses_attention", "ulysses_attention"]

@@ -1,0 +1,25 @@
+"""Shared eager-entry scaffold for shard_map'd attention ops (ring,
+ulysses): spread single-device arrays over the mesh, run the mapped
+body, and restore the caller's placement so downstream eager math sees
+a consistent device."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_mapped_qkv"]
+
+
+def shard_mapped_qkv(body, mesh, spec, q, k, v):
+    restore = None
+    if not isinstance(q, jax.core.Tracer):
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, spec)
+        if q.sharding != sh:
+            restore = q.sharding
+        q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    f = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)
+    out = f(q, k, v)
+    if restore is not None:
+        out = jax.device_put(out, restore)
+    return out

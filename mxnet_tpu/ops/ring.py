@@ -100,24 +100,10 @@ def ring_attention(q, k, v, *, causal: bool = False,
             f"ring attention needs tq == tk divisible by |{axis}|={steps}, "
             f"got tq={t}, tk={k.shape[1]}")
     spec = P(batch_axis, axis, heads_axis, None)
-    restore = None
-    if not isinstance(q, jax.core.Tracer):
-        # eager entry: spread single-device arrays over the mesh, and put
-        # the result back afterwards so downstream eager math (residual
-        # adds on the caller's device) sees a consistent placement
-        from jax.sharding import NamedSharding
-        sh = NamedSharding(mesh, spec)
-        if q.sharding != sh:
-            restore = q.sharding
-        q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     body = functools.partial(_ring_local, axis=axis, steps=steps,
                              causal=causal, scale=scale)
-    f = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                      out_specs=spec, check_vma=False)
-    out = f(q, k, v)
-    if restore is not None:
-        out = jax.device_put(out, restore)
-    return out
+    from ._smap import shard_mapped_qkv
+    return shard_mapped_qkv(body, mesh, spec, q, k, v)
 
 
 def nd_ring_attention(query, key, value, *, causal=False, scale=None,

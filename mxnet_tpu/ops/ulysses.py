@@ -1,0 +1,87 @@
+"""Ulysses (all-to-all) sequence parallelism over the ``sp`` mesh axis.
+
+Capability add over the reference (SURVEY.md §5.7: MXNet has no sequence
+parallelism; the survey names ring attention AND all-to-all
+sequence/context parallelism as the two first-class long-context
+strategies).  DeepSpeed-Ulysses recipe: inputs arrive sharded over the
+sequence dim; one ``all_to_all`` re-shards them over the HEAD dim (each
+device receives the FULL sequence for H/sp of the heads), attention runs
+locally — through the Pallas flash kernel on TPU, so the O(T) online-
+softmax memory discipline is preserved at full sequence length — and a
+second ``all_to_all`` restores sequence sharding.
+
+Trade-off vs the ring (ops/ring.py): 2 all-to-alls of the whole
+activation per attention instead of ``sp`` neighbor ppermutes of K/V;
+better when heads are plentiful and ICI all-to-all bandwidth is high,
+worse at very long T where K/V chunks are much smaller than Q·out.  Both
+ride ICI; selection is ``seq_parallel='ring'|'ulysses'`` on the model or
+``MXNET_TPU_SEQ_PARALLEL`` (docs/env_vars.md).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ulysses_attention", "nd_ulysses_attention"]
+
+
+def _ulysses_local(q, k, v, *, axis, causal, scale):
+    """Per-device body under shard_map: q/k/v local (B, T/sp, H, D)."""
+    # seq-shard -> head-shard: every device gets the full sequence for
+    # its H/sp head group
+    q, k, v = (jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True) for x in (q, k, v))
+    from .attention import flash_attention
+    out = flash_attention(q, k, v, causal=causal, scale=scale)
+    # head-shard -> seq-shard
+    return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = False,
+                      scale: Optional[float] = None, mesh=None,
+                      axis: str = "sp", batch_axis: str = "dp",
+                      heads_axis: str = "tp"):
+    """Sequence-parallel attention on global (B, T, H, D) jax arrays via
+    head/sequence all-to-all re-sharding.  Requires T and the LOCAL head
+    count (H / |heads_axis|) divisible by |axis|."""
+    from ..parallel.mesh import axis_size, current_mesh
+    mesh = mesh or current_mesh()
+    sp = axis_size(mesh, axis) if mesh is not None else 1
+    d = q.shape[-1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if sp == 1:
+        from .attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    t, h = q.shape[1], q.shape[2]
+    tp = axis_size(mesh, heads_axis)
+    if t % sp or k.shape[1] != t:
+        raise ValueError(
+            f"ulysses attention needs tq == tk divisible by |{axis}|={sp},"
+            f" got tq={t}, tk={k.shape[1]}")
+    if h % tp or (h // tp) % sp:
+        raise ValueError(
+            f"ulysses attention needs heads {h} divisible by "
+            f"|{heads_axis}|={tp} and local heads {h}//{tp} divisible by "
+            f"|{axis}|={sp}")
+    spec = P(batch_axis, axis, heads_axis, None)
+    body = functools.partial(_ulysses_local, axis=axis, causal=causal,
+                             scale=scale)
+    from ._smap import shard_mapped_qkv
+    return shard_mapped_qkv(body, mesh, spec, q, k, v)
+
+
+def nd_ulysses_attention(query, key, value, *, causal=False, scale=None,
+                         mesh=None, axis="sp"):
+    """NDArray-level entry (autograd-recorded) for Ulysses attention."""
+    from ..ndarray.ops import _as_nd, invoke
+    query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, causal=causal, scale=scale,
+                                 mesh=mesh, axis=axis)
+
+    return invoke("ulysses_attention", f, [query, key, value])

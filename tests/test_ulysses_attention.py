@@ -1,0 +1,120 @@
+"""Ulysses (all-to-all) sequence parallelism over the sp mesh axis.
+
+Runs on the 8-virtual-device CPU mesh from conftest.  Capability add over
+the reference (SURVEY.md §5.7 names ring AND all-to-all sequence
+parallelism) — the contract is numerical agreement with single-device
+attention, same as the ring tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel as par
+from mxnet_tpu.ops.attention import _attention_ref
+from mxnet_tpu.ops.ulysses import ulysses_attention
+
+
+def _qkv(b=4, t=64, h=4, d=16, seed=0):
+    rs = onp.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(b, t, h, d), jnp.float32)
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dp,sp,tp", [(2, 4, 1), (1, 4, 2), (2, 2, 2)])
+def test_ulysses_matches_ref(causal, dp, sp, tp):
+    mesh = par.make_mesh(dp=dp, sp=sp, tp=tp)
+    q, k, v = _qkv(h=8)
+    out = ulysses_attention(q, k, v, causal=causal, mesh=mesh)
+    ref = _attention_ref(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_grads_match_ref(causal):
+    mesh = par.make_mesh(dp=2, sp=4)
+    q, k, v = _qkv(seed=1)
+
+    def f(q, k, v):
+        return jnp.sum(
+            ulysses_attention(q, k, v, causal=causal, mesh=mesh) ** 2)
+
+    def g(q, k, v):
+        return jnp.sum(_attention_ref(q, k, v, causal=causal) ** 2)
+
+    gu = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gu, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(r),
+                                    rtol=1e-3, atol=1e-3)
+
+
+def test_ulysses_rejects_bad_shapes():
+    mesh = par.make_mesh(dp=2, sp=4)
+    q, k, v = _qkv(t=62)
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, mesh=mesh)
+    # local heads (h/tp) not divisible by sp
+    mesh2 = par.make_mesh(dp=1, sp=4, tp=2)
+    q2, k2, v2 = _qkv(h=4)           # 4/2 = 2 local heads, sp=4
+    with pytest.raises(ValueError):
+        ulysses_attention(q2, k2, v2, mesh=mesh2)
+
+
+def test_mha_routes_to_ulysses_under_sp_mesh(monkeypatch):
+    """seq_parallel='ulysses' actually TAKES the Ulysses path (spied) and
+    matches the plain-attention output."""
+    from mxnet_tpu import ops as ops_mod
+    from mxnet_tpu.models.transformer import MultiHeadAttention
+
+    calls = []
+    real = ops_mod.nd_ulysses_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ops_mod, "nd_ulysses_attention", spy)
+
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.randn(4, 32, 32).astype("float32"))
+    att_u = MultiHeadAttention(32, 4, dropout=0.0, causal=True,
+                               seq_parallel="ulysses")
+    att_u.initialize()
+    base = att_u(x).asnumpy()          # no mesh: plain attention
+    assert not calls
+    mesh = par.make_mesh(dp=2, sp=4)
+    with par.use_mesh(mesh):
+        out_u = att_u(x).asnumpy()
+    assert calls, "ulysses path not taken under the sp mesh"
+    onp.testing.assert_allclose(out_u, base, rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_trainer_sp_ulysses_training_step():
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    import os
+    os.environ["MXNET_TPU_SEQ_PARALLEL"] = "ulysses"
+    try:
+        net = get_gpt2("gpt2_124m", vocab_size=128, units=32, num_layers=2,
+                       num_heads=4, max_length=64, dropout=0.0)
+        net.initialize()
+        rs = onp.random.RandomState(0)
+        toks = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+        labels = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+        mesh = par.make_mesh(dp=2, sp=4)
+        with par.use_mesh(mesh):
+            tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                    optimizer_params={"learning_rate": 1e-2},
+                                    mesh=mesh, seq_axis=1)
+            first = float(tr.step(toks, labels).asscalar())
+            for _ in range(5):
+                last = float(tr.step(toks, labels).asscalar())
+        assert last < first
+    finally:
+        os.environ.pop("MXNET_TPU_SEQ_PARALLEL", None)
