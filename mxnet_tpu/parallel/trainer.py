@@ -78,15 +78,26 @@ class ShardedTrainer:
         default shards dim0 over ``dp`` (and ``seq_axis`` over ``sp``).
     donate : donate param/state buffers to the step (XLA in-place update,
         the static_alloc analogue).
+    grad_accum : microbatch count — the batch splits into ``grad_accum``
+        microbatches run through ``lax.scan`` INSIDE the one jitted step,
+        gradients accumulated in f32 and averaged before the single
+        optimizer update.  Activation memory is O(batch/grad_accum)
+        while the optimizer sees the full effective batch (the
+        grad_req='add' accumulation idiom, compiled).  Batch dim must be
+        divisible by grad_accum (and the microbatch by dp).
     """
 
     def __init__(self, net, optimizer, loss=None, optimizer_params=None,
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  data_specs=None, label_specs=None, seq_axis: Optional[int] = None,
-                 donate: bool = True):
+                 donate: bool = True, grad_accum: int = 1):
         self.net = net
         self.loss = loss
+        if grad_accum != int(grad_accum) or int(grad_accum) < 1:
+            raise _base.MXNetError(
+                f"grad_accum must be a positive integer, got {grad_accum}")
+        self._grad_accum = int(grad_accum)
         self.mesh = mesh or current_mesh()
         if self.mesh is None:
             raise _base.MXNetError(
@@ -226,49 +237,98 @@ class ShardedTrainer:
 
         mesh = self.mesh
 
+        accum = self._grad_accum
+
+        def forward_loss(pvals, aux_now, data_vals, label_vals, k):
+            """Loss + updated aux payloads for ONE (micro)batch — a pure
+            function of its arguments, re-enterable per scan iteration."""
+            _random.push_trace_key(k)
+            aux_nds = [p._data for _, p in aux]
+            swap_ctx = swap_values(aux_nds, aux_now)
+            swap_ctx.__enter__()
+            try:
+                data = [NDArray(v) for v in data_vals]
+                labels = [NDArray(v) for v in label_vals]
+                _base.pop_aux_losses()   # discard stale entries (e.g.
+                # from the eager shape-settling forward) so the loss
+                # only sums aux losses of THIS trace
+                # loss runs inside this same trace → tracers may be
+                # collected (MoE router aux losses)
+                aux_prev = _base.set_aux_collection(True)
+                try:
+                    with swap_values([p._data for _, p in trainable],
+                                     pvals):
+                        with _base.training_mode(True):
+                            rec = _base.set_recording(False)
+                            try:
+                                out = net.forward(*data)
+                            finally:
+                                _base.set_recording(rec)
+                        if loss_fn is not None:
+                            l = loss_fn(out, *labels)
+                        else:
+                            l = out
+                        lval = l.jax if isinstance(l, NDArray) else l
+                        lval = jnp.mean(lval)
+                        new_aux = tuple(
+                            p._data._data for _, p in aux)
+                        return lval, new_aux
+                finally:
+                    _base.set_aux_collection(aux_prev)
+                    _base.pop_aux_losses()  # nothing may outlive the
+                    # trace, drained or not
+            finally:
+                swap_ctx.__exit__(None, None, None)
+                _random.pop_trace_key()
+
         def pure(param_vals, aux_vals, state_vals, batch_vals, key, lr, t):
             _random.push_trace_key(key)
             ctx = use_mesh(mesh)
             ctx.__enter__()
-            aux_nds = [p._data for _, p in aux]
-            swap_ctx = swap_values(aux_nds, aux_vals)
-            swap_ctx.__enter__()
             try:
-                data = [NDArray(v) for v in batch_vals[:n_data]]
-                labels = [NDArray(v) for v in batch_vals[n_data:]]
+                data_vals = tuple(batch_vals[:n_data])
+                label_vals = tuple(batch_vals[n_data:])
+                if accum == 1:
+                    (loss_val, new_aux), grads = jax.value_and_grad(
+                        lambda pv: forward_loss(pv, aux_vals, data_vals,
+                                                label_vals, key),
+                        has_aux=True)(tuple(param_vals))
+                else:
+                    # gradient accumulation: scan over microbatches —
+                    # activations live for ONE microbatch; grads
+                    # accumulate in f32; BN/aux state threads through
+                    # the carry like sequential small steps would
+                    def split_mb(v):
+                        return v.reshape(
+                            (accum, v.shape[0] // accum) + v.shape[1:])
 
-                def forward(pvals):
-                    _base.pop_aux_losses()   # discard stale entries (e.g.
-                    # from the eager shape-settling forward) so the loss
-                    # only sums aux losses of THIS trace
-                    # loss runs inside this same trace → tracers may be
-                    # collected (MoE router aux losses)
-                    aux_prev = _base.set_aux_collection(True)
-                    try:
-                        with swap_values([p._data for _, p in trainable],
-                                         pvals):
-                            with _base.training_mode(True):
-                                rec = _base.set_recording(False)
-                                try:
-                                    out = net.forward(*data)
-                                finally:
-                                    _base.set_recording(rec)
-                            if loss_fn is not None:
-                                l = loss_fn(out, *labels)
-                            else:
-                                l = out
-                            lval = l.jax if isinstance(l, NDArray) else l
-                            lval = jnp.mean(lval)
-                            new_aux = tuple(
-                                p._data._data for _, p in aux)
-                            return lval, new_aux
-                    finally:
-                        _base.set_aux_collection(aux_prev)
-                        _base.pop_aux_losses()  # nothing may outlive the
-                        # trace, drained or not
+                    mb_data = tuple(split_mb(v) for v in data_vals)
+                    mb_labels = tuple(split_mb(v) for v in label_vals)
+                    keys = jax.random.split(key, accum)
 
-                (loss_val, new_aux), grads = jax.value_and_grad(
-                    forward, has_aux=True)(tuple(param_vals))
+                    def body(carry, xs):
+                        aux_c, gacc, lacc = carry
+                        k_i, d_i, l_i = xs
+                        (lv, aux_n), g = jax.value_and_grad(
+                            lambda pv: forward_loss(pv, aux_c, d_i, l_i,
+                                                    k_i),
+                            has_aux=True)(tuple(param_vals))
+                        gacc = tuple(
+                            a + b.astype(jnp.float32)
+                            for a, b in zip(gacc, g))
+                        return (aux_n, gacc,
+                                lacc + lv.astype(jnp.float32)), None
+
+                    g0 = tuple(jnp.zeros(v.shape, jnp.float32)
+                               for v in param_vals)
+                    carry0 = (tuple(aux_vals), g0,
+                              jnp.zeros((), jnp.float32))
+                    (new_aux, gsum, lsum), _ = jax.lax.scan(
+                        body, carry0, (keys, mb_data, mb_labels))
+                    grads = tuple(
+                        (g / accum).astype(v.dtype)
+                        for g, v in zip(gsum, param_vals))
+                    loss_val = lsum / accum
 
                 new_params, new_states = [], []
                 with optimizer.traced(lr, t):
@@ -287,7 +347,6 @@ class ShardedTrainer:
                 return (loss_val, tuple(new_params), tuple(new_aux),
                         tuple(new_states))
             finally:
-                swap_ctx.__exit__(None, None, None)
                 ctx.__exit__()
                 _random.pop_trace_key()
 
@@ -331,6 +390,11 @@ class ShardedTrainer:
             data = (data,)
         if not isinstance(labels, (tuple, list)):
             labels = (labels,)
+        if self._grad_accum > 1 and data and \
+                data[0].shape[0] % self._grad_accum:
+            raise _base.MXNetError(
+                f"batch dim {data[0].shape[0]} not divisible by "
+                f"grad_accum={self._grad_accum}")
         if not self._built:
             self._build(data, labels)
         opt = self.optimizer

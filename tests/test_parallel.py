@@ -196,3 +196,55 @@ def test_every_optimizer_traces_without_retrace():
                               jnp.asarray(t_step, jnp.int32))
         assert sum(traces) == 1, f"{name} retraced {sum(traces)} times"
         assert bool(jnp.isfinite(w).all()), name
+
+
+@pytest.mark.slow
+def test_sharded_trainer_grad_accum_matches_full_batch():
+    """grad_accum=N (microbatch lax.scan inside the jitted step) must
+    produce the same update as one full-batch step: averaged microbatch
+    grads == full-batch grad for mean losses, and the loss matches."""
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    rs = onp.random.RandomState(0)
+    toks = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+    labels = mx.nd.array(rs.randint(0, 128, (8, 16)), dtype="int32")
+
+    def train(accum):
+        mx.random.seed(7)
+        net = get_gpt2("gpt2_124m", vocab_size=128, units=32,
+                       num_layers=2, num_heads=4, max_length=64,
+                       dropout=0.0)
+        net.initialize()
+        import jax as _jax
+        mesh = par.make_mesh(dp=2, devices=_jax.devices()[:2])
+        with par.use_mesh(mesh):
+            tr = par.ShardedTrainer(
+                net, "adam", loss=gpt2_lm_loss,
+                optimizer_params={"learning_rate": 1e-2},
+                mesh=mesh, grad_accum=accum)
+            losses = [float(tr.step(toks, labels).asscalar())
+                      for _ in range(3)]
+        w = [p.data().asnumpy()
+             for _, p in net.collect_params().items()]
+        return losses, w
+
+    l1, w1 = train(1)
+    l4, w4 = train(4)
+    onp.testing.assert_allclose(l1, l4, rtol=1e-4, atol=1e-5)
+    assert len(w1) == len(w4)
+    for i, (a, b) in enumerate(zip(w4, w1)):
+        onp.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4,
+                                    err_msg=f"param {i}")
+    # batch 8 not divisible by 3 -> step() raises
+    from mxnet_tpu import base as _base
+    net = get_gpt2("gpt2_124m", vocab_size=128, units=32,
+                   num_layers=2, num_heads=4, max_length=64,
+                   dropout=0.0)
+    net.initialize()
+    import jax as _jax
+    mesh = par.make_mesh(dp=2, devices=_jax.devices()[:2])
+    with par.use_mesh(mesh):
+        tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                mesh=mesh, grad_accum=3)
+        with pytest.raises(_base.MXNetError):
+            tr.step(toks, labels)
