@@ -1,0 +1,82 @@
+"""Long-context GPT-2 training: flash attention + sequence parallelism.
+
+BASELINE config 5 territory (SURVEY.md §5.7): sequences far beyond what
+materialized (T, T) score matrices allow.  One chip runs the Pallas
+flash kernel (O(T) memory); a mesh with an `sp` axis shards the
+sequence itself — `--seq-parallel ring` rotates K/V chunks around the
+ICI ring, `--seq-parallel ulysses` re-shards seq<->heads with two
+all-to-alls and runs full-sequence flash per head group.  `grad_accum`
+stacks microbatches inside the same jitted step when the per-device
+batch would not fit HBM.
+
+Run (CPU, 8 virtual devices, tiny shapes):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python example/train_long_context.py --dp 2 --sp 4 --seq 512 \
+      --seq-parallel ring --grad-accum 2
+On a TPU slice, raise --seq/--units/--layers to the real config.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--units", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--grad-accum", type=int, default=1)
+    ap.add_argument("--seq-parallel", default="ring",
+                    choices=["ring", "ulysses"])
+    args = ap.parse_args()
+
+    os.environ["MXNET_TPU_SEQ_PARALLEL"] = args.seq_parallel
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    net = get_gpt2("gpt2_124m", vocab_size=args.vocab, units=args.units,
+                   num_layers=args.layers, num_heads=args.heads,
+                   max_length=args.seq, dropout=0.0)
+    net.initialize()
+    mesh = par.make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    rs = onp.random.RandomState(0)
+
+    def batch():
+        toks = rs.randint(0, args.vocab, (args.batch, args.seq))
+        return (mx.nd.array(toks, dtype="int32"),
+                mx.nd.array(onp.roll(toks, -1, axis=1), dtype="int32"))
+
+    with par.use_mesh(mesh):
+        trainer = par.ShardedTrainer(
+            net, "adamw", loss=gpt2_lm_loss,
+            optimizer_params={"learning_rate": 3e-4}, mesh=mesh,
+            seq_axis=1, grad_accum=args.grad_accum)
+        toks, labels = batch()
+        loss = float(trainer.step(toks, labels).asscalar())  # compile
+        print(f"step 0  loss {loss:.4f}  ({args.seq_parallel}, "
+              f"seq={args.seq}, sp={args.sp}, "
+              f"grad_accum={args.grad_accum})", flush=True)
+        t0 = time.perf_counter()
+        for i in range(1, args.steps):
+            loss = float(trainer.step(*batch()).asscalar())
+        dt = time.perf_counter() - t0
+        tok_s = args.batch * args.seq * (args.steps - 1) / max(dt, 1e-9)
+        print(f"step {args.steps - 1}  loss {loss:.4f}  "
+              f"{tok_s:,.0f} tokens/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()
