@@ -143,23 +143,27 @@ class FixedBucketSampler(Sampler):
                 buckets[self.bucket_keys[-1]].append(i)
         self._buckets = buckets
         # seed=None follows the global mx.random state (upstream gluonnlp
-        # draws from the global RNG); an explicit seed pins the order
-        if seed is None:
-            from ... import random as _random
-            self._rng = _random.host_rng()
-        else:
-            self._rng = onp.random.RandomState(int(seed))
+        # draws from the global RNG); an explicit seed pins the order.
+        # The global rng is looked up PER ITERATION (not cached) so a
+        # later mx.random.seed() still governs epoch orders.
+        self._rng = onp.random.RandomState(int(seed)) \
+            if seed is not None else None
 
     def __iter__(self):
+        if self._rng is not None:
+            rng = self._rng
+        else:
+            from ... import random as _random
+            rng = _random.host_rng()
         batches = []
         for k in self.bucket_keys:
             idx = list(self._buckets[k])
             if self._shuffle:
-                self._rng.shuffle(idx)
+                rng.shuffle(idx)
             for i in range(0, len(idx), self._batch_size):
                 batches.append(idx[i:i + self._batch_size])
         if self._shuffle:
-            self._rng.shuffle(batches)
+            rng.shuffle(batches)
         return iter(batches)
 
     def __len__(self):

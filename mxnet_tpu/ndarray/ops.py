@@ -2002,10 +2002,11 @@ def moments(data, axes=None, keepdims=False, **kw):
     ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
 
     def f(x):
-        m = jnp.mean(x, axis=ax, keepdims=keepdims)
-        v = jnp.mean(
-            (x - jnp.mean(x, axis=ax, keepdims=True)) ** 2,
-            axis=ax, keepdims=keepdims)
+        mk = jnp.mean(x, axis=ax, keepdims=True)
+        v = jnp.mean((x - mk) ** 2, axis=ax, keepdims=keepdims)
+        m = mk if keepdims else jnp.squeeze(
+            mk, axis=ax if ax is not None
+            else tuple(range(x.ndim)))
         return m, v
 
     return invoke("moments", f, [data], nout=2)
@@ -2153,14 +2154,9 @@ def box_iou(lhs, rhs, format="corner", **kw):
         return (b[..., 0], b[..., 1], b[..., 2], b[..., 3])
 
     def f(a, b):
-        ax1, ay1, ax2, ay2 = (t[..., :, None] for t in corners(a))
-        bx1, by1, bx2, by2 = (t[..., None, :] for t in corners(b))
-        iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
-        ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
-        inter = iw * ih
-        area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
-        area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
-        return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+        ca = jnp.stack(corners(a), axis=-1)
+        cb = jnp.stack(corners(b), axis=-1)
+        return _pairwise_iou(ca, cb)
 
     return invoke("box_iou", f, [lhs, rhs])
 
@@ -2191,18 +2187,7 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
                                    cy + h / 2], axis=1)
             valid = scores > valid_thresh
             order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
-            x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2],
-                              boxes[:, 3])
-            iw = jnp.maximum(
-                jnp.minimum(x2[:, None], x2[None]) -
-                jnp.maximum(x1[:, None], x1[None]), 0)
-            ih = jnp.maximum(
-                jnp.minimum(y2[:, None], y2[None]) -
-                jnp.maximum(y1[:, None], y1[None]), 0)
-            inter = iw * ih
-            area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
-            iou = inter / jnp.maximum(area[:, None] + area[None] - inter,
-                                      1e-12)
+            iou = _pairwise_iou(boxes, boxes)
             same_cls = jnp.ones_like(iou, bool) if (
                 force_suppress or id_index < 0) else (
                 rows[:, id_index][:, None] == rows[:, id_index][None])
@@ -2256,9 +2241,23 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
 def ROIAlign(data, rois, pooled_size=None, spatial_scale=1.0,
              sample_ratio=2, position_sensitive=False, **kw):
     """ROI Align with bilinear sampling (parity:
-    mx.nd.contrib.ROIAlign, src/operator/contrib/roi_align.cc)."""
+    mx.nd.contrib.ROIAlign, src/operator/contrib/roi_align.cc).
+
+    Deviation: upstream's ``sample_ratio=-1`` adapts the per-bin sample
+    count to each ROI's size, which needs dynamic shapes; here -1 maps
+    to a STATIC 2x2 sample grid per bin (the common configured value)
+    with a one-time warning."""
     data, rois = _as_nd(data), _as_nd(rois)
     ph, pw = pooled_size
+    if sample_ratio < 0:
+        global _WARNED_ROIALIGN_ADAPTIVE
+        if not _WARNED_ROIALIGN_ADAPTIVE:
+            import logging
+            logging.warning(
+                "ROIAlign sample_ratio=-1 (adaptive) needs dynamic "
+                "shapes; using a static 2x2 sample grid per bin")
+            _WARNED_ROIALIGN_ADAPTIVE = True
+        sample_ratio = 2
     sr = builtins.max(int(sample_ratio), 1)
 
     def f(x, r):
@@ -2341,10 +2340,25 @@ def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     return invoke("MultiBoxPrior", f, [data])
 
 
+_WARNED_ROIALIGN_ADAPTIVE = False
+
+
 def _corner_to_center(b):
     w = b[..., 2] - b[..., 0]
     h = b[..., 3] - b[..., 1]
     return (b[..., 0] + w / 2, b[..., 1] + h / 2, w, h)
+
+
+def _pairwise_iou(a, b):
+    """IoU matrix of corner-format boxes a (..., N, 4) x b (..., M, 4)."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
 
 
 @_export
@@ -2370,17 +2384,7 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
             m_gt = rows.shape[0]
             valid = rows[:, 0] >= 0                      # (M,)
             gt = rows[:, 1:5]                            # (M, 4)
-            ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
-            gx1, gy1, gx2, gy2 = (gt[:, 0], gt[:, 1], gt[:, 2], gt[:, 3])
-            iw = jnp.maximum(jnp.minimum(ax2[:, None], gx2[None]) -
-                             jnp.maximum(ax1[:, None], gx1[None]), 0)
-            ih = jnp.maximum(jnp.minimum(ay2[:, None], gy2[None]) -
-                             jnp.maximum(ay1[:, None], gy1[None]), 0)
-            inter = iw * ih
-            area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
-            area_g = jnp.maximum(gx2 - gx1, 0) * jnp.maximum(gy2 - gy1, 0)
-            iou = inter / jnp.maximum(
-                area_a[:, None] + area_g[None] - inter, 1e-12)
+            iou = _pairwise_iou(a, gt)
             iou = jnp.where(valid[None, :], iou, -1.0)   # (A, M)
 
             best_gt = jnp.argmax(iou, axis=1)            # per anchor

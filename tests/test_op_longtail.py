@@ -405,3 +405,17 @@ def test_npx_reshape_2x_dialect():
     # values preserved
     onp.testing.assert_array_equal(
         npx.reshape(x, (-5, 4)).asnumpy(), x.asnumpy().reshape(6, 4))
+
+
+def test_bucket_sampler_follows_later_reseed():
+    """mx.random.seed() called AFTER sampler construction must still
+    govern the shuffle order (the global host rng is looked up per
+    iteration, not captured at construction)."""
+    from mxnet_tpu.gluon.data.sampler import FixedBucketSampler
+    lengths = list(_rs.randint(5, 40, 100))
+    s = FixedBucketSampler(lengths, 8, shuffle=True)
+    mx.random.seed(123)
+    o1 = list(s)
+    mx.random.seed(123)
+    o2 = list(s)
+    assert o1 == o2, "post-construction reseed must control the order"

@@ -50,18 +50,25 @@ def main() -> int:
     cmd = [sys.executable, "-u", os.path.join(REPO, "bench.py"),
            "--workload", args.workload, "--profile", prof_dir]
     print("running:", " ".join(cmd), flush=True)
+    # stderr merges into stdout: two pipes + sequential reads deadlock
+    # once the unread pipe's buffer fills, and this tool deliberately has
+    # no timeout (a killed TPU client wedges the tunnel)
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
+                            stderr=subprocess.STDOUT, text=True)
     records = []
+    tail = []
     for line in proc.stdout:
         print("bench|", line, end="", flush=True)
+        tail.append(line)
+        if len(tail) > 200:
+            tail.pop(0)
         line = line.strip()
         if line.startswith("{"):
             try:
                 records.append(json.loads(line))
             except json.JSONDecodeError:
                 pass
-    stderr_txt = proc.stderr.read()
+    stderr_txt = "".join(tail)
     rc = proc.wait()
     battery_out = ""
     if not args.skip_battery:
@@ -94,7 +101,7 @@ def main() -> int:
     lines += ["", f"Profiler traces: `{os.path.relpath(prof_dir, REPO)}/"
               "<workload>/` (jax.profiler; open with TensorBoard).", ""]
     if stderr_txt.strip():
-        lines += ["## bench stderr (tail)", "```",
+        lines += ["## bench output (tail)", "```",
                   stderr_txt[-2000:], "```", ""]
     if battery_out:
         lines += ["## cpu-vs-tpu consistency battery", "```",
