@@ -13,6 +13,10 @@ FP16_FUNCS = [
     "interleaved_matmul_selfatt_valatt", "linalg_gemm2",
     "dot_product_attention", "einsum", "tensordot", "inner", "outer",
     "vdot", "kron",
+    # attention kernels accumulate in f32 internally; bf16 inputs feed
+    # the MXU at full rate
+    "flash_attention", "ring_attention", "ulysses_attention",
+    "sparse_dot",
 ]
 
 FP32_FUNCS = [
@@ -21,8 +25,11 @@ FP32_FUNCS = [
     "L2Normalization", "norm", "exp", "expm1", "log", "log1p", "log2",
     "log10", "power", "rsqrt", "rcbrt", "erfinv", "gamma", "gammaln",
     "cosh", "sinh", "tan", "arccosh", "arcsinh", "arctanh", "mean", "sum",
-    "nansum", "prod", "nanprod", "cumsum", "var", "std", "smooth_l1",
-    "quantile", "logaddexp", "logaddexp2",
+    "nansum", "prod", "nanprod", "cumsum", "cumprod", "var", "std",
+    "smooth_l1", "quantile", "logaddexp", "logaddexp2", "logsumexp",
+    "LRN", "SoftmaxActivation", "masked_softmax", "masked_log_softmax",
+    "moments", "linalg_det", "linalg_inverse", "linalg_slogdet",
+    "linalg_potrf", "linalg_trsm", "linalg_syrk",
 ]
 
 WIDEST_TYPE_CASTS = [
