@@ -118,3 +118,30 @@ def test_sharded_trainer_sp_ulysses_training_step():
         assert last < first
     finally:
         os.environ.pop("MXNET_TPU_SEQ_PARALLEL", None)
+
+
+def test_sp_with_grad_accum_matches_full_batch():
+    """Sequence parallelism + grad accumulation: the microbatch reshape
+    shifts the seq axis inside the scan — losses must still equal the
+    full-batch step."""
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    def train(accum):
+        mx.random.seed(5)
+        net = get_gpt2("gpt2_124m", vocab_size=128, units=32,
+                       num_layers=2, num_heads=4, max_length=64,
+                       dropout=0.0)
+        net.initialize()
+        rs = onp.random.RandomState(0)
+        toks = mx.nd.array(rs.randint(0, 128, (8, 32)), dtype="int32")
+        labels = mx.nd.array(rs.randint(0, 128, (8, 32)), dtype="int32")
+        mesh = par.make_mesh(dp=2, sp=4)
+        with par.use_mesh(mesh):
+            tr = par.ShardedTrainer(net, "adam", loss=gpt2_lm_loss,
+                                    optimizer_params={"learning_rate": 1e-2},
+                                    mesh=mesh, seq_axis=1,
+                                    grad_accum=accum)
+            return [float(tr.step(toks, labels).asscalar())
+                    for _ in range(3)]
+
+    onp.testing.assert_allclose(train(1), train(2), rtol=2e-3, atol=1e-4)
