@@ -76,6 +76,12 @@ _LAZY = {
     "library": ".library",
     "monitor": ".monitor",
     "mon": ".monitor",
+    "model": ".model",
+    "engine": ".engine",
+    "name": ".name",
+    "attribute": ".attribute",
+    "rtc": ".rtc",
+    "device": ".context",   # 2.x rename: mx.device is the context module
 }
 
 

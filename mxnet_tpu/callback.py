@@ -74,11 +74,8 @@ def do_checkpoint(prefix, period=1):
     """Epoch-end callback saving module checkpoints every `period` epochs."""
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            from . import ndarray as nd
-            sym.save(f"{prefix}-symbol.json")
-            payload = {f"arg:{k}": v for k, v in arg.items()}
-            payload.update({f"aux:{k}": v for k, v in aux.items()})
-            nd.save(f"{prefix}-{iter_no + 1:04d}.params", payload)
+            from .model import save_checkpoint
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
     return _callback
 
 

@@ -1,0 +1,30 @@
+"""``mx.engine`` compatibility (parity: python/mxnet/engine.py).
+
+The threaded dependency engine is absorbed by XLA's async dispatch
+(SURVEY.md §7.1): ``bulk()`` — upstream's batching of engine ops to cut
+per-op overhead — is a no-op context manager because jit tracing already
+bulks entire programs, and ``set_bulk_size`` returns the previous value
+without effect.  Kept so scripts using these knobs run unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 15
+
+
+def set_bulk_size(size: int) -> int:
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -325,24 +325,17 @@ class Module(BaseModule):
 
     # ------------------------------------------------------- checkpoint
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save(f"{prefix}-symbol.json")
+        from ..model import save_checkpoint as _save_ckpt
         arg_p, aux_p = self.get_params()
-        payload = {f"arg:{k}": v for k, v in arg_p.items()}
-        payload.update({f"aux:{k}": v for k, v in aux_p.items()})
-        nd.save(f"{prefix}-{epoch:04d}.params", payload)
+        _save_ckpt(prefix, epoch, self._symbol, arg_p, aux_p)
         if save_optimizer_states:
             with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
                 f.write(self._updater.get_states())
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        from .. import symbol as sym_mod
-        symbol = sym_mod.load(f"{prefix}-symbol.json")
-        saved = nd.load(f"{prefix}-{epoch:04d}.params")
-        arg_params = {k[4:]: v for k, v in saved.items()
-                      if k.startswith("arg:")}
-        aux_params = {k[4:]: v for k, v in saved.items()
-                      if k.startswith("aux:")}
+        from ..model import load_checkpoint as _load_ckpt
+        symbol, arg_params, aux_params = _load_ckpt(prefix, epoch)
         mod = Module(symbol, **kwargs)
         mod._preloaded = (arg_params, aux_params)
         mod._arg_params = arg_params

@@ -1,0 +1,25 @@
+"""``mx.rtc`` stub (parity surface: python/mxnet/rtc.py).
+
+Upstream compiles CUDA source at runtime (CudaModule over NVRTC).  On
+TPU the analogue is a Pallas kernel (mxnet_tpu.ops) — there is no
+runtime C++ compilation path, so these entry points raise a clear error
+instead of an AttributeError deep inside a ported script."""
+from __future__ import annotations
+
+from . import base as _base
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc compiles CUDA at runtime; this TPU build has no CUDA. "
+        "Write a Pallas kernel (see mxnet_tpu/ops/flash.py) or a plain "
+        "jax function registered via mxnet_tpu.operator instead.")
+
+
+class CudaModule:
+    def __init__(self, *a, **kw):
+        raise _base.MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *a, **kw):
+        raise _base.MXNetError(_MSG)

@@ -306,9 +306,27 @@ def _select(base: Symbol, index: int) -> Symbol:
     return s
 
 
+def _scope_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge ambient mx.attribute.AttrScope attrs under explicit ones."""
+    from ..attribute import current_attrs
+    ambient = current_attrs()
+    if not ambient:
+        return attrs
+    out = dict(ambient)
+    out.update(attrs or {})
+    return out
+
+
 def _apply(op: str, inputs: Sequence[Symbol], attrs: Dict[str, Any],
            name: Optional[str] = None, num_outputs: int = 1) -> Symbol:
-    return Symbol(op, name or _auto_name(op), inputs, attrs, num_outputs)
+    # an active mx.name scope (NameManager/Prefix) owns naming —
+    # including prefixing EXPLICIT names, as upstream does
+    from ..name import _stack as _name_stack
+    if _name_stack():
+        name = _name_stack()[-1].get(name, op.lower())
+    elif name is None:
+        name = _auto_name(op)
+    return Symbol(op, name, inputs, _scope_attrs(attrs), num_outputs)
 
 
 def _topo(root: Symbol) -> List[Symbol]:
@@ -512,7 +530,7 @@ def _evaluate_abstract(root: Symbol, traced: Dict[str, Any]):
 # ----------------------------------------------------------------- factory
 
 def Variable(name, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
-    s = Symbol("null", name)
+    s = Symbol("null", name, attrs=_scope_attrs({}))
     if shape is not None:
         s._attrs["__shape__"] = tuple(shape)
     if dtype is not None:

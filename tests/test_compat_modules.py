@@ -1,0 +1,109 @@
+"""1.x top-level compatibility modules (parity: python/mxnet/{model,
+engine,name,attribute,rtc}.py + the 2.x mx.device rename)."""
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_model_checkpoint_roundtrip():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    arg = {"fc1_weight": mx.nd.array(onp.random.rand(4, 6).astype("f")),
+           "fc1_bias": mx.nd.zeros((4,))}
+    aux = {"bn_moving_mean": mx.nd.ones((4,))}
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "m")
+        mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+        sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+        assert set(arg2) == set(arg) and set(aux2) == set(aux)
+        onp.testing.assert_array_equal(
+            arg2["fc1_weight"].asnumpy(), arg["fc1_weight"].asnumpy())
+        # Module can consume the same files
+        mod = mx.mod.Module.load(prefix, 3, data_names=("data",))
+        assert mod is not None
+
+
+def test_model_checkpoint_interops_with_module_save():
+    """Module.save_checkpoint files load through mx.model and back."""
+    import mxnet_tpu.io as mio
+    x = onp.random.rand(8, 6).astype("f")
+    y = onp.random.randint(0, 2, (8,)).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=())
+    it = mio.NDArrayIter({"data": x}, batch_size=4)
+    mod.bind(data_shapes=it.provide_data)
+    mod.init_params()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "mm")
+        mod.save_checkpoint(prefix, 1)
+        sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+        assert "fc_weight" in arg2
+
+
+def test_engine_bulk_scope():
+    prev = mx.engine.set_bulk_size(10)
+    assert mx.engine.set_bulk_size(prev) == 10
+    with mx.engine.bulk(25):
+        out = (mx.nd.ones((2, 2)) * 3).asnumpy()
+    onp.testing.assert_array_equal(out, onp.full((2, 2), 3.0))
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("enc_"):
+        assert mx.name.current().get(None, "dense") == "enc_dense0"
+        assert mx.name.current().get(None, "dense") == "enc_dense1"
+        assert mx.name.current().get("explicit", "dense") == "enc_explicit"
+    nm = mx.name.current().get(None, "dense")
+    assert not nm.startswith("enc_")
+
+
+def test_attr_scope_nesting():
+    from mxnet_tpu.attribute import current_attrs
+    with mx.attribute.AttrScope(ctx_group="a", lr_mult="2"):
+        with mx.attribute.AttrScope(ctx_group="b"):
+            at = current_attrs()
+            assert at["ctx_group"] == "b" and at["lr_mult"] == "2"
+        assert current_attrs()["ctx_group"] == "a"
+    assert current_attrs() == {}
+
+
+def test_rtc_raises_with_guidance():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void k() {}")
+
+
+def test_device_module_alias():
+    assert mx.device.cpu() == mx.cpu()
+    assert mx.device.Context is mx.context.Context
+
+
+def test_name_prefix_governs_symbol_names():
+    """The scope must actually drive symbol auto-naming (not just exist)."""
+    data = mx.sym.Variable("data")
+    with mx.name.Prefix("enc_"):
+        fc = mx.sym.FullyConnected(data, num_hidden=2)
+        assert fc.name.startswith("enc_fullyconnected"), fc.name
+        named = mx.sym.Activation(fc, act_type="relu", name="act")
+        assert named.name == "enc_act"      # upstream prefixes explicit too
+    outside = mx.sym.FullyConnected(data, num_hidden=2)
+    assert not outside.name.startswith("enc_")
+
+
+def test_attr_scope_attaches_to_symbols():
+    with mx.attribute.AttrScope(ctx_group="dev2", lr_mult="0.1"):
+        v = mx.sym.Variable("w")
+        fc = mx.sym.FullyConnected(v, num_hidden=2)
+    assert v._attrs["ctx_group"] == "dev2"
+    assert fc._attrs["lr_mult"] == "0.1"
+    # batchend param is THE callback namedtuple
+    assert mx.model.BatchEndParam is mx.callback.BatchEndParam
+    p = mx.model.BatchEndParam(epoch=1, nbatch=2, eval_metric=None,
+                               locals=None)
+    e, n, m, l = p                          # namedtuple unpacking works
+    assert (e, n) == (1, 2)
