@@ -79,10 +79,18 @@ def flash_attention(q, k, v, *, causal=False, scale=None):
 
 
 def dot_product_attention(query, key, value, *, causal=False, mask=None,
+                          segment_ids=None, kv_segment_ids=None,
                           dropout=0.0, scale=None, impl="auto"):
     """NDArray multi-head attention: inputs (B, T, H, D) → (B, T, H, D).
 
     impl: 'auto' | 'flash' | 'ref'.
+
+    ``segment_ids`` (B, Tq) int enables SEQUENCE PACKING: tokens attend
+    only within their own segment (combined with ``causal``/``mask``),
+    so multiple short documents share one padded row with zero
+    cross-contamination — the standard TPU lever against pad waste.
+    ``kv_segment_ids`` (B, Tk) covers cross-attention; it defaults to
+    ``segment_ids`` (self-attention).
     """
     from ..ndarray.ops import _as_nd, invoke
     query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)
@@ -91,11 +99,23 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
     if dropout > 0.0 and _base.is_training():
         dkey = _random.next_key(query.context)
     mask_val = mask.jax if hasattr(mask, "jax") else mask
+    if segment_ids is not None:
+        def _seg(x):
+            return x.jax if hasattr(x, "jax") else jnp.asarray(x)
 
-    if impl == "flash" and (mask is not None or dropout > 0.0):
+        q_seg = _seg(segment_ids)
+        kv_seg = _seg(kv_segment_ids) if kv_segment_ids is not None \
+            else q_seg
+        seg_mask = (q_seg[:, None, :, None] ==
+                    kv_seg[:, None, None, :])        # (B, 1, Tq, Tk)
+        mask_val = seg_mask if mask_val is None else \
+            jnp.logical_and(mask_val, seg_mask)
+
+    if impl == "flash" and (mask is not None or segment_ids is not None
+                            or dropout > 0.0):
         raise _base.MXNetError(
-            "impl='flash' does not support an explicit mask or attention "
-            "dropout — use impl='auto'/'ref'")
+            "impl='flash' does not support an explicit mask, segment_ids "
+            "or attention dropout — use impl='auto'/'ref'")
 
     if impl == "flash" and not _use_flash(query.shape, causal, mask_val,
                                           dropout, key.shape):

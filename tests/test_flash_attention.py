@@ -144,3 +144,44 @@ def test_flash_large_head_dim_matches_ref(d):
                     jax.grad(g, (0, 1, 2))(q, k, v)):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(r),
                                     rtol=5e-2, atol=5e-2)
+
+
+def test_segment_ids_packing_isolates_documents():
+    """segment_ids packing: tokens never attend across documents packed
+    in one row — each packed segment matches the same document attended
+    alone."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+
+    rs = onp.random.RandomState(0)
+    b, t, h, d = 1, 8, 2, 4
+    x = rs.randn(b, t, h, d).astype("f")
+    q, k, v = (nd.array(x.copy()) for _ in range(3))
+    seg = nd.array(onp.array([[0, 0, 0, 1, 1, 1, 1, 1]]), dtype="int32")
+    packed = dot_product_attention(q, k, v, causal=True,
+                                   segment_ids=seg).asnumpy()
+    # each segment alone
+    a0 = dot_product_attention(nd.array(x[:, :3]), nd.array(x[:, :3]),
+                               nd.array(x[:, :3]), causal=True).asnumpy()
+    a1 = dot_product_attention(nd.array(x[:, 3:]), nd.array(x[:, 3:]),
+                               nd.array(x[:, 3:]), causal=True).asnumpy()
+    onp.testing.assert_allclose(packed[:, :3], a0, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(packed[:, 3:], a1, rtol=1e-5, atol=1e-6)
+    # flash impl refuses segment_ids explicitly
+    with pytest.raises(MXNetError, match="segment_ids"):
+        dot_product_attention(q, k, v, causal=True, segment_ids=seg,
+                              impl="flash")
+    # cross-attention packing via kv_segment_ids
+    out_x = dot_product_attention(
+        nd.array(x[:, :3]), k, v, segment_ids=nd.array(seg.asnumpy()[:, :3],
+                                                       dtype="int32"),
+        kv_segment_ids=seg).asnumpy()
+    ref_x = dot_product_attention(
+        nd.array(x[:, :3]), nd.array(x[:, :3]), nd.array(x[:, :3])).asnumpy()
+    onp.testing.assert_allclose(out_x, ref_x, rtol=1e-5, atol=1e-6)
+    # float 0/1 masks still compose with segment_ids
+    fm = mx.nd.array(onp.ones((1, 1, t, t), "float32"))
+    out_f = dot_product_attention(q, k, v, causal=True, segment_ids=seg,
+                                  mask=fm).asnumpy()
+    onp.testing.assert_allclose(out_f, packed, rtol=1e-5, atol=1e-6)
