@@ -405,6 +405,11 @@ def test_npx_reshape_2x_dialect():
     # values preserved
     onp.testing.assert_array_equal(
         npx.reshape(x, (-5, 4)).asnumpy(), x.asnumpy().reshape(6, 4))
+    # reverse=True matches special values from the right
+    assert npx.reshape(x, (-1, -2), reverse=True).shape == (6, 4)
+    assert npx.reshape(x, (-5, -2), reverse=True).shape == (6, 4)
+    with pytest.raises(_base.MXNetError):
+        npx.reshape(x, (-6, 1, 2, -4), reverse=True)   # unsupported combo
 
 
 def test_bucket_sampler_follows_later_reseed():
