@@ -339,9 +339,11 @@ def bench_bert(on_tpu: bool, batch_override=None) -> dict:
         batch, seq, steps, warmup = 2, 128, 2, 1
         layers, units, vocab, name = 2, 128, 1000, "bert_base"
     n_masked = max(1, seq // 8)
+    # remat="dots": without it the scanned 24-layer stack saves every
+    # per-layer intermediate (>16GB HBM at batch 8 seq 512) and OOMs v5e
     net = BERTForPretrain(get_bert(
         name, vocab_size=vocab, max_length=seq,
-        **({} if on_tpu else
+        **({"remat": "dots"} if on_tpu else
            {"units": units, "num_layers": layers, "num_heads": 2})))
 
     def mlm_loss(outs, mlm_labels, nsp_labels):
@@ -422,8 +424,13 @@ def main():
             cm = jax.profiler.trace(d)
         else:
             cm = contextlib.nullcontext()
-        with cm:
-            rec = table[name](on_tpu)
+        try:
+            with cm:
+                rec = table[name](on_tpu)
+        except Exception as e:  # one workload OOMing must not kill the rest
+            rec = {"metric": f"{name}_error", "value": None, "unit": "",
+                   "vs_baseline": None, "platform": platform,
+                   "error": f"{type(e).__name__}: {e}"[:400]}
         print(json.dumps(rec), flush=True)
 
 

@@ -306,7 +306,15 @@ def _scan_blocks(blocks, x, mask, remat):
                 _random.pop_trace_key()
 
     if remat:
-        body = jax.checkpoint(body)
+        # remat="dots" keeps matmul outputs resident (cheap: O(layers *
+        # tokens * units)) and recomputes only elementwise/softmax in the
+        # backward — near-zero extra MXU FLOPs, while full remat (True)
+        # recomputes the whole layer.  Without remat a deep scanned stack
+        # saves every intermediate per layer and OOMs HBM (BERT-large
+        # batch 8 seq 512 wants >16GB of scan-saved activations).
+        policy = (jax.checkpoint_policies.checkpoint_dots
+                  if remat == "dots" else None)
+        body = jax.checkpoint(body, policy=policy)
     idxs = jnp.arange(len(blocks), dtype=jnp.int32)
     h, _ = jax.lax.scan(body, x.jax, (idxs, *stacked))
     return NDArray(h)
@@ -324,7 +332,9 @@ def run_blocks(blocks, x, mask=None, scan=None, remat=False):
 
     ``scan=None`` auto-enables scanning at >=8 layers; pass True/False to
     force.  ``remat`` wraps the scan body in jax.checkpoint (activation
-    rematerialization for long sequences / deep stacks).
+    rematerialization for long sequences / deep stacks); ``remat="dots"``
+    uses the checkpoint_dots policy (save matmul outputs, recompute only
+    elementwise — the usual best memory/FLOP point on TPU).
     """
     use_scan = scan if scan is not None else len(blocks) >= 8
     if use_scan and _scan_eligible(blocks, x):
