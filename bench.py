@@ -245,9 +245,12 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
             wr.write(pack_img(IRHeader(0, float(i % 100), i, 0), img,
                               quality=90))
         wr.close()
-        it = mx.io.ImageRecordIter(
+        # dtype=uint8: ship raw pixels (4x less host->device traffic — the
+        # transfer, not decode, dominates when the chip is remote) and cast
+        # on device; PrefetchingIter overlaps decode+upload with the step
+        it = mx.io.PrefetchingIter(mx.io.ImageRecordIter(
             path_imgrec=rec, data_shape=(3, size, size), batch_size=batch,
-            shuffle=True, rand_crop=True, rand_mirror=True)
+            shuffle=True, rand_crop=True, rand_mirror=True, dtype="uint8"))
 
         with par.use_mesh(mesh):
             trainer = par.ShardedTrainer(

@@ -274,3 +274,26 @@ def test_multipart_jpeg_through_native_pipeline(tmp_path):
     assert ok.all()
     assert labels[0, 0] == 5.0 and labels[1, 0] == 7.0
     pipe.close()
+
+
+def test_image_record_iter_uint8_dtype(tmp_path):
+    """dtype='uint8' ships raw pixels (device-side normalization); values
+    must equal the float32 path's un-normalized output exactly."""
+    p = str(tmp_path / "u8.rec")
+    _write_img_rec(p)
+    kw = dict(path_imgrec=p, data_shape=(3, 32, 32), batch_size=8)
+    b_f32 = next(iter(ImageRecordIter(**kw)))
+    it = ImageRecordIter(dtype="uint8", **kw)
+    b_u8 = next(iter(it))
+    arr = b_u8.data[0].asnumpy()
+    assert arr.dtype == onp.uint8
+    onp.testing.assert_array_equal(arr.astype(onp.float32),
+                                   b_f32.data[0].asnumpy())
+    onp.testing.assert_array_equal(b_u8.label[0].asnumpy(),
+                                   b_f32.label[0].asnumpy())
+    # raw pixels cannot carry host-side normalization
+    with pytest.raises(ValueError):
+        ImageRecordIter(dtype="uint8", mean_r=123.0, **kw)
+    # device-side cast is where normalization now lives
+    x = b_u8.data[0].astype("float32")
+    assert x.dtype == onp.float32

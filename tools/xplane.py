@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Minimal XSpace/XPlane profile reader (no TensorFlow dependency).
+
+`jax.profiler.trace` writes `*.xplane.pb` — a `tensorflow.profiler.XSpace`
+proto holding per-core timelines with per-XLA-op events and stats (the
+TensorBoard profile plugin's input).  Neither TensorFlow nor the plugin is
+in this image, so this module decodes the protobuf wire format directly
+(field numbers from tsl/profiler/protobuf/xplane.proto) and aggregates
+device-op time — enough for "where does the step time go" analysis:
+
+    python tools/xplane.py path/to/foo.xplane.pb [--top 30] [--plane tpu]
+
+Outputs one row per HLO op name: total device ps, count, share.  Used by
+the perf work for BASELINE workloads (bench.py --profile writes traces).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import struct
+import sys
+
+
+def _read_varint(buf: memoryview, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: memoryview):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    value: int for varint/fixed, memoryview for length-delimited."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            val = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _parse_event(buf: memoryview):
+    """XEvent: metadata_id=1, offset_ps=2, duration_ps=3, stats=4,
+    num_occurrences=5."""
+    md = dur = 0
+    for f, _, v in _fields(buf):
+        if f == 1:
+            md = v
+        elif f == 3:
+            dur = v
+    return md, dur
+
+
+def _parse_line(buf: memoryview):
+    """XLine: id=1, name=2, events=4 (verified against protoc
+    --decode_raw of a jax.profiler TPU capture)."""
+    name = ""
+    events = []
+    for f, wt, v in _fields(buf):
+        if f == 2 and wt == 2:
+            name = bytes(v).decode("utf-8", "replace")
+        elif f == 4 and wt == 2:
+            events.append(_parse_event(v))
+    return name, events
+
+
+def _parse_event_metadata(buf: memoryview):
+    """XEventMetadata: id=1, name=2, display_name=3."""
+    mid = 0
+    name = ""
+    for f, wt, v in _fields(buf):
+        if f == 1:
+            mid = v
+        elif f == 2:
+            name = bytes(v).decode("utf-8", "replace")
+    return mid, name
+
+
+def parse_plane(buf: memoryview):
+    """XPlane: id=1, name=2, lines=3, event_metadata=4, stat_metadata=5.
+    Returns (name, {line_name: [(metadata_id, duration_ps)]}, {id: name})."""
+    pname = ""
+    lines = {}
+    emeta = {}
+    for f, wt, v in _fields(buf):
+        if f == 2:
+            pname = bytes(v).decode("utf-8", "replace")
+        elif f == 3:
+            lname, evs = _parse_line(v)
+            lines.setdefault(lname, []).extend(evs)
+        elif f == 4:  # map<int64, XEventMetadata>: entry key=1, value=2
+            mid = 0
+            md = (0, "")
+            for ef, _, ev in _fields(v):
+                if ef == 1:
+                    mid = ev
+                elif ef == 2:
+                    md = _parse_event_metadata(ev)
+            emeta[mid or md[0]] = md[1]
+    return pname, lines, emeta
+
+
+def iter_planes(path: str):
+    """Yield (name, lines, event_metadata) per XPlane in the XSpace file."""
+    with open(path, "rb") as f:
+        data = memoryview(f.read())
+    for f_, wt, v in _fields(data):
+        if f_ == 1 and wt == 2:
+            yield parse_plane(v)
+
+
+def aggregate(path: str, plane_filter: str = "TPU"):
+    """Sum duration_ps per op name across matching planes.
+
+    Returns {plane_name: {line_name: Counter{op_name: total_ps}}} plus a
+    parallel count table.
+    """
+    out = {}
+    for pname, lines, emeta in iter_planes(path):
+        if plane_filter.lower() not in pname.lower():
+            continue
+        per_line = {}
+        for lname, evs in lines.items():
+            tot = collections.Counter()
+            cnt = collections.Counter()
+            for mid, dur in evs:
+                name = emeta.get(mid, str(mid))
+                tot[name] += dur
+                cnt[name] += 1
+            per_line[lname] = (tot, cnt)
+        out[pname] = per_line
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--plane", default="TPU",
+                    help="substring filter on plane name (default TPU)")
+    ap.add_argument("--line", default=None,
+                    help="substring filter on line (lane) name")
+    args = ap.parse_args()
+
+    found = False
+    for pname, per_line in aggregate(args.path, args.plane).items():
+        for lname, (tot, cnt) in sorted(per_line.items()):
+            if args.line and args.line.lower() not in lname.lower():
+                continue
+            ssum = sum(tot.values())
+            if not ssum:
+                continue
+            found = True
+            print(f"== plane: {pname!r}  line: {lname!r}  "
+                  f"total {ssum/1e12:.4f}s")
+            for name, d in tot.most_common(args.top):
+                print(f"  {d/1e9:10.3f}ms {cnt[name]:6d}x {100*d/ssum:5.1f}%"
+                      f"  {name[:80]}")
+    if not found:
+        print("no matching plane/line with events; planes present:",
+              file=sys.stderr)
+        for pname, lines, _ in iter_planes(args.path):
+            print(f"  {pname!r}: lines {list(lines)[:8]}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
