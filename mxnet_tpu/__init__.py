@@ -25,6 +25,18 @@ if _os.environ.get("MXNET_TPU_COMPILE_CACHE"):
                            0.5)
     except Exception:
         pass  # older jax: cache knobs absent — degrade to no cache
+# Matmul precision contract: upstream f32 dot/conv is TRUE f32 on every
+# backend, while the TPU MXU natively computes f32 contractions as bf16
+# passes.  Default to 'highest' (6-pass f32 — bitwise-meaningful f32
+# parity; bf16 inputs are unaffected, so AMP keeps full MXU speed) with
+# an env knob to relax for f32-heavy speed runs.  Accepts any
+# jax_default_matmul_precision value: highest|high|default|float32|
+# tensorfloat32|bfloat16_3x|bfloat16.
+_prec = _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "highest").lower()
+if _prec not in ("", "default"):
+    import jax as _jax
+    _jax.config.update("jax_default_matmul_precision", _prec)
+
 if _os.environ.get("MXNET_ENGINE_TYPE", "").lower() == "naiveengine":
     # SURVEY.md §5.2: the fully synchronous debug engine ≡ no XLA staging
     import jax as _jax

@@ -141,6 +141,46 @@ def is_recording() -> bool:
     return getattr(_STATE, "recording", False)
 
 
+# Execution-platform hint: ops.invoke runs pure jax functions under
+# jax.vjp, where inputs are tracers that no longer carry a device, yet
+# device-dependent dispatch decisions (Pallas compiled vs interpret) must
+# follow the NDArray's context, not the process default backend — on a
+# TPU host a cpu()-context op still executes on the CPU XLA backend.
+
+def exec_platform() -> Optional[str]:
+    return getattr(_STATE, "exec_platform", None)
+
+
+@contextlib.contextmanager
+def executing_on(platform: Optional[str]):
+    prev = exec_platform()
+    _STATE.exec_platform = platform
+    try:
+        yield
+    finally:
+        _STATE.exec_platform = prev
+
+
+def resolve_exec_platform(x=None) -> str:
+    """Platform a jax computation over ``x`` will actually execute on.
+
+    A concrete array knows its device; under a trace (jax.vjp in
+    ops.invoke, jit) fall back to the dispatcher's execution-platform
+    hint, then to the process default backend.  Deciding from the global
+    default alone is wrong on a TPU host running a cpu()-context op — the
+    exact case the cross-backend consistency battery exercises.
+    """
+    import jax
+    if x is not None and isinstance(x, jax.Array) \
+            and not isinstance(x, jax.core.Tracer):
+        try:
+            return next(iter(x.devices())).platform
+        except Exception:
+            pass
+    hint = exec_platform()
+    return hint if hint is not None else jax.default_backend()
+
+
 def set_recording(flag: bool) -> bool:
     prev = is_recording()
     _STATE.recording = bool(flag)

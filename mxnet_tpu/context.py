@@ -11,6 +11,7 @@ dispatch replaces MXNet's stream/engine machinery (SURVEY.md §7.1).
 """
 from __future__ import annotations
 
+import functools
 import threading
 from typing import List, Optional
 
@@ -76,11 +77,14 @@ class Context:
 Device = Context  # 2.x name
 
 
+@functools.lru_cache(maxsize=None)
 def _backend_devices(platform: str) -> List[jax.Device]:
     """PROCESS-LOCAL devices of a platform: MXNet context semantics are
     per-worker (each worker's cpu(0)/tpu(0) is its own), and in a
     multi-process job placing eager arrays on another process's device is
-    both wrong and unsupported."""
+    both wrong and unsupported.  Cached — device enumeration sits on the
+    eager dispatch hot path; utils.platform.force_cpu() invalidates when
+    it swaps the backend out."""
     try:
         return list(jax.local_devices(backend=platform))
     except RuntimeError:

@@ -398,7 +398,8 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        return [DataDesc("data", (self.batch_size,) + self.data_shape,
+                         dtype=self._dtype)]
 
     @property
     def provide_label(self):

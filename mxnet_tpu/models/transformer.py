@@ -363,7 +363,9 @@ def run_blocks(blocks, x, mask=None, scan=None, remat=False):
                     finally:
                         if base_key is not None:
                             _random.pop_trace_key()
-                x = NDArray(jax.checkpoint(f)(x.jax))
+                policy = (jax.checkpoint_policies.checkpoint_dots
+                          if remat == "dots" else None)
+                x = NDArray(jax.checkpoint(f, policy=policy)(x.jax))
             return x
     for blk in blocks:
         x = blk(x, mask)

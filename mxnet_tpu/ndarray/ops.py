@@ -63,13 +63,18 @@ def invoke(name, pure_fn, nd_inputs, nout=1, ctx=None, differentiable=True):
     recording = _base.is_recording() and differentiable
     in_nodes = [node_of(x) for x in nd_inputs] if recording else None
     needs_grad = recording and any(n is not None for n in in_nodes)
-    if needs_grad:
-        outs, vjp_fn = jax.vjp(pure_fn, *arrs)
-    else:
-        outs = pure_fn(*arrs)
+    ctx = ctx or (nd_inputs[0].context if nd_inputs else current_context())
+    try:
+        platform = ctx.jax_device.platform
+    except Exception:   # backend not up yet / device resolution failed
+        platform = None
+    with _base.executing_on(platform):
+        if needs_grad:
+            outs, vjp_fn = jax.vjp(pure_fn, *arrs)
+        else:
+            outs = pure_fn(*arrs)
     multi = isinstance(outs, (tuple, list))
     outs_list = list(outs) if multi else [outs]
-    ctx = ctx or (nd_inputs[0].context if nd_inputs else current_context())
     res = [NDArray(o, ctx=ctx) for o in outs_list]
     if needs_grad:
         node = OpNode(

@@ -49,11 +49,14 @@ def _attention_ref(q, k, v, *, causal=False, mask=None, scale=None,
 
 # ------------------------------------------------------------------ dispatch
 
-def _use_flash(q_shape, causal, mask, dropout, k_shape=None) -> bool:
+def _use_flash(q_shape, causal, mask, dropout, k_shape=None,
+               platform=None) -> bool:
     """Flash kernel handles: SELF-attention (tq == tk — cross-attention
     with a different source length falls back to the XLA path), no
     explicit mask, no attention dropout, long 128-aligned sequences,
-    head dims the MXU tiles well (64/128/256)."""
+    head dims the MXU tiles well (64/128/256).  ``platform`` is where the
+    op will execute (resolved per-call — a cpu()-context op on a TPU host
+    must take the XLA reference path, not compiled Pallas)."""
     if mask is not None or dropout > 0.0:
         return False
     b, t, h, d = q_shape
@@ -61,7 +64,7 @@ def _use_flash(q_shape, causal, mask, dropout, k_shape=None) -> bool:
         return False
     if t < 256 or t % 128 or d not in (64, 128, 256):
         return False
-    if jax.default_backend() != "tpu":
+    if (platform or jax.default_backend()) != "tpu":
         return False
     try:
         from . import flash  # noqa: F401
@@ -72,7 +75,8 @@ def _use_flash(q_shape, causal, mask, dropout, k_shape=None) -> bool:
 
 def flash_attention(q, k, v, *, causal=False, scale=None):
     """Jax-level flash attention entry (Pallas on TPU, reference on CPU)."""
-    if _use_flash(q.shape, causal, None, 0.0, k.shape):
+    if _use_flash(q.shape, causal, None, 0.0, k.shape,
+                  platform=_base.resolve_exec_platform(q)):
         from .flash import flash_attention as _pallas
         return _pallas(q, k, v, causal=causal, scale=scale)
     return _attention_ref(q, k, v, causal=causal, scale=scale)
@@ -99,6 +103,7 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
     if dropout > 0.0 and _base.is_training():
         dkey = _random.next_key(query.context)
     mask_val = mask.jax if hasattr(mask, "jax") else mask
+    q_seg = kv_seg = None
     if segment_ids is not None:
         def _seg(x):
             return x.jax if hasattr(x, "jax") else jnp.asarray(x)
@@ -106,19 +111,38 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
         q_seg = _seg(segment_ids)
         kv_seg = _seg(kv_segment_ids) if kv_segment_ids is not None \
             else q_seg
+        bq_, tq_ = query.shape[0], query.shape[1]
+        tk_ = key.shape[1]
+        if tuple(q_seg.shape) != (bq_, tq_) or \
+                tuple(kv_seg.shape) != (bq_, tk_):
+            raise _base.MXNetError(
+                f"segment_ids must be (B, Tq)=({bq_}, {tq_}) and "
+                f"kv_segment_ids (B, Tk)=({bq_}, {tk_}); got "
+                f"{tuple(q_seg.shape)} / {tuple(kv_seg.shape)} — "
+                "cross-attention with Tq != Tk needs an explicit "
+                "kv_segment_ids")
+    elif kv_segment_ids is not None:
+        raise _base.MXNetError("kv_segment_ids requires segment_ids")
+
+    def _full_mask():
+        """Segment equality folded into the dense mask — the O(Tq*Tk)
+        fallback representation; the Pallas path keeps the raw (B, T) ids
+        and masks per-tile in VMEM instead."""
+        if q_seg is None:
+            return mask_val
         seg_mask = (q_seg[:, None, :, None] ==
                     kv_seg[:, None, None, :])        # (B, 1, Tq, Tk)
-        mask_val = seg_mask if mask_val is None else \
+        return seg_mask if mask_val is None else \
             jnp.logical_and(mask_val, seg_mask)
 
-    if impl == "flash" and (mask is not None or segment_ids is not None
-                            or dropout > 0.0):
+    if impl == "flash" and (mask is not None or dropout > 0.0):
         raise _base.MXNetError(
-            "impl='flash' does not support an explicit mask, segment_ids "
-            "or attention dropout — use impl='auto'/'ref'")
+            "impl='flash' does not support an explicit mask or attention "
+            "dropout — use impl='auto'/'ref'")
 
     if impl == "flash" and not _use_flash(query.shape, causal, mask_val,
-                                          dropout, key.shape):
+                                          dropout, key.shape,
+                                          platform=_base.resolve_exec_platform(query.jax)):
         raise _base.MXNetError(
             f"impl='flash' requested but the Pallas kernel does not support "
             f"this configuration (shape={tuple(query.shape)}, platform="
@@ -129,10 +153,13 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
 
     def f(q, k, v):
         if impl != "ref" and _use_flash(q.shape, causal, mask_val, dropout,
-                                        k.shape):
+                                        k.shape,
+                                        platform=_base.resolve_exec_platform(q)):
             from .flash import flash_attention as _pallas
-            return _pallas(q, k, v, causal=causal, scale=scale)
-        return _attention_ref(q, k, v, causal=causal, mask=mask_val,
+            return _pallas(q, k, v, causal=causal, scale=scale,
+                           segment_ids=q_seg,
+                           kv_segment_ids=kv_seg)
+        return _attention_ref(q, k, v, causal=causal, mask=_full_mask(),
                               scale=scale, dropout=dropout, dropout_key=dkey)
 
     return invoke("dot_product_attention", f, nd_in)

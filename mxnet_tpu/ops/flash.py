@@ -70,14 +70,34 @@ def _clamp_blocks(bq: int, bk: int, d: int, itemsize: int):
     return bq, bk
 
 
-def _default_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+def _default_interpret(x) -> bool:
+    from ..base import resolve_exec_platform
+    return resolve_exec_platform(x) != "tpu"
 
 
 # --------------------------------------------------------------------- fwd
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, nk):
+def _seg_mask(qseg_ref, kseg_ref, s):
+    """Mask score tile entries whose q/k tokens belong to different packed
+    segments.  Returns (masked s, run-this-tile predicate).  The skip
+    predicate is a range-disjointness test on the tile's segment ids —
+    exact for the packed layout (ids non-decreasing along the row) and
+    conservative (never skips a tile that could match) for arbitrary
+    ids."""
+    qs = qseg_ref[0, 0, :]                             # (bq,) int32
+    ks = kseg_ref[0, 0, :]                             # (bk,) int32
+    s = jnp.where(qs[:, None] == ks[None, :], s, _MASK)
+    overlap = jnp.logical_and(jnp.min(ks) <= jnp.max(qs),
+                              jnp.max(ks) >= jnp.min(qs))
+    return s, overlap
+
+
+def _fwd_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
+    if has_seg:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -99,11 +119,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
+        if has_seg:
+            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
         m_prev = m_ref[:, :1]                          # (bq, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_next)                        # (bq, bk)
+        # masked-safe exp: a tile whose every entry is _MASK for some row
+        # (the row's segment starts in a LATER tile) has m_next == _MASK
+        # there, and bare exp(s - m_next) would contribute exp(0)=1 per
+        # masked entry.  Zero masked entries explicitly instead.
+        p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - m_next))
         corr = jnp.exp(m_prev - m_next)                # (bq, 1)
         l_next = corr * l_prev + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -113,8 +139,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
 
+    run = None
     if causal:
-        @pl.when(ki * block_k < (qi + 1) * block_q)
+        run = ki * block_k < (qi + 1) * block_q
+    if has_seg:
+        qs = qseg_ref[0, 0, :]
+        ks = kseg_ref[0, 0, :]
+        overlap = jnp.logical_and(jnp.min(ks) <= jnp.max(qs),
+                                  jnp.max(ks) >= jnp.min(qs))
+        run = overlap if run is None else jnp.logical_and(run, overlap)
+    if run is not None:
+        @pl.when(run)
         def _():
             _tile()
     else:
@@ -123,26 +158,63 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     @pl.when(ki == nk - 1)
     def _finish():
         l = l_ref[:, :1]
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
+        # rows with NO matching key anywhere (possible only in degenerate
+        # cross-segment cases) get zeros out and a finite lse of _MASK so
+        # the backward recompute exp(s - lse) stays 0, never inf
+        empty = l <= 0.0
+        o_ref[0] = jnp.where(
+            empty, 0.0, acc_ref[:] / jnp.where(empty, 1.0, l)
+        ).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = jnp.where(
+            empty[:, 0], _MASK, m_ref[:, 0] + jnp.log(
+                jnp.where(empty[:, 0], 1.0, l_ref[:, 0])))
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _seg_specs(nheads, block_q, block_k):
+    """BlockSpecs for (B, 1, T) segment-id planes: the grid's flattened
+    batch*heads coordinate maps back to the batch row with b // nheads."""
+    return [
+        pl.BlockSpec((1, 1, block_q),
+                     lambda b, i, j: (b // nheads, 0, i)),
+        pl.BlockSpec((1, 1, block_k),
+                     lambda b, i, j: (b // nheads, 0, j)),
+    ]
+
+
+def _dkv_seg_specs(nheads, block_q, block_k):
+    """Same as _seg_specs for the dkv grid, whose (b, j, i) coords carry
+    the kv block index second."""
+    return [
+        pl.BlockSpec((1, 1, block_q),
+                     lambda b, j, i: (b // nheads, 0, i)),
+        pl.BlockSpec((1, 1, block_k),
+                     lambda b, j, i: (b // nheads, 0, j)),
+    ]
+
+
+def _fwd(q, k, v, q_seg, kv_seg, nheads, causal, scale, block_q, block_k,
+         interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     nq = pl.cdiv(tq, block_q)
     nk = pl.cdiv(tk, block_k)
+    has_seg = q_seg is not None
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, has_seg=has_seg,
         block_q=block_q, block_k=block_k, nk=nk)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        in_specs += _seg_specs(nheads, block_q, block_k)
+        args += [q_seg, kv_seg]
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse is (bh, 1, tq) so each qi owns its own (1, 1, block_q)
@@ -166,14 +238,34 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             flops=4 * bh * tq * tk * d, transcendentals=bh * tq * tk,
             bytes_accessed=2 * (q.size + k.size + v.size) * q.dtype.itemsize),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
 # --------------------------------------------------------------------- bwd
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale, causal, block_q, block_k, nk):
+def _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
+                  qseg_ref, kseg_ref):
+    run = None
+    if causal:
+        run = ki * block_k < (qi + 1) * block_q
+    if has_seg:
+        qs = qseg_ref[0, 0, :]
+        ks = kseg_ref[0, 0, :]
+        overlap = jnp.logical_and(jnp.min(ks) <= jnp.max(qs),
+                                  jnp.max(ks) >= jnp.min(qs))
+        run = overlap if run is None else jnp.logical_and(run, overlap)
+    return run
+
+
+def _dq_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_ref) = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -193,9 +285,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
+        if has_seg:
+            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
-        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (bq, bk)
@@ -203,8 +297,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    if causal:
-        @pl.when(ki * block_k < (qi + 1) * block_q)
+    run = _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
+                        qseg_ref, kseg_ref)
+    if run is not None:
+        @pl.when(run)
         def _():
             _tile()
     else:
@@ -215,9 +311,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, block_q, block_k, nq):
+def _dkv_kernel(*refs, scale, causal, has_seg, block_q, block_k, nq):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -239,9 +340,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
+        if has_seg:
+            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
-        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - lse[:, None]))
         # dV += P^T @ dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -254,8 +357,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    if causal:
-        @pl.when((qi + 1) * block_q > ki * block_k)
+    run = _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
+                        qseg_ref, kseg_ref)
+    if run is not None:
+        @pl.when(run)
         def _():
             _tile()
     else:
@@ -267,47 +372,58 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, out, lse, do, causal, scale, block_q, block_k,
-              interpret):
+def _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do, nheads, causal, scale,
+              block_q, block_k, interpret):
     bh, tq, d = q.shape
     tk = k.shape[1]
     nq = pl.cdiv(tq, block_q)
     nk = pl.cdiv(tk, block_k)
+    has_seg = q_seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]               # (bh, 1, tq)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+    ]
+    args = [q, k, v, do, lse, delta]
+    if has_seg:
+        dq_in_specs += _seg_specs(nheads, block_q, block_k)
+        args += [q_seg, kv_seg]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          has_seg=has_seg,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*args)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+    ]
+    if has_seg:
+        dkv_in_specs += _dkv_seg_specs(nheads, block_q, block_k)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          has_seg=has_seg,
                           block_q=block_q, block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -323,27 +439,45 @@ def _bwd_impl(q, k, v, out, lse, do, causal, scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*args)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------- custom_vjp glue
+# Segment ids travel as primal args (they are data, not static config) and
+# return symbolic-zero cotangents of dtype float0, the JAX contract for
+# integer primal inputs.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+def _int_zero_cotangent(x):
+    if x is None:
+        return None
+    import numpy as _np
+
+    from jax import dtypes as _dtypes
+    return _np.zeros(x.shape, _dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, q_seg, kv_seg, nheads, causal, scale, block_q, block_k,
+           interpret):
+    out, _ = _fwd(q, k, v, q_seg, kv_seg, nheads, causal, scale,
+                  block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, q_seg, kv_seg, nheads, causal, scale, block_q,
+               block_k, interpret):
+    out, lse = _fwd(q, k, v, q_seg, kv_seg, nheads, causal, scale,
+                    block_q, block_k, interpret)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
-    return _bwd_impl(q, k, v, out, lse, do, causal, scale,
-                     block_q, block_k, interpret)
+def _flash_bwd(nheads, causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, q_seg, kv_seg, out, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, q_seg, kv_seg, out, lse, do, nheads,
+                           causal, scale, block_q, block_k, interpret)
+    return (dq, dk, dv,
+            _int_zero_cotangent(q_seg), _int_zero_cotangent(kv_seg))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -351,6 +485,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
+                    segment_ids=None, kv_segment_ids=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None):
@@ -360,6 +495,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
     dispatcher in :mod:`mxnet_tpu.ops.attention` guarantees this before
     routing here).  ``interpret`` defaults to True off-TPU so the same
     kernel is unit-testable on the CPU backend.
+
+    ``segment_ids`` (B, Tq) int enables SEQUENCE PACKING in-kernel:
+    tokens attend only within their own segment; tiles whose q/k segment
+    ranges cannot overlap are skipped at block level (exact skip for the
+    packed non-decreasing layout), so packed long-context training keeps
+    the O(T) memory AND the sub-quadratic compute of the kernel.
+    ``kv_segment_ids`` defaults to ``segment_ids``.  Degenerate rows with
+    no matching key anywhere output zeros (the XLA reference path gives a
+    uniform average over fully-masked rows — such rows carry no
+    information either way).
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -382,11 +527,25 @@ def flash_attention(q, k, v, *, causal: bool = False,
             f"seq lens ({tq}, {tk}) must divide by blocks "
             f"({block_q}, {block_k})")
     if interpret is None:
-        interpret = _default_interpret()
+        interpret = _default_interpret(q)
+
+    q_seg = kv_seg = None
+    if segment_ids is not None:
+        q_seg = jnp.asarray(segment_ids, jnp.int32)[:, None, :]  # (B,1,Tq)
+        kv_seg = (jnp.asarray(kv_segment_ids, jnp.int32)[:, None, :]
+                  if kv_segment_ids is not None else q_seg)
+        if q_seg.shape != (b, 1, tq) or kv_seg.shape != (b, 1, tk):
+            raise ValueError(
+                f"segment_ids must be (B, Tq)=({b}, {tq}) / "
+                f"(B, Tk)=({b}, {tk}); got {segment_ids.shape}"
+                + (f" / {kv_segment_ids.shape}"
+                   if kv_segment_ids is not None else ""))
+    elif kv_segment_ids is not None:
+        raise ValueError("kv_segment_ids requires segment_ids")
 
     def flat(x, t):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    out = _flash(flat(q, tq), flat(k, tk), flat(v, tk),
-                 causal, scale, block_q, block_k, bool(interpret))
+    out = _flash(flat(q, tq), flat(k, tk), flat(v, tk), q_seg, kv_seg,
+                 h, causal, scale, block_q, block_k, bool(interpret))
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -35,6 +35,12 @@ def force_cpu(n_devices: int = 8) -> None:
             _xb._clear_backends()
     except Exception:
         pass
+    try:  # context device caches hold devices of the dropped backend
+        from .. import context as _ctx
+        _ctx._ACCEL_CACHE = None
+        _ctx._backend_devices.cache_clear()
+    except Exception:
+        pass
 
 
 def probe_accelerator(timeout: float = 120.0) -> bool:

@@ -1,0 +1,66 @@
+"""The f32 matmul precision contract (parity: upstream f32 dot/conv is
+TRUE f32 on every backend; the TPU MXU's native bf16 passes are opted
+into, never silently defaulted — VERDICT r3 item 2).
+
+mxnet_tpu sets ``jax_default_matmul_precision='highest'`` at import
+unless MXNET_TPU_MATMUL_PRECISION overrides it, which (a) makes the
+cross-backend consistency battery's tight f32 tolerances meaningful on
+chip, and (b) leaves bf16/AMP inputs at full MXU speed (the precision
+flag only affects f32 contractions).
+"""
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as onp
+
+import mxnet_tpu as mx
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_default_is_highest():
+    # conftest imports mxnet_tpu with no override → the package default
+    assert jax.config.jax_default_matmul_precision == "highest"
+
+
+def test_env_knob_respected():
+    code = (
+        "from mxnet_tpu.utils.platform import force_cpu; force_cpu(1)\n"
+        "import mxnet_tpu, jax\n"
+        "print(jax.config.jax_default_matmul_precision)\n"
+    )
+    env = dict(os.environ, MXNET_TPU_MATMUL_PRECISION="bfloat16",
+               PYTHONPATH=_REPO)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().endswith("bfloat16")
+
+
+def test_env_knob_default_leaves_unset():
+    code = (
+        "from mxnet_tpu.utils.platform import force_cpu; force_cpu(1)\n"
+        "import mxnet_tpu, jax\n"
+        "print(repr(jax.config.jax_default_matmul_precision))\n"
+    )
+    env = dict(os.environ, MXNET_TPU_MATMUL_PRECISION="default",
+               PYTHONPATH=_REPO)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().endswith("None")
+
+
+def test_f32_dot_is_true_f32():
+    # values with mantissa structure bf16 destroys: 1 + 2^-12.  A bf16
+    # MXU pass would round the operands to 1.0 and the product row-sum to
+    # k; HIGHEST keeps the exact f32 result k*(1+2^-12)^2.
+    k = 64
+    val = onp.float32(1.0) + onp.float32(2.0) ** -12
+    a = mx.nd.full((8, k), float(val))
+    b = mx.nd.full((k, 8), float(val))
+    out = mx.nd.dot(a, b).asnumpy()
+    expect = onp.float32(k) * val * val
+    onp.testing.assert_allclose(out, expect, rtol=1e-6)
