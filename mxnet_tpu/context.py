@@ -11,7 +11,6 @@ dispatch replaces MXNet's stream/engine machinery (SURVEY.md §7.1).
 """
 from __future__ import annotations
 
-import functools
 import threading
 from typing import List, Optional
 
@@ -77,29 +76,49 @@ class Context:
 Device = Context  # 2.x name
 
 
-@functools.lru_cache(maxsize=None)
+_DEVICE_CACHE: dict = {}
+
+
 def _backend_devices(platform: str) -> List[jax.Device]:
     """PROCESS-LOCAL devices of a platform: MXNet context semantics are
     per-worker (each worker's cpu(0)/tpu(0) is its own), and in a
     multi-process job placing eager arrays on another process's device is
-    both wrong and unsupported.  Cached — device enumeration sits on the
-    eager dispatch hot path; utils.platform.force_cpu() invalidates when
-    it swaps the backend out."""
-    try:
-        return list(jax.local_devices(backend=platform))
-    except RuntimeError:
-        return []
+    both wrong and unsupported.  Successful lookups are cached — device
+    enumeration sits on the eager dispatch hot path — but FAILURES are
+    not: a TPU plugin that initializes late relative to the first
+    tpu-context lookup must become visible on retry, not stay pinned to
+    the [] result for the life of the process.  utils.platform.force_cpu()
+    invalidates when it swaps the backend out."""
+    devs = _DEVICE_CACHE.get(platform)
+    if devs is None:
+        try:
+            devs = list(jax.local_devices(backend=platform))
+        except RuntimeError:
+            return []
+        if devs:
+            _DEVICE_CACHE[platform] = devs
+    return devs
+
+
+# lru_cache-compatible invalidation shim: force_cpu() and older callers
+# invalidate via _backend_devices.cache_clear()
+_backend_devices.cache_clear = _DEVICE_CACHE.clear  # type: ignore[attr-defined]
 
 
 _ACCEL_CACHE: Optional[List[jax.Device]] = None
 
 
 def accelerator_devices() -> List[jax.Device]:
-    """All non-host devices (TPU chips), else empty."""
+    """All non-host devices (TPU chips), else empty.
+
+    An empty result is NOT cached (same late-plugin rule as
+    :func:`_backend_devices`): a TPU backend that comes up after the
+    first lookup must be found on retry, not shadowed by a stale []
+    for the life of the process."""
     global _ACCEL_CACHE
-    if _ACCEL_CACHE is None:
-        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
-        _ACCEL_CACHE = devs
+    if not _ACCEL_CACHE:
+        _ACCEL_CACHE = [d for d in jax.local_devices()
+                        if d.platform != "cpu"]
     return _ACCEL_CACHE
 
 

@@ -39,6 +39,13 @@ def _attention_ref(q, k, v, *, causal=False, mask=None, scale=None,
     if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    if causal or mask is not None:
+        # fully-masked (degenerate) rows: softmax of an all-_NEG_INF row
+        # is a uniform average; zero it instead so this path is
+        # bitwise-comparable with the Pallas kernel, which outputs zeros
+        # for rows with no matching key (flash.py _finish)
+        any_valid = jnp.any(logits > 0.5 * _NEG_INF, axis=-1, keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
     if dropout > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout),

@@ -42,26 +42,31 @@ _LANES = 128
 _VMEM_BUDGET = 12 * 2 ** 20
 
 
-def _vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
+def _vmem_bytes(bq: int, bk: int, d: int, itemsize: int,
+                has_seg: bool = False) -> int:
     """Working-set model of one grid step, sized for the WORST of the
     three kernels (the bwd dq/dkv kernels stream four tiles — q, k, v,
     do — where fwd streams three): two live (bq, bk) f32 score-tile
     temporaries (s→p and dp→ds are reused in place), double-buffered
     input tiles, double-buffered output tile(s), and the larger of the
-    fwd/dkv f32 accumulator scratch sets."""
+    fwd/dkv f32 accumulator scratch sets.  The segment path adds one
+    more (bq, bk)-sized temporary (the q==k equality mask materialized
+    by the ``jnp.where``) plus the double-buffered int32 seg-id tiles."""
     score = 2 * 4 * bq * bk
     tiles = 2 * itemsize * d * 2 * (bq + bk)      # dq/dkv stream 4 tiles
     outs = 2 * itemsize * bq * d
     scratch = 4 * max(bq * d + 2 * bq * _LANES,   # fwd: acc + m + l
                       2 * bk * d)                 # dkv: dk_acc + dv_acc
-    return score + tiles + outs + scratch
+    seg = (4 * bq * bk + 2 * 4 * (bq + bk)) if has_seg else 0
+    return score + tiles + outs + scratch + seg
 
 
-def _clamp_blocks(bq: int, bk: int, d: int, itemsize: int):
+def _clamp_blocks(bq: int, bk: int, d: int, itemsize: int,
+                  has_seg: bool = False):
     """Shrink (block_q, block_k) until the working set fits the VMEM
     budget — head-dim/dtype aware, so d=64 bf16 keeps the measured-fast
     1024x1024 while d=256 f32 lands on a safe smaller tile."""
-    while _vmem_bytes(bq, bk, d, itemsize) > _VMEM_BUDGET and \
+    while _vmem_bytes(bq, bk, d, itemsize, has_seg) > _VMEM_BUDGET and \
             (bq > 128 or bk > 128):
         if bk >= bq and bk > 128:
             bk //= 2
@@ -79,17 +84,12 @@ def _default_interpret(x) -> bool:
 
 def _seg_mask(qseg_ref, kseg_ref, s):
     """Mask score tile entries whose q/k tokens belong to different packed
-    segments.  Returns (masked s, run-this-tile predicate).  The skip
-    predicate is a range-disjointness test on the tile's segment ids —
-    exact for the packed layout (ids non-decreasing along the row) and
-    conservative (never skips a tile that could match) for arbitrary
-    ids."""
+    segments.  The tile-skip predicate lives separately in
+    :func:`_run_pred` (shared by all three kernels) so the min/max
+    reductions are computed once per grid step."""
     qs = qseg_ref[0, 0, :]                             # (bq,) int32
     ks = kseg_ref[0, 0, :]                             # (bk,) int32
-    s = jnp.where(qs[:, None] == ks[None, :], s, _MASK)
-    overlap = jnp.logical_and(jnp.min(ks) <= jnp.max(qs),
-                              jnp.max(ks) >= jnp.min(qs))
-    return s, overlap
+    return jnp.where(qs[:, None] == ks[None, :], s, _MASK)
 
 
 def _fwd_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
@@ -120,7 +120,7 @@ def _fwd_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
         if has_seg:
-            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
+            s = _seg_mask(qseg_ref, kseg_ref, s)
         m_prev = m_ref[:, :1]                          # (bq, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -139,15 +139,9 @@ def _fwd_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
         m_ref[:] = jnp.broadcast_to(m_next, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_next, l_ref.shape)
 
-    run = None
-    if causal:
-        run = ki * block_k < (qi + 1) * block_q
-    if has_seg:
-        qs = qseg_ref[0, 0, :]
-        ks = kseg_ref[0, 0, :]
-        overlap = jnp.logical_and(jnp.min(ks) <= jnp.max(qs),
-                                  jnp.max(ks) >= jnp.min(qs))
-        run = overlap if run is None else jnp.logical_and(run, overlap)
+    run = _run_pred(causal, has_seg, qi, ki, block_q, block_k,
+                    qseg_ref if has_seg else None,
+                    kseg_ref if has_seg else None)
     if run is not None:
         @pl.when(run)
         def _():
@@ -244,8 +238,13 @@ def _fwd(q, k, v, q_seg, kv_seg, nheads, causal, scale, block_q, block_k,
 
 # --------------------------------------------------------------------- bwd
 
-def _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
-                  qseg_ref, kseg_ref):
+def _run_pred(causal, has_seg, qi, ki, block_q, block_k,
+              qseg_ref, kseg_ref):
+    """Tile-skip predicate shared by all three kernels: the causal
+    above-diagonal test plus a range-disjointness test on the tile's
+    segment ids — exact for the packed layout (ids non-decreasing along
+    the row) and conservative (never skips a tile that could match) for
+    arbitrary ids."""
     run = None
     if causal:
         run = ki * block_k < (qi + 1) * block_q
@@ -286,7 +285,7 @@ def _dq_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
         if has_seg:
-            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
+            s = _seg_mask(qseg_ref, kseg_ref, s)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
         p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - lse[:, None]))
@@ -297,7 +296,7 @@ def _dq_kernel(*refs, scale, causal, has_seg, block_q, block_k, nk):
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    run = _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
+    run = _run_pred(causal, has_seg, qi, ki, block_q, block_k,
                         qseg_ref, kseg_ref)
     if run is not None:
         @pl.when(run)
@@ -341,7 +340,7 @@ def _dkv_kernel(*refs, scale, causal, has_seg, block_q, block_k, nq):
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(col <= row, s, _MASK)
         if has_seg:
-            s, _ = _seg_mask(qseg_ref, kseg_ref, s)
+            s = _seg_mask(qseg_ref, kseg_ref, s)
         lse = lse_ref[0, 0, :]
         delta = delta_ref[0, 0, :]
         p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - lse[:, None]))
@@ -357,7 +356,7 @@ def _dkv_kernel(*refs, scale, causal, has_seg, block_q, block_k, nq):
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-    run = _bwd_run_pred(causal, has_seg, qi, ki, block_q, block_k,
+    run = _run_pred(causal, has_seg, qi, ki, block_q, block_k,
                         qseg_ref, kseg_ref)
     if run is not None:
         @pl.when(run)
@@ -502,9 +501,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     packed non-decreasing layout), so packed long-context training keeps
     the O(T) memory AND the sub-quadratic compute of the kernel.
     ``kv_segment_ids`` defaults to ``segment_ids``.  Degenerate rows with
-    no matching key anywhere output zeros (the XLA reference path gives a
-    uniform average over fully-masked rows — such rows carry no
-    information either way).
+    no matching key anywhere output zeros — as does the XLA reference
+    path (``attention.py:_attention_ref`` zeroes fully-masked rows), so
+    the two paths are comparable row-for-row.
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -515,7 +514,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     block_q, block_k = _clamp_blocks(block_q, block_k, d,
-                                     jnp.dtype(q.dtype).itemsize)
+                                     jnp.dtype(q.dtype).itemsize,
+                                     has_seg=segment_ids is not None)
     # halve until the block divides the sequence (any T that is a multiple
     # of 128 lands on a legal block by 128 at the latest)
     while block_q > 128 and tq % block_q:

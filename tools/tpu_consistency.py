@@ -33,6 +33,27 @@ def _flash_case(q, k, v):
     return invoke("flash_attention", f, [q, k, v])
 
 
+def _flash_seg_case(q, k, v):
+    """Segment-packed flash through the default dispatch: the unit tests
+    pin interpret=True with 128-blocks, so this is the only place the
+    compiled TPU segment path (1-D seg-id loads, min/max skip reductions,
+    mask temporary in VMEM) is exercised at the production tile sizes the
+    has_seg-aware VMEM clamp actually selects (1024x512 for d=64 —
+    the seg mask temporary pushes full 1024x1024 over budget)."""
+    import numpy as _np
+
+    from mxnet_tpu.ndarray.ops import invoke
+    from mxnet_tpu.ops.flash import flash_attention
+
+    t = q.shape[1]
+    seg = _np.repeat(_np.arange(4, dtype=_np.int32), t // 4)[None, :]
+
+    def f(qj, kj, vj):
+        return flash_attention(qj, kj, vj, causal=True, segment_ids=seg)
+
+    return invoke("flash_attention_seg", f, [q, k, v])
+
+
 def battery():
     from mxnet_tpu.ndarray import ops as F
     from mxnet_tpu.ops import dot_product_attention
@@ -85,6 +106,9 @@ def battery():
                                      r(1, 256, 2, 128)]),
         "flash_d256": (_flash_case, [r(1, 256, 2, 256), r(1, 256, 2, 256),
                                      r(1, 256, 2, 256)]),
+        "flash_seg_1024": (_flash_seg_case,
+                           [r(1, 1024, 1, 64), r(1, 1024, 1, 64),
+                            r(1, 1024, 1, 64)]),
         "gelu": (lambda x: F.Activation(x, act_type="gelu"), [r(8, 32)]),
         "logsumexp": (lambda x: F.logsumexp(x, axis=-1), [r(6, 40)]),
     }
