@@ -36,19 +36,18 @@ _prec = _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "highest").lower()
 _PREC_VALUES = ("highest", "high", "float32", "tensorfloat32",
                 "bfloat16_3x", "bfloat16")
 if _prec not in ("", "default"):
-    if _prec in _PREC_VALUES:
-        import jax as _jax
-        _jax.config.update("jax_default_matmul_precision", _prec)
-    else:  # a typo'd env var must not break import NOR silently drop to
-        # the MXU's bf16-pass default — warn and keep the package
-        # default 'highest' (the documented f32-parity contract)
+    if _prec not in _PREC_VALUES:
+        # a typo'd env var must not break import NOR silently drop to the
+        # MXU's bf16-pass default — warn and keep the package default
+        # 'highest' (the documented f32-parity contract)
         import warnings as _warnings
         _warnings.warn(
             f"MXNET_TPU_MATMUL_PRECISION={_prec!r} is not one of "
             f"{_PREC_VALUES + ('default',)}; using the package default "
             "'highest'", RuntimeWarning)
-        import jax as _jax
-        _jax.config.update("jax_default_matmul_precision", "highest")
+        _prec = "highest"
+    import jax as _jax
+    _jax.config.update("jax_default_matmul_precision", _prec)
 
 if _os.environ.get("MXNET_ENGINE_TYPE", "").lower() == "naiveengine":
     # SURVEY.md §5.2: the fully synchronous debug engine ≡ no XLA staging

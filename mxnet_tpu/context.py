@@ -111,12 +111,15 @@ _ACCEL_CACHE: Optional[List[jax.Device]] = None
 def accelerator_devices() -> List[jax.Device]:
     """All non-host devices (TPU chips), else empty.
 
-    An empty result is NOT cached (same late-plugin rule as
-    :func:`_backend_devices`): a TPU backend that comes up after the
-    first lookup must be found on retry, not shadowed by a stale []
-    for the life of the process."""
+    The result — INCLUDING an empty one — is cached: this sits on the
+    eager dispatch hot path (``current_context`` consults it per op on
+    an empty context stack), so a CPU-only host must not re-enumerate
+    devices forever.  The late-TPU-plugin case is handled by
+    invalidation instead: ``utils.platform`` clears the cache from
+    ``force_cpu()`` and whenever ``probe_accelerator``/``init_backend``
+    observe the backend coming up."""
     global _ACCEL_CACHE
-    if not _ACCEL_CACHE:
+    if _ACCEL_CACHE is None:
         _ACCEL_CACHE = [d for d in jax.local_devices()
                         if d.platform != "cpu"]
     return _ACCEL_CACHE

@@ -39,11 +39,13 @@ def _attention_ref(q, k, v, *, causal=False, mask=None, scale=None,
     if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    if causal or mask is not None:
+    if mask is not None or (causal and q.shape[1] > k.shape[1]):
         # fully-masked (degenerate) rows: softmax of an all-_NEG_INF row
         # is a uniform average; zero it instead so this path is
         # bitwise-comparable with the Pallas kernel, which outputs zeros
-        # for rows with no matching key (flash.py _finish)
+        # for rows with no matching key (flash.py _finish).  Causal with
+        # tq <= tk can never fully mask a row (row i always sees key
+        # i + tk - tq), so that common case skips the O(Tq*Tk) scan.
         any_valid = jnp.any(logits > 0.5 * _NEG_INF, axis=-1, keepdims=True)
         probs = jnp.where(any_valid, probs, 0.0)
     if dropout > 0.0 and dropout_key is not None:

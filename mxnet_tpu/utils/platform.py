@@ -35,12 +35,8 @@ def force_cpu(n_devices: int = 8) -> None:
             _xb._clear_backends()
     except Exception:
         pass
-    try:  # context device caches hold devices of the dropped backend
-        from .. import context as _ctx
-        _ctx._ACCEL_CACHE = None
-        _ctx._backend_devices.cache_clear()
-    except Exception:
-        pass
+    # context device caches hold devices of the dropped backend
+    _invalidate_device_caches()
 
 
 def probe_accelerator(timeout: float = 120.0) -> bool:
@@ -78,6 +74,17 @@ def probe_accelerator(timeout: float = 120.0) -> bool:
     return False
 
 
+def _invalidate_device_caches() -> None:
+    """Clear context.py's device caches (accelerator list + per-platform
+    devices) so a backend that appeared after the first lookup is found."""
+    try:
+        from .. import context as _ctx
+        _ctx._ACCEL_CACHE = None
+        _ctx._backend_devices.cache_clear()
+    except Exception:
+        pass
+
+
 def init_backend(n_cpu_devices: int = 8, probe_timeout: float = 120.0) -> str:
     """Bring up the accelerator if reachable, else force CPU.  Returns the
     active platform name ("tpu"/"cpu")."""
@@ -86,6 +93,8 @@ def init_backend(n_cpu_devices: int = 8, probe_timeout: float = 120.0) -> str:
     if probe_accelerator(probe_timeout):
         try:
             jax.devices()
+            _invalidate_device_caches()  # a late plugin just came up —
+            # drop any [] cached by pre-bring-up context lookups
             return jax.default_backend()
         except RuntimeError:
             pass

@@ -398,3 +398,140 @@ def test_csr_dot_empty_batch_stays_on_tape():
     loss.backward()
     onp.testing.assert_array_equal(y.asnumpy(), onp.zeros((4, 2)))
     onp.testing.assert_array_equal(w.grad.asnumpy(), onp.zeros((6, 2)))
+
+
+# ---------------------------------------------- cast_storage, all directions
+
+def test_cast_storage_csr_row_sparse_both_directions():
+    dense = _rand_csr((6, 5), 0.4, seed=7)
+    c = sparse.cast_storage(nd.array(dense), "csr")
+    r = sparse.cast_storage(c, "row_sparse")          # csr -> row_sparse
+    assert r.stype == "row_sparse"
+    onp.testing.assert_allclose(r.asnumpy(), dense)
+    nz_rows = onp.nonzero((dense != 0).any(axis=1))[0]
+    assert onp.asarray(r._sp_indices).tolist() == nz_rows.tolist()
+    c2 = sparse.cast_storage(r, "csr")                # row_sparse -> csr
+    assert c2.stype == "csr"
+    onp.testing.assert_allclose(c2.asnumpy(), dense)
+    back = sparse.cast_storage(r, "default")          # row_sparse -> default
+    assert back.stype == "default"
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_cast_storage_empty_and_dtype():
+    z = nd.array(onp.zeros((3, 4), "float32"))
+    c = sparse.cast_storage(z, "csr")
+    assert c._sp_data.shape[0] == 0
+    onp.testing.assert_array_equal(c.asnumpy(), onp.zeros((3, 4)))
+    r = sparse.cast_storage(c, "row_sparse")
+    assert r._sp_data.shape[0] == 0
+    onp.testing.assert_array_equal(r.asnumpy(), onp.zeros((3, 4)))
+    # dtype preserved through every hop (f16; f64 is downcast by the
+    # x64-disabled jax config, the standard TPU-first stance)
+    d16 = _rand_csr((4, 4), 0.5, seed=8).astype("float16")
+    c16 = sparse.cast_storage(nd.array(d16, dtype="float16"), "csr")
+    assert c16.dtype == onp.dtype("float16")
+    assert sparse.cast_storage(c16, "row_sparse").dtype == \
+        onp.dtype("float16")
+
+
+def test_tostype_matrix():
+    dense = _rand_csr((5, 6), 0.4, seed=9)
+    c = sparse.csr_matrix(dense)
+    assert c.tostype("csr") is c
+    r = c.tostype("row_sparse")
+    assert r.stype == "row_sparse"
+    onp.testing.assert_allclose(r.asnumpy(), dense)
+    d = r.tostype("default")
+    assert d.stype == "default"
+    onp.testing.assert_allclose(d.asnumpy(), dense)
+
+
+# ------------------------------------- lazy optimizer updates: parity proof
+
+def _no_densify(monkeypatch):
+    """Arm a tripwire: ANY dense materialization of a sparse array during
+    the patched scope is a test failure (the lazy hot path must only read
+    the compact components)."""
+    def boom(self):
+        raise AssertionError("sparse array was densified on the hot path")
+    monkeypatch.setattr(sparse.CSRNDArray, "_materialize", boom)
+    monkeypatch.setattr(sparse.RowSparseNDArray, "_materialize", boom)
+
+
+@pytest.mark.parametrize("opt_kwargs", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-3}),
+])
+def test_lazy_update_matches_compact_subproblem(opt_kwargs, monkeypatch):
+    """Lazy row-sparse update == running the SAME optimizer on the compact
+    (touched-rows-only) dense subproblem, with untouched rows bit-identical
+    and zero densification (parity: sgd_update/adam_update lazy_update=True,
+    src/operator/optimizer_op.* row_sparse kernels)."""
+    from mxnet_tpu.optimizer import create, get_updater
+
+    name, kwargs = opt_kwargs
+    V, D = 12, 3
+    rs = onp.random.RandomState(0)
+    w_full = rs.randn(V, D).astype("float32")
+    rows = onp.array([2, 5, 9])
+    w = nd.array(w_full)
+    w_sub = nd.array(w_full[rows])
+    upd = get_updater(create(name, **kwargs))
+    upd_sub = get_updater(create(name, **kwargs))
+    _no_densify(monkeypatch)
+    for _ in range(4):
+        g = rs.randn(len(rows), D).astype("float32")
+        upd(0, sparse.row_sparse_array((g, rows), shape=(V, D)), w)
+        upd_sub(0, nd.array(g), w_sub)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[rows], w_sub.asnumpy(),
+                                rtol=1e-6, atol=1e-7)
+    untouched = onp.setdiff1d(onp.arange(V), rows)
+    onp.testing.assert_array_equal(out[untouched], w_full[untouched])
+
+
+def test_lazy_adam_untouched_rows_skip_state_decay(monkeypatch):
+    """Rows absent from a step's gradient must skip the update ENTIRELY —
+    weight bit-identical and m/v state not decayed (the defining difference
+    between lazy_update and dense adam, where even zero-grad rows decay m)."""
+    from mxnet_tpu.optimizer import Adam, get_updater
+
+    V, D = 8, 2
+    w = nd.array(onp.ones((V, D), "float32"))
+    upd = get_updater(Adam(learning_rate=0.1))
+    _no_densify(monkeypatch)
+    g1 = onp.ones((2, D), "float32")
+    upd(0, sparse.row_sparse_array((g1, onp.array([1, 3])), shape=(V, D)), w)
+    w_after1 = w.asnumpy().copy()
+    m_after1 = upd.states[0][0].asnumpy().copy()
+    v_after1 = upd.states[0][1].asnumpy().copy()
+    # second step touches DIFFERENT rows
+    upd(0, sparse.row_sparse_array((g1, onp.array([4, 6])), shape=(V, D)), w)
+    out = w.asnumpy()
+    onp.testing.assert_array_equal(out[[1, 3]], w_after1[[1, 3]])
+    onp.testing.assert_array_equal(upd.states[0][0].asnumpy()[[1, 3]],
+                                   m_after1[[1, 3]])
+    onp.testing.assert_array_equal(upd.states[0][1].asnumpy()[[1, 3]],
+                                   v_after1[[1, 3]])
+    assert not onp.allclose(out[[4, 6]], w_after1[[4, 6]])
+
+
+def test_csr_dot_transpose_a_grad(monkeypatch):
+    """Backward through the csr^T·dense (embedding-bag) direction: the vjp
+    of gather+segment-sum must match the dense formula without densifying
+    the csr operand."""
+    from mxnet_tpu import autograd
+
+    dense = _rand_csr((5, 7), 0.4, seed=11)
+    a = sparse.csr_matrix(dense)
+    w = nd.array(onp.random.RandomState(12).randn(5, 2).astype("float32"))
+    w.attach_grad()
+    _no_densify(monkeypatch)
+    with autograd.record():
+        y = sparse.dot(a, w, transpose_a=True)      # (7, 2)
+        loss = (y * y).sum()
+    loss.backward()
+    expect = 2 * dense @ (dense.T @ w.asnumpy())
+    onp.testing.assert_allclose(w.grad.asnumpy(), expect,
+                                rtol=1e-4, atol=1e-5)
