@@ -65,6 +65,11 @@ def probe_accelerator(timeout: float = 120.0) -> bool:
     while time.monotonic() < deadline:
         rc = p.poll()
         if rc is not None:
+            if rc == 0:
+                # the backend is reachable — drop any [] the context
+                # layer cached before bring-up so in-process pollers
+                # (probe until True, then use tpu()) see the chip
+                _invalidate_device_caches()
             return rc == 0
         time.sleep(0.5)
     # still connecting — abandoned, NOT killed; reap it from a daemon
