@@ -177,13 +177,16 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
     if on_tpu:
         # batch 128: the MXU wants large convs — 64 measured ~10% MFU on
         # v5e; bigger per-chip batch is the first lever (tools/tpu_tune.py
-        # sweeps this)
+        # sweeps this).  NHWC: channels-last keeps C on the 128-lane minor
+        # dim through conv/BN-stat/pool, eliminating the relayout copies
+        # and f32 NCHW stat fusions the r3 profile showed dominating the
+        # non-conv time (docs/resnet_roofline_r05.md).
         batch, steps, warmup, size = 128, 20, 3, 224
-        net = get_resnet(1, 50, classes=1000)
+        net = get_resnet(1, 50, classes=1000, layout="NHWC")
         train_flops_per_img = 3 * 4.1e9   # fwd conv+fc flops, ResNet-50 v1
     else:
         batch, steps, warmup, size = 8, 2, 1, 64
-        net = get_resnet(1, 18, classes=100)
+        net = get_resnet(1, 18, classes=100, layout="NHWC")
         train_flops_per_img = 3 * 1.8e9 * (64 / 224) ** 2
     net.initialize()
     mesh = par.make_mesh()
@@ -194,7 +197,7 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
             optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
             mesh=mesh)
         imgs = mx.nd.array(
-            onp.random.uniform(-1, 1, (batch, 3, size, size)).astype("float32"))
+            onp.random.uniform(-1, 1, (batch, size, size, 3)).astype("float32"))
         labels = mx.nd.array(
             onp.random.randint(0, 100, (batch,)), dtype="int32")
         dt = _run_steps(trainer, [(imgs, labels)], warmup, steps)
@@ -223,11 +226,11 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
 
     if on_tpu:
         batch, steps, warmup, size, n_img = 128, 20, 3, 224, 512
-        net = get_resnet(1, 50, classes=1000)
+        net = get_resnet(1, 50, classes=1000, layout="NHWC")
         train_flops_per_img = 3 * 4.1e9
     else:
         batch, steps, warmup, size, n_img = 8, 2, 1, 64, 64
-        net = get_resnet(1, 18, classes=100)
+        net = get_resnet(1, 18, classes=100, layout="NHWC")
         train_flops_per_img = 3 * 1.8e9 * (64 / 224) ** 2
     net.initialize()
     mesh = par.make_mesh()
@@ -261,7 +264,12 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
             def stream():
                 while True:
                     for b in it:
-                        yield (b.data[0].astype("float32"),
+                        # NCHW pipeline batch -> NHWC on device: the
+                        # transpose rides the chip (free vs the uint8
+                        # transfer); the uint8->f32 cast also stays
+                        # device-side
+                        yield (b.data[0].astype("float32")
+                               .transpose((0, 2, 3, 1)),
                                b.label[0].astype("int32"))
                     it.reset()
 

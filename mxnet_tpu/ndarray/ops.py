@@ -1211,11 +1211,12 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     training = _base.is_training() and not use_global_stats
 
     def f(x, g, b, mmean, mvar):
-        shape = [1] * x.ndim
-        shape[axis] = x.shape[axis]
+        ax = axis % x.ndim          # canonicalize: axis=-1 (NHWC) must
+        shape = [1] * x.ndim        # exclude the LAST dim from the stat
+        shape[ax] = x.shape[ax]     # reduction, not match nothing
         g_ = jnp.ones_like(g) if fix_gamma else g
         if training:
-            axes = tuple(i for i in range(x.ndim) if i != axis)
+            axes = tuple(i for i in range(x.ndim) if i != ax)
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
         else:

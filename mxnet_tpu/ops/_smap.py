@@ -9,7 +9,10 @@ import jax
 __all__ = ["shard_mapped_qkv"]
 
 
-def shard_mapped_qkv(body, mesh, spec, q, k, v):
+def shard_mapped_qkv(body, mesh, spec, q, k, v, *extra, extra_specs=()):
+    """Run ``body(q, k, v, *extra)`` under shard_map.  ``extra`` carries
+    side inputs with their own partition specs (e.g. packed segment-id
+    planes, sharded over batch+sequence only)."""
     restore = None
     if not isinstance(q, jax.core.Tracer):
         from jax.sharding import NamedSharding
@@ -17,9 +20,12 @@ def shard_mapped_qkv(body, mesh, spec, q, k, v):
         if q.sharding != sh:
             restore = q.sharding
         q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    f = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+        extra = tuple(jax.device_put(x, NamedSharding(mesh, s))
+                      for x, s in zip(extra, extra_specs))
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=(spec, spec, spec, *extra_specs),
                       out_specs=spec, check_vma=False)
-    out = f(q, k, v)
+    out = f(q, k, v, *extra)
     if restore is not None:
         out = jax.device_put(out, restore)
     return out

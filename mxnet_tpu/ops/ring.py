@@ -24,20 +24,29 @@ from jax.sharding import PartitionSpec as P
 from .attention import _NEG_INF as _MASK
 
 
-@functools.partial(jax.checkpoint, static_argnums=(5, 6))
-def _block(q, k, v, q_pos, kv_pos, causal, scale):
+@functools.partial(jax.checkpoint, static_argnums=(7, 8))
+def _block(q, k, v, q_pos, kv_pos, q_seg, kv_seg, causal, scale):
     """Partial attention of local Q against one K/V chunk.
 
-    q: (B, Tl, H, D); k/v: (B, Tc, H, D); returns un-normalized
-    (pv (B, H, Tl, D) f32, m (B, H, Tl, 1), l (B, H, Tl, 1)).
+    q: (B, Tl, H, D); k/v: (B, Tc, H, D); optional q_seg (B, Tl) /
+    kv_seg (B, Tc) packed segment ids mask cross-segment pairs; returns
+    un-normalized (pv (B, H, Tl, D) f32, m (B, H, Tl, 1),
+    l (B, H, Tl, 1)).  A fully-masked row yields m = _MASK and l = 0,
+    which merges with zero weight — nan-free as long as SOME chunk
+    (the diagonal: self-key always matches) is live for the row.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         keep = kv_pos[None, :] <= q_pos[:, None]       # (Tl, Tc)
         s = jnp.where(keep[None, None], s, _MASK)
+    if q_seg is not None:
+        keep_seg = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        s = jnp.where(keep_seg, s, _MASK)              # (B, 1, Tl, Tc)
     m = jnp.max(s, axis=-1, keepdims=True)             # (B, H, Tl, 1)
-    p = jnp.exp(s - m)
+    # zero fully-masked entries (not exp(_MASK - _MASK) = 1) so packed
+    # rows whose segment lives in another chunk contribute l = 0 here
+    p = jnp.where(s <= _MASK * 0.5, 0.0, jnp.exp(s - m))
     l = jnp.sum(p, axis=-1, keepdims=True)
     pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
@@ -53,22 +62,27 @@ def _merge(state, pv, m_c, l_c):
     return acc * c_old + pv * c_new, m_new, l * c_old + l_c * c_new
 
 
-def _ring_local(q, k, v, *, axis, steps, causal, scale):
-    """Per-device body under shard_map: q/k/v are local (B, Tl, H, D)."""
+def _ring_local(q, k, v, seg=None, *, axis, steps, causal, scale):
+    """Per-device body under shard_map: q/k/v are local (B, Tl, H, D);
+    ``seg`` (B, Tl) local packed segment ids — the kv-side ids rotate
+    around the ring with their K/V chunk."""
     idx = jax.lax.axis_index(axis)
     tl = q.shape[1]
     offs = jax.lax.broadcasted_iota(jnp.int32, (tl, 1), 0)[:, 0]
     q_pos = idx * tl + offs
     perm = [(i, (i + 1) % steps) for i in range(steps)]
+    kv_seg = seg
 
     acc = m = l = None
     for t in range(steps):
         owner = (idx - t) % steps                      # chunk's home device
         kv_pos = owner * tl + offs
-        pv, m_c, l_c = _block(q, k, v, q_pos, kv_pos, causal, scale)
+        pv, m_c, l_c = _block(q, k, v, q_pos, kv_pos, seg, kv_seg,
+                              causal, scale)
         if t == 0:
-            # step 0 is the diagonal chunk: every causal row has >= 1
-            # unmasked key, so m is finite and later fully-masked chunks
+            # step 0 is the diagonal chunk: every row has >= 1 unmasked
+            # key (its own — causal keeps the diagonal, segments always
+            # self-match), so m is finite and later fully-masked chunks
             # (m_c = _MASK) merge with weight exp(_MASK - m) = 0, nan-free
             acc, m, l = pv, m_c, l_c
         else:
@@ -76,16 +90,24 @@ def _ring_local(q, k, v, *, axis, steps, causal, scale):
         if t + 1 < steps:
             k = jax.lax.ppermute(k, axis, perm)
             v = jax.lax.ppermute(v, axis, perm)
+            if kv_seg is not None:
+                kv_seg = jax.lax.ppermute(kv_seg, axis, perm)
     out = acc / l                                      # (B, H, Tl, D)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def _ring_local_balanced(q, k, v, *, axis, steps, scale):
+def _ring_local_balanced(q, k, v, seg=None, *, axis, steps, scale):
     """Zigzag-balanced CAUSAL ring body: each device's local rows are the
     pair [chunk idx | chunk 2*steps-1-idx] of a 2*steps-way split, so at
     every ring step every device computes exactly two UNMASKED
     half-blocks (plus the two causal diagonals at step 0) — half the
-    FLOPs of masking a full block per step, with uniform load."""
+    FLOPs of masking a full block per step, with uniform load.
+
+    ``seg`` (B, Tl) rides the SAME zigzag layout as q/k/v (the caller
+    permutes it); its kv-side halves rotate with their K/V chunk, and
+    the half-block "fully live" structure is unchanged — segment masking
+    only ever REMOVES pairs inside a block, so every _block below passes
+    its half-ids and the step-0 self-key guarantee keeps rows nan-free."""
     idx = jax.lax.axis_index(axis)
     tl = q.shape[1]
     hl = tl // 2
@@ -98,30 +120,43 @@ def _ring_local_balanced(q, k, v, *, axis, steps, scale):
     q_lo, q_hi = halves(q)
     k_lo, k_hi = halves(k)
     v_lo, v_hi = halves(v)
+    s_lo = s_hi = None
+    if seg is not None:
+        s_lo, s_hi = halves(seg)
 
     # step 0 (own chunks): high-vs-low is FULLY live (chunk 2s-1-i > i);
     # the two diagonals are the only blocks that ever need a causal mask
-    lo = _block(q_lo, k_lo, v_lo, offs, offs, True, scale)
-    hi = _block(q_hi, k_lo, v_lo, offs, offs, False, scale)
-    hi = _merge(hi, *_block(q_hi, k_hi, v_hi, offs, offs, True, scale))
+    lo = _block(q_lo, k_lo, v_lo, offs, offs, s_lo, s_lo, True, scale)
+    hi = _block(q_hi, k_lo, v_lo, offs, offs, s_hi, s_lo, False, scale)
+    hi = _merge(hi, *_block(q_hi, k_hi, v_hi, offs, offs, s_hi, s_hi,
+                            True, scale))
 
-    kk, vv = k, v
+    kk, vv, ss = k, v, seg
     for t in range(1, steps):
         kk = jax.lax.ppermute(kk, axis, perm)
         vv = jax.lax.ppermute(vv, axis, perm)
         ko_lo, ko_hi = halves(kk)
         vo_lo, vo_hi = halves(vv)
+        so_lo = so_hi = None
+        if ss is not None:
+            ss = jax.lax.ppermute(ss, axis, perm)
+            so_lo, so_hi = halves(ss)
         # always live: local HIGH rows vs arriving LOW chunk (no mask:
         # every high-chunk position exceeds every low-chunk position)
-        hi = _merge(hi, *_block(q_hi, ko_lo, vo_lo, offs, offs, False,
-                                scale))
+        hi = _merge(hi, *_block(q_hi, ko_lo, vo_lo, offs, offs,
+                                s_hi, so_lo, False, scale))
         # exactly one of (lo vs lo) / (hi vs hi) is live, fully unmasked:
         # owner o = (idx - t) mod steps; o <= idx  <=>  idx >= t
         pred = idx >= t
         q_s = jnp.where(pred, q_lo, q_hi)
         k_s = jnp.where(pred, ko_lo, ko_hi)
         v_s = jnp.where(pred, vo_lo, vo_hi)
-        pv, m_c, l_c = _block(q_s, k_s, v_s, offs, offs, False, scale)
+        qs_seg = ks_seg = None
+        if ss is not None:
+            qs_seg = jnp.where(pred, s_lo, s_hi)
+            ks_seg = jnp.where(pred, so_lo, so_hi)
+        pv, m_c, l_c = _block(q_s, k_s, v_s, offs, offs, qs_seg, ks_seg,
+                              False, scale)
         lo_new = _merge(lo, pv, m_c, l_c)
         hi_new = _merge(hi, pv, m_c, l_c)
         lo = tuple(jnp.where(pred, n, o) for n, o in zip(lo_new, lo))

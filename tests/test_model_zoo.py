@@ -69,3 +69,38 @@ def test_model_zoo_save_load_roundtrip(tmp_path):
     net2.load_parameters(f)
     onp.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5,
                                 atol=1e-5)
+
+
+@pytest.mark.parametrize("version,layers", [(1, 18), (2, 50)])
+def test_resnet_nhwc_matches_nchw(version, layers):
+    """layout='NHWC' (the TPU channels-last fast path, bench.py default
+    on chip) must be numerically identical to NCHW given the same OIHW
+    weights (docs/resnet_roofline_r05.md)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.models.vision import get_resnet
+
+    rs = onp.random.RandomState(0)
+    x_nchw = rs.randn(2, 3, 32, 32).astype("float32")
+    xc, xh = nd.array(x_nchw), nd.array(x_nchw.transpose(0, 2, 3, 1))
+
+    net_c = get_resnet(version, layers, classes=10, thumbnail=True)
+    net_c.initialize()
+    net_c(xc)
+    net_h = get_resnet(version, layers, classes=10, thumbnail=True,
+                       layout="NHWC")
+    net_h.initialize()
+    net_h(xh)
+    # same build order -> same param sequence; weights are OIHW in BOTH
+    # layouts so they copy across directly (checkpoint compatibility)
+    for vc, vh in zip(net_c.collect_params().values(),
+                      net_h.collect_params().values()):
+        assert vc.shape == vh.shape
+        vh.set_data(vc.data())
+    onp.testing.assert_allclose(net_c(xc).asnumpy(), net_h(xh).asnumpy(),
+                                rtol=3e-4, atol=3e-4)
+    with autograd.record():
+        loss = (net_h(xh) ** 2).sum()
+    loss.backward()
+    g = net_h.collect_params()
+    assert all(onp.isfinite(v.grad().asnumpy()).all()
+               for v in g.values() if v.grad_req != "null")
