@@ -16,6 +16,12 @@ always beats an rc=1 (round-1 failure mode).  CPU-fallback records bench a
 REDUCED model: they are renamed ``<metric>_cpu_sanity`` with
 ``vs_baseline: null`` so a fabricated ratio can never be read as an MFU
 claim (VERDICT r2 weak #3).
+
+Trial hygiene (VERDICT round-5 ask): after warmup each workload runs >= 3
+independent timed segments; ``value`` is the MEDIAN per-trial throughput
+and the record carries ``trials`` (each segment's value) and
+``spread_pct`` = (max-min)/median.  benchmark/serving_bench.py (the
+online-inference bench) emits the same schema.
 """
 from __future__ import annotations
 
@@ -23,6 +29,7 @@ import argparse
 import json
 import sys
 import time
+from statistics import median as _median
 
 import numpy as onp
 
@@ -58,12 +65,16 @@ def peak_flops_per_device() -> float:
     return 50e12 if d.platform == "cpu" else 200e12
 
 
-def _run_steps(trainer, batches, warmup: int, steps: int) -> float:
+def _run_steps(trainer, batches, warmup: int, steps: int,
+               trials: int = 3) -> list:
     """Warm up (each step synced, so lazy compile/upload never leaks into
-    the timed region), then time `steps` async-dispatched steps with one
-    final sync.  ``batches`` is a list of (data, labels) tuples OR a
-    callable returning the next batch (streaming input pipelines).
-    Returns seconds."""
+    the timed region), then run ``trials`` independent timed segments of
+    `steps` async-dispatched steps, each closed by one hard sync.
+    ``batches`` is a list of (data, labels) tuples OR a callable
+    returning the next batch (streaming input pipelines).  Returns the
+    per-trial durations in seconds — callers report the MEDIAN plus the
+    spread, so one noisy segment (host jitter, background compile) can
+    never masquerade as the steady-state number."""
     if callable(batches):
         nth = lambda i: batches()          # noqa: E731
     else:
@@ -71,12 +82,15 @@ def _run_steps(trainer, batches, warmup: int, steps: int) -> float:
     for i in range(warmup):
         loss = trainer.step(*nth(i))
         float(loss.asnumpy())     # hard sync — waitall is not enough
-    t0 = time.perf_counter()
-    loss = None
-    for i in range(steps):
-        loss = trainer.step(*nth(i))
-    float(loss.asnumpy())
-    return time.perf_counter() - t0
+    times = []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            loss = trainer.step(*nth(i))
+        float(loss.asnumpy())
+        times.append(time.perf_counter() - t0)
+    return times
 
 
 def _ce_loss(logits, labels):
@@ -86,7 +100,7 @@ def _ce_loss(logits, labels):
 
 
 def _record(metric: str, value: float, unit: str, mfu: float,
-            batch=None) -> dict:
+            batch=None, trials=None) -> dict:
     import jax
     platform = jax.default_backend()
     if platform != "tpu":
@@ -101,6 +115,12 @@ def _record(metric: str, value: float, unit: str, mfu: float,
            "vs_baseline": vs_baseline, "platform": platform}
     if batch is not None:
         rec["batch"] = batch   # ACTUAL per-step batch (after dp rounding)
+    if trials is not None and len(trials) > 1:
+        # value is the MEDIAN of the per-trial throughputs; spread_pct =
+        # (max-min)/median — a large spread flags an untrustworthy run
+        rec["trials"] = [round(v, 1) for v in trials]
+        rec["spread_pct"] = round(
+            100.0 * (max(trials) - min(trials)) / value, 2) if value else None
     return rec
 
 
@@ -145,9 +165,10 @@ def _bench_gpt2_config(on_tpu: bool, long: bool, batch_override=None) -> dict:
             onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
         labels = mx.nd.array(
             onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
-        dt = _run_steps(trainer, [(toks, labels)], warmup, steps)
+        dts = _run_steps(trainer, [(toks, labels)], warmup, steps)
 
-    tokens_per_sec = batch * seq * steps / dt
+    vals = [batch * seq * steps / dt for dt in dts]
+    tokens_per_sec = _median(vals)
     # matmul flops per token: 6*(block params + tied lm head) + attention
     # (2 score + 2 value matmuls per layer, fwd; x3 for training)
     flops_per_token = (6.0 * (12 * layers * units * units + units * vocab)
@@ -156,7 +177,8 @@ def _bench_gpt2_config(on_tpu: bool, long: bool, batch_override=None) -> dict:
         peak_flops_per_device() * len(jax_devices()))
     name = "gpt2_124m_seq4096_train_throughput" if long \
         else "gpt2_124m_train_throughput"
-    return _record(name, tokens_per_sec, "tokens/sec", mfu, batch=batch)
+    return _record(name, tokens_per_sec, "tokens/sec", mfu, batch=batch,
+                   trials=vals)
 
 
 def bench_gpt2(on_tpu: bool, batch_override=None) -> dict:
@@ -200,13 +222,14 @@ def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
             onp.random.uniform(-1, 1, (batch, size, size, 3)).astype("float32"))
         labels = mx.nd.array(
             onp.random.randint(0, 100, (batch,)), dtype="int32")
-        dt = _run_steps(trainer, [(imgs, labels)], warmup, steps)
+        dts = _run_steps(trainer, [(imgs, labels)], warmup, steps)
 
-    imgs_per_sec = batch * steps / dt
+    vals = [batch * steps / dt for dt in dts]
+    imgs_per_sec = _median(vals)
     mfu = imgs_per_sec * train_flops_per_img / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("resnet50_train_throughput", imgs_per_sec,
-                   "images/sec", mfu, batch=batch)
+                   "images/sec", mfu, batch=batch, trials=vals)
 
 
 # ------------------------------------------------- ResNet-50 + input pipeline
@@ -274,13 +297,14 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
                     it.reset()
 
             gen = iter(stream())
-            dt = _run_steps(trainer, lambda: next(gen), warmup, steps)
+            dts = _run_steps(trainer, lambda: next(gen), warmup, steps)
 
-    imgs_per_sec = batch * steps / dt
+    vals = [batch * steps / dt for dt in dts]
+    imgs_per_sec = _median(vals)
     mfu = imgs_per_sec * train_flops_per_img / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("resnet50_io_train_throughput", imgs_per_sec,
-                   "images/sec", mfu, batch=batch)
+                   "images/sec", mfu, batch=batch, trials=vals)
 
 
 # ------------------------------------------------------------ NMT (config 4)
@@ -318,9 +342,10 @@ def bench_nmt(on_tpu: bool, batch_override=None) -> dict:
             onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
         labels = mx.nd.array(
             onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
-        dt = _run_steps(trainer, [((src, tgt), labels)], warmup, steps)
+        dts = _run_steps(trainer, [((src, tgt), labels)], warmup, steps)
 
-    tokens_per_sec = batch * seq * steps / dt
+    vals = [batch * seq * steps / dt for dt in dts]
+    tokens_per_sec = _median(vals)
     # per tgt token: decoder (self+cross attn + ffn) + encoder (per src
     # token, same count) + tied output projection; x3 for training
     enc_block = 4 * units * units + 2 * units * hidden
@@ -331,7 +356,7 @@ def bench_nmt(on_tpu: bool, batch_override=None) -> dict:
     mfu = tokens_per_sec * flops_per_token / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("transformer_big_nmt_train_throughput", tokens_per_sec,
-                   "tokens/sec", mfu, batch=batch)
+                   "tokens/sec", mfu, batch=batch, trials=vals)
 
 
 # -------------------------------------------------------------- BERT-large
@@ -385,16 +410,18 @@ def bench_bert(on_tpu: bool, batch_override=None) -> dict:
         nsp_lab = mx.nd.array(onp.random.randint(0, 2, (batch,)),
                               dtype="int32")
         data = (toks, types, vlen, pos)
-        dt = _run_steps(trainer, [(data, (mlm_lab, nsp_lab))], warmup, steps)
+        dts = _run_steps(trainer, [(data, (mlm_lab, nsp_lab))], warmup,
+                         steps)
 
-    samples_per_sec = batch * steps / dt
+    vals = [batch * steps / dt for dt in dts]
+    samples_per_sec = _median(vals)
     flops_per_sample = seq * (6.0 * 12 * layers * units * units
                               + 12.0 * layers * units * seq) \
         + 6.0 * n_masked * units * vocab
     mfu = samples_per_sec * flops_per_sample / (
         peak_flops_per_device() * len(jax_devices()))
     return _record("bert_large_pretrain_throughput", samples_per_sec,
-                   "samples/sec", mfu, batch=batch)
+                   "samples/sec", mfu, batch=batch, trials=vals)
 
 
 def jax_devices():

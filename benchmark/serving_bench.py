@@ -1,0 +1,151 @@
+"""Online-serving benchmark: concurrent dynamic-batched decode through
+``mxnet_tpu.serving.InferenceEngine`` vs sequential per-request
+``net.generate()`` on the same host.
+
+Prints bench.py-schema JSON lines (metric/value/unit/vs_baseline/
+platform/trials/spread_pct), one for the sequential baseline and one for
+the engine:
+
+- ``serving_sequential_decode``: tokens/sec decoding N requests one at a
+  time with the fused-loop ``generate`` (``vs_baseline: null`` — it IS
+  the baseline);
+- ``serving_engine_decode_c<N>``: tokens/sec with all N requests in
+  flight through the engine (continuous batching + shape buckets).
+  ``vs_baseline`` is the speedup over the sequential line measured in
+  the SAME process — meaningful on any platform, unlike the MFU-derived
+  ratios in bench.py.  The record also carries the engine's p50/p95
+  total-latency milliseconds.
+
+Both paths pay their compiles during warmup (generate's jit cache /
+``engine.warmup()``), then run >= 3 timed trials; the reported value is
+the median (bench.py trial hygiene).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        name = "gpt2_124m"
+        prompt_lens = (64, 96, 128, 192)
+        seq_buckets = (64, 128, 256)
+        max_new = 64
+    else:   # CPU sanity: reduced model, same code path.  Large enough
+        # that a decode step is compute- (not dispatch-) bound, else the
+        # measured ratio reflects Python overhead, not batching
+        name = "gpt2_124m"
+        cfg = dict(vocab_size=2048, units=256, num_layers=4, num_heads=8,
+                   max_length=256, dropout=0.0)
+        prompt_lens = (8, 12, 16, 24)
+        seq_buckets = (8, 16, 32)
+        max_new = 32
+    net = get_gpt2(name, **cfg)
+    net.initialize()
+    return net, prompt_lens, seq_buckets, max_new
+
+
+def _prompts(concurrency, prompt_lens, vocab):
+    import numpy as onp
+    rs = onp.random.RandomState(0)
+    return [rs.randint(0, vocab, (prompt_lens[i % len(prompt_lens)],))
+            .astype("int32") for i in range(concurrency)]
+
+
+def _record(metric, vals, unit, vs_baseline, extra=None):
+    import jax
+    platform = jax.default_backend()
+    value = statistics.median(vals)
+    if platform != "tpu":
+        metric = f"{metric}_cpu_sanity"
+    rec = {"metric": metric, "value": round(value, 1), "unit": unit,
+           "vs_baseline": vs_baseline, "platform": platform,
+           "trials": [round(v, 1) for v in vals],
+           "spread_pct": round(100.0 * (max(vals) - min(vals)) / value, 2)
+           if value else None}
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def bench_serving_decode(concurrency: int = 16, max_new: int = None,
+                         trials: int = 3):
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import InferenceEngine
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    net, prompt_lens, seq_buckets, default_new = _build_net(on_tpu)
+    max_new = max_new or default_new
+    prompts = _prompts(concurrency, prompt_lens, net.vocab_size)
+    total_tokens = concurrency * max_new
+
+    # ---- sequential baseline: per-request fused generate ----------------
+    def seq_pass():
+        for p in prompts:
+            net.generate(mx.nd.array(p[None], dtype="int32"), max_new,
+                         temperature=0).asnumpy()
+    seq_pass()                                   # warmup: pays the compiles
+    seq_vals = []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        seq_pass()
+        seq_vals.append(total_tokens / (time.perf_counter() - t0))
+
+    # ---- engine: all requests in flight ---------------------------------
+    eng = InferenceEngine(net, num_slots=concurrency,
+                          max_batch=concurrency, seq_buckets=seq_buckets,
+                          queue_depth=4 * concurrency,
+                          default_max_new_tokens=max_new,
+                          name=f"serving_bench_c{concurrency}")
+    eng.warmup()
+    eng_vals = []
+    with eng:
+        for _ in range(max(1, trials)):
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+            for f in futs:
+                f.result(timeout=1800)
+            eng_vals.append(total_tokens / (time.perf_counter() - t0))
+        lat = eng.stats()["latency"]["total"]
+
+    speedup = round(statistics.median(eng_vals) /
+                    statistics.median(seq_vals), 4)
+    yield _record("serving_sequential_decode", seq_vals, "tokens/sec",
+                  None, {"concurrency": 1, "max_new_tokens": max_new})
+    yield _record(f"serving_engine_decode_c{concurrency}", eng_vals,
+                  "tokens/sec", speedup,
+                  {"concurrency": concurrency, "max_new_tokens": max_new,
+                   "p50_ms": lat["p50_ms"], "p95_ms": lat["p95_ms"]})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    from mxnet_tpu.utils.platform import init_backend
+    platform = init_backend()
+    if platform != "tpu":
+        print(f"serving_bench: accelerator unavailable; running on "
+              f"{platform}", file=sys.stderr)
+
+    for rec in bench_serving_decode(args.concurrency, args.max_new_tokens,
+                                    args.trials):
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

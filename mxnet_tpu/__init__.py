@@ -82,6 +82,7 @@ _LAZY = {
     "image": ".image",
     "recordio": ".recordio",
     "runtime": ".runtime",
+    "serving": ".serving",
     "test_utils": ".test_utils",
     "np": ".numpy",
     "npx": ".numpy_extension",

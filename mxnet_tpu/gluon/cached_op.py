@@ -34,6 +34,47 @@ from ..ndarray.ndarray import swap_values
 _WARNED_FOREIGN_TRACE = False
 
 
+def collect_block_params(block):
+    """Stable, deduped list of a block's INITIALIZED parameters — the
+    same collect_params()-ordered convention GPT2.generate uses (CachedOp
+    keeps its own _iter_params-based collection for trace signatures)."""
+    items, seen = [], set()
+    for _, p in block.collect_params().items():
+        if id(p) in seen or p._data is None:
+            continue
+        seen.add(id(p))
+        items.append(p)
+    return items
+
+
+def make_pure_fn(block, fn):
+    """Reuse CachedOp's functionalization for arbitrary INFERENCE entries
+    (the serving engine's prefill/decode/forward steps): returns
+    ``(params, pure)`` where ``pure(param_vals, *args)`` evaluates
+    ``fn(*args)`` with every parameter payload swapped for the
+    corresponding entry of ``param_vals`` — inference mode, no autograd
+    tape, live payloads re-captured at trace time (so reset_ctx/astype
+    between traces can never bake stale weights in as constants).  The
+    caller jits ``pure``; jax caches one executable per shape bucket."""
+    items = collect_block_params(block)
+    if not items:
+        raise _base.MXNetError(
+            f"make_pure_fn: {type(block).__name__} has no initialized "
+            "parameters — call block.initialize() first")
+
+    def pure(param_vals, *args):
+        live = [p._data for p in items]
+        with swap_values(live, param_vals):
+            with _base.training_mode(False):
+                rec = _base.set_recording(False)
+                try:
+                    return fn(*args)
+                finally:
+                    _base.set_recording(rec)
+
+    return items, pure
+
+
 class CachedOp:
     def __init__(self, block, flags=None):
         self.block = block

@@ -85,6 +85,68 @@ class _GPT2Decoding:
                                   flatten=False)
         return logits.reshape((tok.shape[0], self.vocab_size)), new_caches
 
+    # ---------------------------------------------------- serving entries
+    # The single-step decode surface mxnet_tpu.serving.InferenceEngine
+    # drives: a persistent SLOT-batched KV cache (row = in-flight request),
+    # bucketed admission prefill, and a per-slot-position decode step —
+    # continuous batching of requests at different positions.
+
+    def init_slot_cache(self, num_slots, max_length=None, dtype=None):
+        """Persistent serving cache: per-layer (S, Tmax, H, D) where row s
+        belongs to whichever request currently owns slot s."""
+        _dense_blocks_only(self)
+        return self.init_cache(num_slots, max_length, dtype)
+
+    def prefill_slots(self, tokens_nd, lens, caches, slot_idx):
+        """Admission prefill for a bucketed batch of prompts: tokens
+        (B, Tb) int32 right-PADDED to the bucket length, ``lens`` (B,)
+        true lengths, ``slot_idx`` (B,) destination rows of the (S,...)
+        caches.  One causal forward writes every layer's K/V for
+        positions [0, Tb) into the requests' slots and returns the
+        logits at each row's LAST REAL position (B, vocab) — right
+        padding never leaks into them (causal mask), and the garbage
+        K/V it leaves beyond ``lens`` is overwritten by decode before
+        it can be attended."""
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+
+        b = tokens_nd.shape[0]
+        pos = F.arange_like(tokens_nd, axis=1).astype("int32")
+        x = self.wte(tokens_nd) + self.wpe(pos)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, c = blk.forward_prefill_slots(x, cache, slot_idx)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        last = NDArray(x.jax[jnp.arange(b), lens - 1])      # (B, U)
+        logits = F.FullyConnected(last, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return logits, new_caches
+
+    def decode_step(self, tok, caches, pos):
+        """One continuous-batching decode step over EVERY slot: tok (S,)
+        int32 NDArray of last tokens, ``pos`` (S,) int32 jax array of
+        their (per-slot) positions → (logits (S, vocab), new caches).
+        Rows whose slot is free run too (fixed shape = one XLA
+        program); their writes land at pos 0 of a row nobody reads
+        until the next prefill overwrites it.  Inference mode assumed."""
+        from ..ndarray import NDArray
+
+        s = tok.shape[0]
+        tok2 = tok.reshape((s, 1))
+        x = self.wte(tok2) + self.wpe(NDArray(pos.reshape((s, 1))))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, c = blk.forward_step_slots(x, cache, pos)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        logits = F.FullyConnected(x, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return logits.reshape((s, self.vocab_size)), new_caches
+
     def generate(self, prompt, max_new_tokens, temperature=1.0, top_k=0,
                  seed=0):
         """Autoregressive generation with a KV cache, as ONE jitted XLA
@@ -116,12 +178,8 @@ class _GPT2Decoding:
             raise ValueError(f"prompt+new = {total} exceeds max_length="
                              f"{self.max_length}")
 
-        items, seen = [], set()
-        for _, p in self.collect_params().items():
-            if id(p) in seen or p._data is None:
-                continue
-            seen.add(id(p))
-            items.append(p)
+        from ..gluon.cached_op import collect_block_params
+        items = collect_block_params(self)
         param_vals = tuple(p._data.jax for p in items)
         net = self
 

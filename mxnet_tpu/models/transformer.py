@@ -162,6 +162,57 @@ class MultiHeadAttention(HybridBlock):
         out = self.out_proj(out.reshape((b, t, h * d)))
         return out, {"k": kc, "v": vc}
 
+    def forward_step_slots(self, x, cache, pos):
+        """Continuous-batching decode: x (S,1,U) where row s is an
+        independent request parked in SLOT s of the persistent cache
+        {'k','v': (S,Tmax,H,D)}, at its OWN position ``pos`` (S,) int32.
+        Writes K/V at [s, pos[s]] and attends row-wise to keys
+        <= pos[s].  Inference only."""
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+
+        s = x.shape[0]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((s, 1, h, d))
+        k_new = self.k_proj(x).reshape((s, h, d))
+        v_new = self.v_proj(x).reshape((s, h, d))
+        rows = jnp.arange(s)
+        kc = cache["k"].at[rows, pos].set(
+            k_new.jax.astype(cache["k"].dtype))
+        vc = cache["v"].at[rows, pos].set(
+            v_new.jax.astype(cache["v"].dtype))
+        out = _attention_step_slots(q.jax, kc, vc, pos, 1.0 / (d ** 0.5))
+        out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
+        return out, {"k": kc, "v": vc}
+
+    def forward_prefill_slots(self, x, cache, slot_idx):
+        """Bucketed admission prefill: x (B,Tb,U) is a batch of PADDED
+        prompts; row i's K/V for positions [0, Tb) land in cache row
+        ``slot_idx[i]`` of the persistent (S,Tmax,H,D) cache.  Causal
+        attention keeps real tokens blind to the right-padding; padded
+        positions write garbage K/V beyond each prompt's true length,
+        which decode overwrites (position p is rewritten before it is
+        ever attended).  Duplicate slot_idx rows (scratch padding) are
+        allowed — last-writer-wins is fine for rows nobody reads."""
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+        from ..ops import dot_product_attention
+
+        b, t = x.shape[0], x.shape[1]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((b, t, h, d))
+        k = self.k_proj(x).reshape((b, t, h, d))
+        v = self.v_proj(x).reshape((b, t, h, d))
+        ridx = slot_idx[:, None]
+        cidx = jnp.arange(t)[None, :]
+        kc = cache["k"].at[ridx, cidx].set(k.jax.astype(cache["k"].dtype))
+        vc = cache["v"].at[ridx, cidx].set(v.jax.astype(cache["v"].dtype))
+        out = dot_product_attention(q, k, v, causal=True)
+        out = self.out_proj(out.reshape((b, t, h * d)))
+        return out, {"k": kc, "v": vc}
+
 
 def _attention_step(q, k_cache, v_cache, idx, scale):
     """Single-position attention against a KV cache: q (B,1,H,D),
@@ -173,6 +224,23 @@ def _attention_step(q, k_cache, v_cache, idx, scale):
                         preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(k_cache.shape[1])
     logits = jnp.where(pos[None, None, None, :] <= idx, logits, -1e30)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                      v_cache)
+
+
+def _attention_step_slots(q, k_cache, v_cache, pos, scale):
+    """Per-row-position variant of :func:`_attention_step` for continuous
+    batching: row s attends keys <= pos[s] (pos (S,) int32).  Attention
+    reads only its own cache row, so slots never contaminate each other."""
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    keys = jnp.arange(k_cache.shape[1])
+    keep = keys[None, None, None, :] <= pos[:, None, None, None]
+    logits = jnp.where(keep, logits, -1e30)
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
@@ -404,6 +472,23 @@ class TransformerBlock(HybridBlock):
         """Batched cache fill through the block (see
         MultiHeadAttention.forward_prefill)."""
         a, cache = self.attn.forward_prefill(self.ln1(x), cache)
+        x = x + a
+        x = x + self.ffn(self.ln2(x))
+        return x, cache
+
+    def forward_step_slots(self, x, cache, pos):
+        """Continuous-batching decode through the block (see
+        MultiHeadAttention.forward_step_slots)."""
+        a, cache = self.attn.forward_step_slots(self.ln1(x), cache, pos)
+        x = x + a
+        x = x + self.ffn(self.ln2(x))
+        return x, cache
+
+    def forward_prefill_slots(self, x, cache, slot_idx):
+        """Bucketed admission prefill through the block (see
+        MultiHeadAttention.forward_prefill_slots)."""
+        a, cache = self.attn.forward_prefill_slots(self.ln1(x), cache,
+                                                   slot_idx)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache

@@ -104,6 +104,13 @@ def dot_product_attention(query, key, value, *, causal=False, mask=None,
     cross-contamination — the standard TPU lever against pad waste.
     ``kv_segment_ids`` (B, Tk) covers cross-attention; it defaults to
     ``segment_ids`` (self-attention).
+
+    Fully-masked rows: a query position whose keys are ALL masked out
+    (by ``mask``/``segment_ids``/a degenerate causal shape) returns
+    ZEROS, not the historical uniform average over values.  Both impls
+    agree on this — the Pallas kernel emits zeros for rows with no
+    matching key and the XLA reference path zeroes them to match — so
+    padding rows can be sliced away without contaminating reductions.
     """
     from ..ndarray.ops import _as_nd, invoke
     query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)

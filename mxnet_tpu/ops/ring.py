@@ -182,7 +182,8 @@ def _zigzag_perm(t: int, steps: int):
 def ring_attention(q, k, v, *, causal: bool = False,
                    scale: Optional[float] = None, mesh=None,
                    axis: str = "sp", batch_axis: str = "dp",
-                   heads_axis: str = "tp", balance: Optional[bool] = None):
+                   heads_axis: str = "tp", balance: Optional[bool] = None,
+                   segment_ids=None):
     """Sequence-parallel attention on global (B, T, H, D) jax arrays.
 
     Shards T over ``axis`` (and B over ``batch_axis``, H over
@@ -194,21 +195,48 @@ def ring_attention(q, k, v, *, causal: bool = False,
     causal masking never throws away half of every computed block: 2x
     fewer attention FLOPs at uniform per-device load, for one static
     gather of the inputs and one of the output.
+
+    ``segment_ids`` (B, T) int enables sequence packing: tokens attend
+    only within their own segment.  The ids shard over (batch, seq) and
+    the kv-side plane rotates around the ring with its K/V chunk; on the
+    balanced path the ids ride the same zigzag permutation as q/k/v, so
+    callers always pass them in the NATURAL sequence order.
     """
     from ..parallel.mesh import axis_size, current_mesh
     mesh = mesh or current_mesh()
     steps = axis_size(mesh, axis) if mesh is not None else 1
     d = q.shape[-1]
     scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    if segment_ids is not None:
+        segment_ids = jnp.asarray(segment_ids)
+        if tuple(segment_ids.shape) != (q.shape[0], q.shape[1]):
+            raise ValueError(
+                f"segment_ids must be (B, T)={(q.shape[0], q.shape[1])}, "
+                f"got {tuple(segment_ids.shape)}")
     if steps == 1:
-        from .attention import flash_attention
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        from .. import base as _base
+        from .attention import _attention_ref, _use_flash, flash_attention
+        if segment_ids is None:
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        if _use_flash(q.shape, causal, None, 0.0, k.shape,
+                      platform=_base.resolve_exec_platform(q)):
+            # the Pallas kernel masks per-tile from the raw (B, T) ids —
+            # never materialize the dense (B, 1, T, T) mask on TPU
+            from .flash import flash_attention as _pallas
+            return _pallas(q, k, v, causal=causal, scale=scale,
+                           segment_ids=segment_ids,
+                           kv_segment_ids=segment_ids)
+        seg_mask = (segment_ids[:, None, :, None] ==
+                    segment_ids[:, None, None, :])
+        return _attention_ref(q, k, v, causal=causal, mask=seg_mask,
+                              scale=scale)
     t = q.shape[1]
     if t % steps or k.shape[1] != t:
         raise ValueError(
             f"ring attention needs tq == tk divisible by |{axis}|={steps}, "
             f"got tq={t}, tk={k.shape[1]}")
     spec = P(batch_axis, axis, heads_axis, None)
+    seg_spec = P(batch_axis, axis)
     from ._smap import shard_mapped_qkv
     if balance and not causal:
         raise ValueError("balance=True requires causal=True (the zigzag "
@@ -225,21 +253,32 @@ def ring_attention(q, k, v, *, causal: bool = False,
         qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
         body = functools.partial(_ring_local_balanced, axis=axis,
                                  steps=steps, scale=scale)
-        out = shard_mapped_qkv(body, mesh, spec, qz, kz, vz)
+        if segment_ids is not None:
+            segz = jnp.take(segment_ids, perm, axis=1)
+            out = shard_mapped_qkv(body, mesh, spec, qz, kz, vz, segz,
+                                   extra_specs=(seg_spec,))
+        else:
+            out = shard_mapped_qkv(body, mesh, spec, qz, kz, vz)
         return jnp.take(out, inv, axis=1)
     body = functools.partial(_ring_local, axis=axis, steps=steps,
                              causal=causal, scale=scale)
+    if segment_ids is not None:
+        return shard_mapped_qkv(body, mesh, spec, q, k, v, segment_ids,
+                                extra_specs=(seg_spec,))
     return shard_mapped_qkv(body, mesh, spec, q, k, v)
 
 
 def nd_ring_attention(query, key, value, *, causal=False, scale=None,
-                      mesh=None, axis="sp", balance=None):
-    """NDArray-level entry (autograd-recorded) for ring attention."""
+                      mesh=None, axis="sp", balance=None, segment_ids=None):
+    """NDArray-level entry (autograd-recorded) for ring attention.
+    ``segment_ids`` (B, T) is a non-differentiable side input."""
     from ..ndarray.ops import _as_nd, invoke
     query, key, value = _as_nd(query), _as_nd(key), _as_nd(value)
+    seg = segment_ids.jax if hasattr(segment_ids, "jax") else segment_ids
 
     def f(q, k, v):
         return ring_attention(q, k, v, causal=causal, scale=scale,
-                              mesh=mesh, axis=axis, balance=balance)
+                              mesh=mesh, axis=axis, balance=balance,
+                              segment_ids=seg)
 
     return invoke("ring_attention", f, [query, key, value])

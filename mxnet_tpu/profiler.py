@@ -117,10 +117,23 @@ class Marker:
     def __init__(self, name: str):
         self.name = name
 
-    def mark(self, scope_="process"):
+    def mark(self, scope_="process", value=None):
+        """Instant event in the device trace.  ``value`` (int/float/str)
+        is embedded in the annotation name so counters exported by the
+        serving layer (queue depth, batch size, shed events) line up
+        with the XLA ops around them in the timeline."""
         import jax
-        with jax.profiler.TraceAnnotation(f"marker:{self.name}"):
+        name = f"marker:{self.name}" if value is None else \
+            f"marker:{self.name}={value}"
+        with jax.profiler.TraceAnnotation(name):
             pass
+
+    def span(self):
+        """The same marker as a named RANGE (context manager) — the
+        serving scheduler wraps each prefill/decode/forward batch in one
+        so per-batch host time is visible next to the device ops it
+        launched."""
+        return _Annotation(f"marker:{self.name}")
 
 
 def scope(name: str):

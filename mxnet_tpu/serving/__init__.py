@@ -1,0 +1,34 @@
+"""``mxnet_tpu.serving`` — online inference subsystem.
+
+Capability add over the reference (MXNet shipped model-server tooling
+out of tree and per-request Python dispatch in it): an in-process
+:class:`InferenceEngine` with dynamic batching, a shape-bucketed compile
+cache (pad-to-lattice so XLA compiles once per bucket, warmup API to
+pre-compile it), continuous batching of LM decode over a slot-managed
+persistent KV cache, bounded-queue load shedding, per-request deadlines
+and latency/throughput metrics.  See docs/serving.md.
+
+Quick start::
+
+    net = get_gpt2(...); net.initialize()
+    with InferenceEngine(net, num_slots=16) as eng:
+        eng.warmup()
+        futs = [eng.submit(p, max_new_tokens=32) for p in prompts]
+        outs = [f.result() for f in futs]
+        print(eng.stats()["latency"]["total"])
+"""
+from .batcher import BucketLattice, DynamicBatcher
+from .engine import InferenceEngine, InferenceFuture, Request
+from .errors import (EngineStoppedError, InvalidRequestError, QueueFullError,
+                     RequestTimeoutError, ServingError)
+from .kv_slots import SlotAllocator, SlotState
+from .metrics import LatencyHistogram, ServingMetrics
+
+__all__ = [
+    "InferenceEngine", "InferenceFuture", "Request",
+    "BucketLattice", "DynamicBatcher",
+    "SlotAllocator", "SlotState",
+    "LatencyHistogram", "ServingMetrics",
+    "ServingError", "QueueFullError", "RequestTimeoutError",
+    "EngineStoppedError", "InvalidRequestError",
+]

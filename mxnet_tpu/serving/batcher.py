@@ -1,0 +1,187 @@
+"""Dynamic batching + the shape-bucket lattice.
+
+XLA compiles one executable per input shape, so a serving engine that
+batched requests at their natural sizes would recompile on nearly every
+batch.  :class:`BucketLattice` quantizes the two dynamic dims — batch
+and (for decode prefill) sequence — onto a small fixed lattice: every
+batch is padded UP to the nearest lattice point, so the number of
+distinct compiled programs is bounded by the lattice size and a warmup
+pass can pre-compile all of them before traffic arrives.
+
+:class:`DynamicBatcher` is the admission queue in front of the
+scheduler: bounded (overflow is shed at ``put`` with
+:class:`~.errors.QueueFullError` — backpressure, not backlog), FIFO, and
+batch-forming under a max-batch / max-wait-µs policy — a batch closes
+when it reaches ``max_batch`` compatible requests or the OLDEST waiting
+request has waited ``max_wait_us``, whichever comes first (the standard
+throughput/latency knob pair).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .errors import EngineStoppedError, QueueFullError
+
+__all__ = ["BucketLattice", "DynamicBatcher"]
+
+
+def _pow2_lattice(lo: int, hi: int) -> Tuple[int, ...]:
+    out, v = [], lo
+    while v < hi:
+        out.append(v)
+        v *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+class BucketLattice:
+    """The (batch, seq) padding lattice.
+
+    ``batch(n)``/``seq(t)`` round UP to the nearest lattice point;
+    requests beyond the largest sequence bucket are unservable (the
+    engine rejects them at submit).  Defaults: powers of two."""
+
+    def __init__(self, batch_buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 8, max_seq: int = 1024):
+        bb = tuple(sorted(set(batch_buckets))) if batch_buckets else \
+            _pow2_lattice(1, max_batch)
+        sb = tuple(sorted(set(seq_buckets))) if seq_buckets else \
+            _pow2_lattice(min(16, max_seq), max_seq)
+        if bb[0] < 1 or sb[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {bb} / {sb}")
+        self.batch_buckets = bb
+        self.seq_buckets = sb
+
+    @staticmethod
+    def _round_up(v: int, buckets: Tuple[int, ...]) -> int:
+        for b in buckets:
+            if v <= b:
+                return b
+        raise ValueError(f"{v} exceeds largest bucket {buckets[-1]}")
+
+    def batch(self, n: int) -> int:
+        return self._round_up(n, self.batch_buckets)
+
+    def seq(self, t: int) -> int:
+        return self._round_up(t, self.seq_buckets)
+
+    @property
+    def max_seq(self) -> int:
+        return self.seq_buckets[-1]
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    def prefill_points(self):
+        """Every (batch_bucket, seq_bucket) pair — the warmup compile set."""
+        return [(b, s) for b in self.batch_buckets
+                for s in self.seq_buckets]
+
+    def __len__(self):
+        return len(self.batch_buckets) * len(self.seq_buckets)
+
+    def __repr__(self):
+        return (f"BucketLattice(batch={self.batch_buckets}, "
+                f"seq={self.seq_buckets})")
+
+
+class DynamicBatcher:
+    """Bounded FIFO admission queue with max-batch/max-wait batch forming.
+
+    The engine and the batcher share one Condition: producers
+    (``put``) notify the scheduler thread; the scheduler blocks in
+    ``get_batch`` only when it has nothing else to do (idle engine) and
+    otherwise drains whatever is ready without waiting (continuous
+    batching never stalls running requests on arriving ones).
+    """
+
+    def __init__(self, max_depth: int = 64,
+                 cond: Optional[threading.Condition] = None):
+        self.max_depth = max_depth
+        self._cond = cond or threading.Condition()
+        self._q: deque = deque()
+        self._closed = False
+
+    @property
+    def cond(self) -> threading.Condition:
+        return self._cond
+
+    def __len__(self):
+        return len(self._q)
+
+    def empty(self) -> bool:
+        return not self._q
+
+    def put(self, req) -> None:
+        """Enqueue or shed.  O(1); never blocks the caller."""
+        with self._cond:
+            if self._closed:
+                raise EngineStoppedError(
+                    "engine is stopped — request not accepted")
+            if len(self._q) >= self.max_depth:
+                raise QueueFullError(
+                    f"request queue at configured depth "
+                    f"{self.max_depth} — shedding load")
+            req.t_enqueue = time.monotonic()
+            self._q.append(req)
+            self._cond.notify_all()
+
+    def get_batch(self, max_batch: int, max_wait_us: float,
+                  compatible: Optional[Callable] = None,
+                  wait: bool = True) -> List:
+        """Form one batch.
+
+        Blocks (if ``wait``) until at least one request is queued, then
+        keeps collecting until ``max_batch`` COMPATIBLE requests are
+        ready or the oldest has waited ``max_wait_us``.  ``compatible``
+        maps a request to a grouping key (e.g. input shape); the batch
+        takes the head's key and skips over mismatches without
+        reordering them.  Returns [] if closed-and-empty or ``wait`` is
+        False with nothing queued.
+        """
+        with self._cond:
+            if wait:
+                while not self._q and not self._closed:
+                    self._cond.wait(0.1)
+            if not self._q:
+                return []
+            head = self._q[0]
+            deadline = head.t_enqueue + max_wait_us * 1e-6
+            while (len(self._q) < max_batch and not self._closed):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            key = compatible(head) if compatible else None
+            batch, leftover = [], deque()
+            while self._q and len(batch) < max_batch:
+                r = self._q.popleft()
+                if compatible is None or compatible(r) == key:
+                    batch.append(r)
+                else:
+                    leftover.append(r)
+            leftover.extend(self._q)
+            self._q = leftover
+            return batch
+
+    def drain(self) -> List:
+        """Remove and return everything queued (shutdown/cancel path)."""
+        with self._cond:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def close(self):
+        """Stop accepting new requests; wake any waiter."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

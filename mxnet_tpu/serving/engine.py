@@ -1,0 +1,642 @@
+"""`InferenceEngine` — the online-serving front end.
+
+One background scheduler thread owns all device work; callers interact
+through ``submit()`` (async, returns an :class:`InferenceFuture`) or
+``infer()`` (sync).  Two first-class execution paths:
+
+- **decode** (GPT-2 style LMs exposing ``prefill_slots``/``decode_step``):
+  continuous batching over a persistent slot-batched KV cache — new
+  requests prefill into free cache rows between decode steps of the
+  in-flight ones, so a long generation never blocks a short one and the
+  decode matmuls stay batched at all times (Orca-style iteration-level
+  scheduling; the slot cache is the XLA-static stand-in for vLLM's
+  paged blocks).
+
+- **forward** (any ``HybridBlock``, e.g. vision): classic dynamic
+  batching — group same-shape requests, pad the batch dim to the bucket
+  lattice, run one compiled forward, scatter rows back.
+
+Both paths pad to a fixed shape-bucket lattice so XLA compiles once per
+bucket; ``warmup()`` pre-compiles the whole lattice so no request ever
+pays a compile.  The compiled step itself reuses CachedOp's
+functionalization (``make_pure_fn``): parameters are swapped in as
+traced arguments, inference mode, no tape.
+
+Backpressure: a bounded queue sheds at ``submit`` with
+:class:`QueueFullError`; each request can carry a deadline, enforced
+while queued AND mid-generation.  ``stats()`` exposes latency
+percentiles, token counters and the bucket-hit/compile counters;
+scheduler batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as onp
+
+from .batcher import BucketLattice, DynamicBatcher
+from .errors import (EngineStoppedError, InvalidRequestError, QueueFullError,
+                     RequestTimeoutError, ServingError)
+from .kv_slots import SlotAllocator, SlotState
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceEngine", "InferenceFuture", "Request"]
+
+
+class InferenceFuture:
+    """Write-once result holder; safe across threads."""
+
+    __slots__ = ("_ev", "_result", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def set_result(self, value):
+        if not self._ev.is_set():
+            self._result = value
+            self._ev.set()
+
+    def set_exception(self, exc: BaseException):
+        if not self._ev.is_set():
+            self._exc = exc
+            self._ev.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("result() wait timed out (the request may "
+                               "still complete server-side)")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class Request:
+    __slots__ = ("id", "kind", "payload", "prompt_len", "max_new_tokens",
+                 "eos_id", "deadline", "future", "t_submit", "t_enqueue",
+                 "t_schedule", "shape_key")
+
+    _ids = itertools.count()
+
+    def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
+                 deadline=None):
+        self.id = next(self._ids)
+        self.kind = kind
+        self.payload = payload
+        self.prompt_len = int(payload.shape[0]) if kind == "decode" else 0
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.future = InferenceFuture()
+        self.t_submit = time.monotonic()
+        self.t_enqueue = self.t_submit
+        self.t_schedule = None
+        self.shape_key = (tuple(payload.shape), str(payload.dtype)) \
+            if kind == "forward" else None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class InferenceEngine:
+    """Serve a ``HybridBlock`` online.  See the module docstring.
+
+    Parameters
+    ----------
+    net : HybridBlock
+        Initialized model.  ``mode='decode'`` needs the serving decode
+        surface (``prefill_slots``/``decode_step``/``init_slot_cache``,
+        e.g. :class:`~mxnet_tpu.models.gpt2.GPT2Model`); any block
+        serves in ``mode='forward'``.
+    mode : 'decode' | 'forward' | None (auto-detect)
+    max_batch / max_wait_us : dynamic-batching policy — a batch closes
+        at ``max_batch`` requests or when the oldest has waited
+        ``max_wait_us``.
+    queue_depth : bounded admission queue; beyond it ``submit`` raises
+        :class:`QueueFullError`.
+    default_timeout : per-request deadline in seconds (None = no limit),
+        overridable per ``submit``.
+    num_slots : decode concurrency (KV cache rows); default
+        ``max_batch``.
+    max_length : decode KV length per slot; default ``net.max_length``.
+    batch_buckets / seq_buckets : explicit shape lattice (defaults:
+        powers of two up to ``max_batch`` / ``max_length``).
+    eos_id : stop token for decode requests (overridable per submit).
+    default_max_new_tokens : decode budget when ``submit`` omits it.
+    """
+
+    def __init__(self, net, mode: Optional[str] = None, *,
+                 max_batch: int = 8, max_wait_us: float = 2000.0,
+                 queue_depth: int = 64,
+                 default_timeout: Optional[float] = None,
+                 num_slots: Optional[int] = None,
+                 max_length: Optional[int] = None,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None,
+                 default_max_new_tokens: int = 16,
+                 name: str = "serving"):
+        if mode is None:
+            mode = "decode" if hasattr(net, "decode_step") and \
+                hasattr(net, "prefill_slots") else "forward"
+        if mode not in ("decode", "forward"):
+            raise ValueError(f"mode must be 'decode'|'forward', got {mode}")
+        if mode == "decode" and not hasattr(net, "prefill_slots"):
+            raise ValueError(f"{type(net).__name__} lacks the serving "
+                             "decode surface (prefill_slots/decode_step)")
+        self.net = net
+        self.mode = mode
+        self.max_batch = int(max_batch)
+        self.max_wait_us = float(max_wait_us)
+        self.default_timeout = default_timeout
+        self.eos_id = eos_id
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.metrics = ServingMetrics(name)
+
+        if mode == "decode":
+            self.max_length = int(max_length or net.max_length)
+            if getattr(net, "max_length", None) is not None and \
+                    self.max_length > net.max_length:
+                raise ValueError(
+                    f"max_length={self.max_length} exceeds the model's "
+                    f"position table (net.max_length={net.max_length}) — "
+                    "positions past it would silently clamp, not error")
+            self.num_slots = int(num_slots or max_batch)
+            self.lattice = BucketLattice(
+                batch_buckets, seq_buckets,
+                max_batch=min(self.max_batch, self.num_slots),
+                max_seq=self.max_length)
+            if self.lattice.max_seq > self.max_length:
+                raise ValueError(
+                    f"largest seq bucket {self.lattice.max_seq} exceeds "
+                    f"KV length max_length={self.max_length}")
+            self._alloc = SlotAllocator(self.num_slots)
+        else:
+            self.max_length = None
+            self.num_slots = 0
+            self.lattice = BucketLattice(batch_buckets, (1,),
+                                         max_batch=self.max_batch)
+            self._alloc = None
+
+        self._cond = threading.Condition()
+        self._batcher = DynamicBatcher(queue_depth, cond=self._cond)
+        self._step_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._caches = None
+        self._shape_seen = set()
+        self._fwd_single = None
+        self._build_fns()
+
+    # ------------------------------------------------------------ compiled fns
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..gluon.cached_op import make_pure_fn
+        from ..ndarray import NDArray
+
+        net = self.net
+        if self.mode == "decode":
+            def prefill(toks, lens, caches, sidx):
+                logits, c = net.prefill_slots(NDArray(toks), lens, caches,
+                                              sidx)
+                return jnp.argmax(logits.jax, -1).astype(jnp.int32), c
+
+            def step(tok, caches, pos):
+                logits, c = net.decode_step(NDArray(tok), caches, pos)
+                return jnp.argmax(logits.jax, -1).astype(jnp.int32), c
+
+            self._items, pure_prefill = make_pure_fn(net, prefill)
+            _, pure_step = make_pure_fn(net, step)
+            # donate the cache buffers on TPU (in-place update, no copy of
+            # the S×Tmax×H×D arrays per step); CPU jax warns on donation
+            if jax.default_backend() == "tpu":
+                self._jit_prefill = jax.jit(pure_prefill,
+                                            donate_argnums=(3,))
+                self._jit_step = jax.jit(pure_step, donate_argnums=(2,))
+            else:
+                self._jit_prefill = jax.jit(pure_prefill)
+                self._jit_step = jax.jit(pure_step)
+        else:
+            def forward(xs):
+                out = net(NDArray(xs))
+                if isinstance(out, NDArray):
+                    if self._fwd_single is None:
+                        self._fwd_single = True
+                    return (out.jax,)
+                if self._fwd_single is None:
+                    self._fwd_single = False
+                return tuple(o.jax for o in out)
+
+            self._items, pure_forward = make_pure_fn(net, forward)
+            self._jit_forward = jax.jit(pure_forward)
+
+    def _params(self):
+        return tuple(p._data.jax for p in self._items)
+
+    def _counted(self, key, fn, *args):
+        """Run a compiled entry, tracking engine-level bucket hits vs
+        compiles (mirrors jax's per-shape executable cache)."""
+        if key in self._shape_seen:
+            self.metrics.count("bucket_hits")
+        else:
+            self._shape_seen.add(key)
+            self.metrics.count("compiles")
+        with self.metrics.span(key[0]):
+            return fn(*args)
+
+    # ---------------------------------------------------------------- lifecycle
+    def start(self):
+        if self._thread is not None:
+            raise ServingError("engine already started")
+        if self._batcher.closed:
+            raise ServingError("engine cannot be restarted once stopped "
+                               "— build a fresh InferenceEngine")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxnet_tpu-serving",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the engine.  ``drain=True`` finishes everything queued
+        and in flight first; ``drain=False`` fails pending AND in-flight
+        requests with :class:`EngineStoppedError` immediately."""
+        self._batcher.close()
+        if not drain:
+            with self._step_lock:       # scheduler is between cycles here
+                exc = EngineStoppedError("engine stopped without drain")
+                for req in self._batcher.drain():
+                    self._fail(req, exc)
+                if self._alloc is not None:
+                    for slot, st in list(self._alloc.items()):
+                        self._alloc.free(slot)
+                        self._fail(st.request, exc)
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise ServingError("scheduler thread failed to stop "
+                                   f"within {timeout}s")
+        else:
+            # never started: nothing can drain — fail whatever queued
+            exc = EngineStoppedError("engine stopped before starting")
+            for req in self._batcher.drain():
+                self._fail(req, exc)
+        self._thread = None
+
+    def __enter__(self):
+        if self._thread is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=not any(exc))
+
+    # ------------------------------------------------------------------ submit
+    def submit(self, x, max_new_tokens: Optional[int] = None,
+               timeout: Optional[float] = None,
+               eos_id: Optional[int] = None) -> InferenceFuture:
+        """Enqueue one request; returns its future.
+
+        decode mode: ``x`` is a 1-D int prompt (list/np/NDArray); the
+        result is the full sequence (prompt + generated) as np.int32.
+        forward mode: ``x`` is ONE example WITHOUT the batch dim; the
+        result is the corresponding output row (tuple of rows for
+        multi-output nets).
+
+        ``timeout`` sets the request's SERVER-side deadline in seconds
+        (``None``/``0`` = no deadline), enforced while queued and
+        mid-generation.
+        """
+        timeout = self.default_timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout if timeout else None
+        if self.mode == "decode":
+            arr = onp.asarray(getattr(x, "asnumpy", lambda: x)(),
+                              dtype="int32")
+            if arr.ndim == 2 and arr.shape[0] == 1:
+                arr = arr[0]        # generate-style (1, T) prompt
+            if arr.ndim != 1:
+                self.metrics.count("rejected_invalid")
+                raise InvalidRequestError(
+                    f"a decode request is ONE prompt: expected shape (T,) "
+                    f"or (1, T), got {arr.shape} — submit batch rows "
+                    "individually, batching is the engine's job")
+            mnt = int(self.default_max_new_tokens if max_new_tokens is None
+                      else max_new_tokens)
+            if arr.size < 1 or mnt < 1:
+                self.metrics.count("rejected_invalid")
+                raise InvalidRequestError(
+                    f"need a non-empty prompt and max_new_tokens >= 1 "
+                    f"(got len={arr.size}, max_new_tokens={mnt})")
+            if arr.size > self.lattice.max_seq or \
+                    arr.size + mnt > self.max_length:
+                self.metrics.count("rejected_invalid")
+                raise InvalidRequestError(
+                    f"prompt len {arr.size} + {mnt} new tokens does not "
+                    f"fit (largest seq bucket {self.lattice.max_seq}, "
+                    f"KV length {self.max_length})")
+            req = Request("decode", arr, mnt,
+                          self.eos_id if eos_id is None else eos_id,
+                          deadline)
+        else:
+            arr = onp.asarray(getattr(x, "asnumpy", lambda: x)())
+            req = Request("forward", arr, deadline=deadline)
+        self.metrics.count("submitted")
+        try:
+            self._batcher.put(req)
+        except QueueFullError:
+            self.metrics.count("rejected_queue_full")
+            self.metrics.mark("shed")
+            raise
+        return req.future
+
+    def infer(self, x, max_new_tokens: Optional[int] = None,
+              timeout: Optional[float] = None,
+              eos_id: Optional[int] = None):
+        """Synchronous ``submit()`` + wait.  ``timeout`` is the SERVER
+        deadline; the wait itself is unbounded — the scheduler resolves
+        every future (result, typed timeout, or engine error), so a
+        timed-out request always surfaces as
+        :class:`RequestTimeoutError`, never a bare client-side wait
+        timeout (a fixed client grace could expire during a long first
+        compile and mask the typed error)."""
+        if self._thread is None:
+            raise ServingError("engine not started — call start() or use "
+                               "the context manager (submit() alone may "
+                               "queue pre-start, but a sync infer() would "
+                               "block forever)")
+        fut = self.submit(x, max_new_tokens, timeout, eos_id)
+        return fut.result(None)
+
+    # ------------------------------------------------------------------ warmup
+    def warmup(self, example_shape: Optional[Sequence[int]] = None,
+               dtype: str = "float32") -> int:
+        """Pre-compile the whole bucket lattice so live traffic never
+        pays an XLA compile.  Decode mode compiles the decode step plus
+        every (batch, seq) prefill point; forward mode needs the
+        per-example ``example_shape`` (no batch dim).  Requires an idle
+        engine (no in-flight decodes).  Returns the number of programs
+        compiled."""
+        import jax.numpy as jnp
+
+        with self._step_lock:
+            before = self.metrics.counters["compiles"]
+            params = self._params()
+            if self.mode == "decode":
+                if self._alloc.active_count:
+                    raise ServingError("warmup needs an idle engine "
+                                       "(decode writes would collide with "
+                                       "in-flight slots)")
+                self._ensure_caches()
+                s1 = self.num_slots + 1
+                zeros = jnp.zeros((s1,), jnp.int32)
+                _, self._caches = self._counted(
+                    ("decode",), self._jit_step, params, zeros,
+                    self._caches, zeros)
+                scratch = self._alloc.scratch
+                for bb, tb in self.lattice.prefill_points():
+                    toks = jnp.zeros((bb, tb), jnp.int32)
+                    lens = jnp.ones((bb,), jnp.int32)
+                    sidx = jnp.full((bb,), scratch, jnp.int32)
+                    _, self._caches = self._counted(
+                        ("prefill", bb, tb), self._jit_prefill, params,
+                        toks, lens, self._caches, sidx)
+            else:
+                if example_shape is None:
+                    raise ServingError("forward-mode warmup needs "
+                                       "example_shape (per-example, no "
+                                       "batch dim)")
+                shape_key = (tuple(int(d) for d in example_shape),
+                             str(onp.dtype(dtype)))
+                for bb in self.lattice.batch_buckets:
+                    xs = jnp.zeros((bb,) + shape_key[0],
+                                   onp.dtype(dtype).name)
+                    self._counted(("forward", bb) + shape_key,
+                                  self._jit_forward, params, xs)
+            return self.metrics.counters["compiles"] - before
+
+    # ------------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        s = self.metrics.stats()
+        s["engine"] = {
+            "mode": self.mode,
+            "queued": len(self._batcher),
+            "active_slots": self._alloc.active_count if self._alloc else 0,
+            "num_slots": self.num_slots,
+            "batch_buckets": list(self.lattice.batch_buckets),
+            "seq_buckets": list(self.lattice.seq_buckets)
+            if self.mode == "decode" else None,
+            "running": self._thread is not None,
+        }
+        return s
+
+    # --------------------------------------------------------------- scheduler
+    def _loop(self):
+        while True:
+            with self._cond:
+                idle = (self._alloc is None
+                        or self._alloc.active_count == 0)
+                if self._batcher.empty() and idle:
+                    if self._stopping:
+                        return
+                    self._cond.wait(0.05)
+                    continue
+            try:
+                with self._step_lock:
+                    if self.mode == "decode":
+                        self._decode_cycle()
+                    else:
+                        self._forward_cycle()
+            except BaseException as e:  # defensive: never leave futures hung
+                with self._step_lock:
+                    self._fail_inflight(e)
+
+    def _filter_expired(self, reqs):
+        """Fail deadline-blown queued requests; return the live rest."""
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                self._fail(r, RequestTimeoutError(
+                    f"request {r.id} timed out in queue"))
+            else:
+                live.append(r)
+        return live
+
+    def _fail(self, req: Request, exc: BaseException):
+        req.future.set_exception(exc)
+        if isinstance(exc, RequestTimeoutError):
+            self.metrics.count("timeouts")
+            self.metrics.mark("timeout")
+        elif isinstance(exc, EngineStoppedError):
+            self.metrics.count("cancelled")
+
+    def _fail_inflight(self, exc: BaseException):
+        for req in self._batcher.drain():
+            self._fail(req, exc)
+        if self._alloc is not None:
+            for slot, st in list(self._alloc.items()):
+                self._alloc.free(slot)
+                self._fail(st.request, exc)
+            # the cache buffers may be donated-away or poisoned by the
+            # failed step — drop them so the next admission rebuilds
+            self._caches = None
+
+    def _complete(self, st: SlotState):
+        req = st.request
+        seq = onp.concatenate(
+            [req.payload, onp.asarray(st.generated, "int32")])
+        now = time.monotonic()
+        self.metrics.observe_request(req.t_schedule - req.t_submit,
+                                     now - req.t_schedule)
+        self.metrics.count("completed")
+        self.metrics.count("tokens_generated", len(st.generated))
+        req.future.set_result(seq)
+
+    # ------------------------------------------------------------ decode path
+    def _ensure_caches(self):
+        if self._caches is None:
+            self._caches = self.net.init_slot_cache(self.num_slots + 1,
+                                                    self.max_length)
+
+    def _decode_cycle(self):
+        alloc = self._alloc
+        now = time.monotonic()
+        # mid-flight deadline enforcement
+        for slot, st in alloc.items():
+            if st.request.expired(now):
+                alloc.free(slot)
+                self._fail(st.request, RequestTimeoutError(
+                    f"request {st.request.id} timed out after "
+                    f"{len(st.generated)} tokens"))
+        # admission: fill free slots from the queue; only an IDLE engine
+        # waits out the batching window — with requests in flight the
+        # arrivals ride the next cycle (continuous batching)
+        free = alloc.free_count
+        if free and not self._batcher.empty():
+            wait_us = self.max_wait_us if alloc.active_count == 0 else 0
+            reqs = self._batcher.get_batch(
+                min(free, self.lattice.max_batch), wait_us, wait=False)
+            live = self._filter_expired(reqs)
+            groups = {}
+            for r in live:
+                groups.setdefault(self.lattice.seq(r.prompt_len),
+                                  []).append(r)
+            for tb in sorted(groups):
+                self._admit_group(groups[tb], tb)
+        if alloc.active_count:
+            self._decode_step()
+
+    def _admit_group(self, group, tb):
+        import jax.numpy as jnp
+
+        alloc = self._alloc
+        bb = self.lattice.batch(len(group))
+        toks = onp.zeros((bb, tb), "int32")
+        lens = onp.ones((bb,), "int32")
+        sidx = onp.full((bb,), alloc.scratch, "int32")
+        states = []
+        now = time.monotonic()
+        n_prompt = 0
+        for i, req in enumerate(group):
+            toks[i, :req.prompt_len] = req.payload
+            lens[i] = req.prompt_len
+            n_prompt += req.prompt_len
+            st = SlotState(req, req.prompt_len, req.max_new_tokens)
+            sidx[i] = alloc.alloc(st)
+            req.t_schedule = now
+            states.append(st)
+        self.metrics.count("admitted", len(group))
+        self.metrics.count("prompt_tokens", n_prompt)
+        self.metrics.count("padded_tokens", bb * tb - n_prompt)
+        self.metrics.count("prefill_batches")
+        self.metrics.mark("admit", len(group))
+        self._ensure_caches()
+        first, self._caches = self._counted(
+            ("prefill", bb, tb), self._jit_prefill, self._params(),
+            jnp.asarray(toks), jnp.asarray(lens), self._caches,
+            jnp.asarray(sidx))
+        first = onp.asarray(first)
+        for i, st in enumerate(states):
+            st.advance(int(first[i]))
+            self._finish_if_done(int(sidx[i]), st)
+
+    def _finish_if_done(self, slot: int, st: SlotState):
+        if st.done or (st.request.eos_id is not None
+                       and st.last_token == st.request.eos_id):
+            self._alloc.free(slot)
+            self._complete(st)
+
+    def _decode_step(self):
+        import jax.numpy as jnp
+
+        alloc = self._alloc
+        s1 = self.num_slots + 1
+        tok = onp.zeros((s1,), "int32")
+        pos = onp.zeros((s1,), "int32")
+        for slot, st in alloc.items():
+            tok[slot] = st.last_token
+            pos[slot] = st.pos
+        self.metrics.count("decode_steps")
+        nxt, self._caches = self._counted(
+            ("decode",), self._jit_step, self._params(),
+            jnp.asarray(tok), self._caches, jnp.asarray(pos))
+        nxt = onp.asarray(nxt)
+        for slot, st in alloc.items():
+            st.advance(int(nxt[slot]))
+            self._finish_if_done(slot, st)
+
+    # ----------------------------------------------------------- forward path
+    def _forward_cycle(self):
+        import jax.numpy as jnp
+
+        reqs = self._batcher.get_batch(
+            self.max_batch, self.max_wait_us,
+            compatible=lambda r: r.shape_key, wait=False)
+        if not reqs:
+            return
+        live = self._filter_expired(reqs)
+        if not live:
+            return
+        now = time.monotonic()
+        for r in live:
+            r.t_schedule = now
+        bb = self.lattice.batch(len(live))
+        xs = onp.stack([r.payload for r in live] +
+                       [onp.zeros_like(live[0].payload)] *
+                       (bb - len(live)))
+        self.metrics.count("admitted", len(live))
+        self.metrics.count("forward_batches")
+        self.metrics.mark("admit", len(live))
+        key = ("forward", bb) + live[0].shape_key
+        try:
+            outs = self._counted(key, self._jit_forward, self._params(),
+                                 jnp.asarray(xs))
+            outs = [onp.asarray(o) for o in outs]
+        except BaseException as e:
+            # the popped batch lives in neither the batcher nor the slot
+            # allocator — fail it HERE or the futures hang forever; the
+            # rest of the queue is untouched (no shared state to poison)
+            for r in live:
+                self._fail(r, e)
+            return
+        done = time.monotonic()
+        for i, r in enumerate(live):
+            res = outs[0][i] if self._fwd_single else \
+                tuple(o[i] for o in outs)
+            self.metrics.observe_request(r.t_schedule - r.t_submit,
+                                         done - r.t_schedule)
+            self.metrics.count("completed")
+            r.future.set_result(res)

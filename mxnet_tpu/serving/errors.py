@@ -1,0 +1,44 @@
+"""Serving error taxonomy + backpressure contract.
+
+Every failure a request can see is a typed subclass of
+:class:`ServingError`, so callers can distinguish "shed under load —
+retry elsewhere" (:class:`QueueFullError`), "missed its deadline"
+(:class:`RequestTimeoutError`), "engine going away"
+(:class:`EngineStoppedError`) and "request itself is malformed"
+(:class:`InvalidRequestError`).  Load shedding happens at ``submit()``
+time against a bounded queue — a saturated engine rejects instantly
+instead of building an unbounded latency backlog (the Orca/vLLM
+admission-control discipline).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
+           "EngineStoppedError", "InvalidRequestError"]
+
+
+class ServingError(MXNetError):
+    """Base class for all online-inference failures."""
+
+
+class QueueFullError(ServingError):
+    """Backpressure: the bounded request queue is at its configured
+    depth; the request was shed WITHOUT being enqueued."""
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline elapsed — while queued, or mid-generation
+    (a partially generated sequence is discarded and its KV slot
+    freed)."""
+
+
+class EngineStoppedError(ServingError):
+    """The engine is stopped/stopping and not accepting (or no longer
+    able to finish) this request."""
+
+
+class InvalidRequestError(ServingError):
+    """The request can never be served by this engine configuration
+    (e.g. prompt longer than the largest sequence bucket, or
+    prompt + max_new_tokens exceeding the KV cache length)."""

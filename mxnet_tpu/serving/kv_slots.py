@@ -1,0 +1,96 @@
+"""KV-cache slot management for continuous batching.
+
+The engine owns ONE persistent batched KV cache per layer, shaped
+(S+1, Tmax, H, D): rows 0..S-1 are SLOTS a generation request leases for
+its lifetime, row S is SCRATCH (the write target for padding rows of a
+bucketed prefill, and for free slots during a decode step — XLA wants a
+fixed shape, so every row computes every step).  This is the
+fixed-shape, XLA-friendly version of vLLM's paged KV blocks: instead of
+paging, a request leases a whole row, and "continuous batching" (Orca)
+falls out of rows being at independent positions — admission drops a new
+request into any free row mid-flight without disturbing the others.
+
+:class:`SlotAllocator` tracks the lease lifecycle (admit → decode… →
+free) plus per-slot decode state; it is scheduler-thread-only (no
+locks) — the engine serializes all access.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["SlotState", "SlotAllocator"]
+
+
+class SlotState:
+    """Decode-time state of one leased slot."""
+
+    __slots__ = ("request", "prompt_len", "pos", "last_token", "generated",
+                 "max_new_tokens")
+
+    def __init__(self, request, prompt_len: int, max_new_tokens: int):
+        self.request = request
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        # pos == position of last_token == where the NEXT decode step
+        # writes its K/V (the step consumes last_token at pos, emits the
+        # token for pos+1)
+        self.pos = prompt_len
+        self.last_token: Optional[int] = None
+        self.generated: List[int] = []
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens
+
+    def advance(self, token: int):
+        """Record one generated token; generated[i] sits at position
+        prompt_len + i, so pos tracks the LAST token's position."""
+        self.generated.append(token)
+        self.last_token = token
+        self.pos = self.prompt_len + len(self.generated) - 1
+
+
+class SlotAllocator:
+    """Free-list allocator over the S cache rows (scratch excluded)."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.scratch = num_slots           # row S of the (S+1, ...) cache
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        self._active: Dict[int, SlotState] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def alloc(self, state: SlotState) -> int:
+        """Lease a free row for ``state``; raises if none free (the
+        engine admits at most ``free_count`` requests per cycle)."""
+        if not self._free:
+            raise RuntimeError("no free KV slots (admission bug: engine "
+                               "must admit <= free_count)")
+        slot = self._free.pop()
+        self._active[slot] = state
+        return slot
+
+    def free(self, slot: int) -> SlotState:
+        """End a lease.  The row's stale K/V needs no scrubbing: the next
+        prefill overwrites [0, Tb) and decode rewrites each later
+        position before ever attending to it."""
+        state = self._active.pop(slot)
+        self._free.append(slot)
+        return state
+
+    def items(self):
+        """(slot, state) pairs of active leases, slot-ordered (stable
+        iteration while the engine mutates per-slot state)."""
+        return sorted(self._active.items())
+
+    def __contains__(self, slot: int) -> bool:
+        return slot in self._active

@@ -1,0 +1,156 @@
+"""Serving observability: latency histograms, throughput counters, and
+compile-cache hit tracking, exported two ways —
+
+- ``stats()``: a plain dict (p50/p95/p99, counts, rates) for scraping
+  into whatever the host fleet uses;
+- :mod:`mxnet_tpu.profiler` ``Marker``/``scope`` annotations around every
+  batch the scheduler executes, so a ``jax.profiler`` device trace of a
+  serving process shows prefill/decode batches interleaved with the XLA
+  ops they launched.
+
+Histograms are log-spaced (10µs … ~2min) so one shape covers both a
+CPU-sanity test and a TPU fleet; percentile queries interpolate inside
+the winning bucket.  All mutation is lock-guarded — the scheduler thread
+and any number of ``stats()`` readers may race freely.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List
+
+from .. import profiler as _profiler
+
+__all__ = ["LatencyHistogram", "ServingMetrics"]
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram over seconds.
+
+    ``bounds[i]`` is the inclusive upper edge of bucket i; the last
+    bucket is open-ended.  ``percentile`` returns a geometric
+    interpolation inside the selected bucket — exact enough for
+    p50/p95/p99 dashboards without keeping raw samples.
+    """
+
+    def __init__(self, lo: float = 1e-5, hi: float = 120.0,
+                 buckets_per_decade: int = 5):
+        n = int(math.ceil(math.log10(hi / lo) * buckets_per_decade))
+        ratio = (hi / lo) ** (1.0 / n)
+        self.bounds = [lo * ratio ** (i + 1) for i in range(n)]
+        self.counts = [0] * (n + 1)
+        self.total = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float):
+        seconds = max(float(seconds), 0.0)
+        lo, bounds = 0, self.bounds
+        hi = len(bounds)
+        while lo < hi:                       # first bound >= seconds
+            mid = (lo + hi) // 2
+            if bounds[mid] < seconds:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.counts[lo] += 1
+        self.total += 1
+        self.sum += seconds
+        self.max = max(self.max, seconds)
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; 0 with no samples."""
+        if not self.total:
+            return 0.0
+        rank = q / 100.0 * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                if i >= len(self.bounds):            # open-ended tail
+                    return self.max
+                lo = self.bounds[i - 1] if i else self.bounds[0] / 2
+                hi = self.bounds[i]
+                frac = (rank - (seen - c)) / c
+                # geometric interp, clamped: a bucket's upper edge can
+                # overshoot the true sample max
+                return min(lo * (hi / lo) ** frac, self.max)
+        return self.max
+
+    def summary(self) -> Dict[str, float]:
+        mean = self.sum / self.total if self.total else 0.0
+        return {"count": self.total,
+                "mean_ms": round(mean * 1e3, 3),
+                "p50_ms": round(self.percentile(50) * 1e3, 3),
+                "p95_ms": round(self.percentile(95) * 1e3, 3),
+                "p99_ms": round(self.percentile(99) * 1e3, 3),
+                "max_ms": round(self.max * 1e3, 3)}
+
+
+class ServingMetrics:
+    """All engine counters + the three per-request latency histograms
+    (queue = submit→scheduled, compute = scheduled→done, total)."""
+
+    _COUNTERS = ("submitted", "admitted", "completed", "rejected_queue_full",
+                 "rejected_invalid", "timeouts", "cancelled",
+                 "prefill_batches", "decode_steps", "forward_batches",
+                 "bucket_hits", "compiles", "tokens_generated",
+                 "prompt_tokens", "padded_tokens")
+
+    def __init__(self, name: str = "serving"):
+        self.name = name
+        self._lock = threading.Lock()
+        self.counters = {k: 0 for k in self._COUNTERS}
+        self.queue = LatencyHistogram()
+        self.compute = LatencyHistogram()
+        self.total = LatencyHistogram()
+
+    # ------------------------------------------------------------- counters
+    def count(self, key: str, n: int = 1):
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def observe_request(self, queue_s: float, compute_s: float):
+        with self._lock:
+            self.queue.observe(queue_s)
+            self.compute.observe(compute_s)
+            self.total.observe(queue_s + compute_s)
+
+    # ------------------------------------------------- profiler integration
+    def span(self, kind: str):
+        """Named range in the device trace around one scheduled batch
+        (shows up next to the XLA ops it launched)."""
+        return _profiler.Marker(f"{self.name}:{kind}").span()
+
+    def mark(self, event: str, value=None):
+        """Instant marker (e.g. admission, shed, timeout); ``value``
+        (batch size, queue depth, …) is embedded in the annotation."""
+        _profiler.Marker(f"{self.name}:{event}").mark(value=value)
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            c = dict(self.counters)
+            lat = {"queue": self.queue.summary(),
+                   "compute": self.compute.summary(),
+                   "total": self.total.summary()}
+        lookups = c["bucket_hits"] + c["compiles"]
+        return {
+            "requests": {k: c[k] for k in
+                         ("submitted", "admitted", "completed",
+                          "rejected_queue_full", "rejected_invalid",
+                          "timeouts", "cancelled")},
+            "batches": {k: c[k] for k in
+                        ("prefill_batches", "decode_steps",
+                         "forward_batches")},
+            "tokens": {k: c[k] for k in
+                       ("tokens_generated", "prompt_tokens",
+                        "padded_tokens")},
+            "compile_cache": {
+                "bucket_hits": c["bucket_hits"],
+                "compiles": c["compiles"],
+                "hit_rate": round(c["bucket_hits"] / lookups, 4)
+                if lookups else None,
+            },
+            "latency": lat,
+        }

@@ -1,0 +1,213 @@
+"""mxnet_tpu.serving — online inference engine.
+
+Contracts under test: batched continuous decoding is TOKEN-IDENTICAL to
+per-request ``net.generate``; compiles are bounded by the bucket
+lattice; backpressure sheds, deadlines fire, shutdown drains.
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import (BucketLattice, EngineStoppedError,
+                               InferenceEngine, InvalidRequestError,
+                               LatencyHistogram, QueueFullError,
+                               RequestTimeoutError)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 97, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    return InferenceEngine(net, **kw)
+
+
+# ------------------------------------------------------------------ parity
+
+def test_batched_greedy_parity_and_bounded_compiles(net):
+    """The acceptance contract: a mixed-length concurrent workload decoded
+    by the engine is token-identical to per-request net.generate, and the
+    number of XLA programs stays <= the bucket lattice (+1 decode step)."""
+    prompts = _prompts((3, 5, 9, 12, 5, 7, 16, 2))
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net)
+    n_warm = eng.warmup()
+    lattice_size = len(eng.lattice)
+    assert n_warm <= lattice_size + 1          # prefill points + decode
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    # mixed-shape traffic after warmup NEVER compiles: all bucket hits
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["compile_cache"]["compiles"] <= lattice_size + 1
+    assert s["compile_cache"]["bucket_hits"] > 0
+    assert s["requests"]["completed"] == len(prompts)
+    assert s["tokens"]["tokens_generated"] == 8 * len(prompts)
+
+
+def test_single_request_sync_infer(net):
+    p = _prompts((6,), seed=3)[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 5,
+                       temperature=0).asnumpy()[0]
+    with _engine(net) as eng:
+        out = eng.infer(p, max_new_tokens=5)
+    onp.testing.assert_array_equal(ref, out)
+    assert out.dtype == onp.int32
+
+
+def test_eos_stops_generation_early(net):
+    p = _prompts((6,), seed=4)[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                       temperature=0).asnumpy()[0]
+    gen = ref[len(p):]
+    eos = int(gen[2])                # a token greedy decoding DOES emit
+    stop_at = int(onp.argmax(gen == eos))    # first occurrence
+    with _engine(net) as eng:
+        out = eng.infer(p, max_new_tokens=8, eos_id=eos)
+    assert len(out) == len(p) + stop_at + 1 and out[-1] == eos
+    onp.testing.assert_array_equal(ref[:len(out)], out)
+
+
+# ------------------------------------------------------------- edge cases
+
+def test_queue_overflow_sheds(net):
+    eng = _engine(net, queue_depth=3)       # NOT started: queue only fills
+    p = _prompts((4,), seed=5)[0]
+    futs = [eng.submit(p) for _ in range(3)]
+    with pytest.raises(QueueFullError):
+        eng.submit(p)
+    s = eng.stats()
+    assert s["requests"]["rejected_queue_full"] == 1
+    assert s["requests"]["submitted"] == 4
+    eng.stop(drain=False)                   # sheds the queued three
+    for f in futs:
+        with pytest.raises(EngineStoppedError):
+            f.result(timeout=5)
+
+
+def test_request_timeout_in_queue(net):
+    eng = _engine(net)                       # not yet started
+    p = _prompts((4,), seed=6)[0]
+    fut = eng.submit(p, timeout=0.01)
+    ok = eng.submit(p, max_new_tokens=2)     # no deadline — must survive
+    time.sleep(0.05)
+    with eng.start():
+        with pytest.raises(RequestTimeoutError):
+            fut.result(timeout=60)
+        assert len(ok.result(timeout=120)) == len(p) + 2
+    assert eng.stats()["requests"]["timeouts"] == 1
+
+
+def test_invalid_requests_rejected(net):
+    eng = _engine(net)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(onp.arange(17, dtype="int32"))        # > largest bucket
+    with pytest.raises(InvalidRequestError):
+        eng.submit(onp.arange(16, dtype="int32"),
+                   max_new_tokens=64)                     # KV overflow
+    with pytest.raises(InvalidRequestError):
+        eng.submit(onp.zeros((0,), "int32"))
+    with pytest.raises(InvalidRequestError):
+        eng.submit(onp.zeros((2, 8), "int32"))   # a BATCH is not a prompt
+    with pytest.raises(InvalidRequestError):
+        eng.submit(onp.arange(4, dtype="int32"),
+                   max_new_tokens=0)     # explicit 0 is an error, not default
+    with pytest.raises(ValueError):
+        _engine(net, max_length=128)     # beyond the net's position table
+    assert eng.stats()["requests"]["rejected_invalid"] == 5
+
+
+def test_mixed_length_prompts_share_buckets(net):
+    """Prompts landing in different buckets batch independently and all
+    complete; per-bucket padding is accounted."""
+    prompts = _prompts((2, 3, 15, 16, 8, 4), seed=7)
+    with _engine(net) as eng:
+        outs = [f.result(timeout=120)
+                for f in [eng.submit(p, max_new_tokens=4) for p in prompts]]
+    for p, o in zip(prompts, outs):
+        assert len(o) == len(p) + 4
+        onp.testing.assert_array_equal(o[:len(p)], p)
+    s = eng.stats()
+    assert s["requests"]["completed"] == 6
+    assert s["tokens"]["prompt_tokens"] == sum(len(p) for p in prompts)
+    assert s["tokens"]["padded_tokens"] > 0
+
+
+def test_shutdown_drains_cleanly(net):
+    prompts = _prompts((5, 9, 3, 6, 11, 2), seed=8)
+    eng = _engine(net).start()
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.stop(drain=True, timeout=300)        # returns only once drained
+    for p, f in zip(prompts, futs):
+        out = f.result(timeout=1)            # must already be done
+        assert len(out) == len(p) + 6
+    with pytest.raises(EngineStoppedError):
+        eng.submit(prompts[0])
+    from mxnet_tpu.serving import ServingError
+    with pytest.raises(ServingError):
+        eng.start()                          # no restart: build a new one
+
+
+# ------------------------------------------------------------ forward path
+
+def test_forward_mode_batching_parity(net):
+    from mxnet_tpu.gluon import nn
+    dense = nn.Dense(8, in_units=16)
+    dense.initialize()
+    xs = onp.random.RandomState(9).randn(5, 16).astype("float32")
+    ref = dense(mx.nd.array(xs)).asnumpy()
+    eng = InferenceEngine(dense, max_batch=4)
+    assert eng.mode == "forward"
+    n_warm = eng.warmup(example_shape=(16,))
+    assert n_warm == len(eng.lattice.batch_buckets)
+    with eng:
+        outs = [f.result(timeout=60) for f in
+                [eng.submit(x) for x in xs]]
+    onp.testing.assert_allclose(onp.stack(outs), ref, rtol=1e-5, atol=1e-6)
+    s = eng.stats()
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["requests"]["completed"] == 5
+
+
+# ------------------------------------------------------- component units
+
+def test_bucket_lattice_rounding():
+    lat = BucketLattice(batch_buckets=(1, 2, 4), seq_buckets=(8, 32))
+    assert lat.batch(1) == 1 and lat.batch(3) == 4
+    assert lat.seq(5) == 8 and lat.seq(9) == 32
+    with pytest.raises(ValueError):
+        lat.seq(33)
+    assert len(lat) == 6
+    assert len(lat.prefill_points()) == 6
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in (1, 2, 3, 4, 100):
+        h.observe(ms / 1e3)
+    s = h.summary()
+    assert s["count"] == 5
+    assert 0.5 < s["p50_ms"] < 5
+    assert s["p99_ms"] <= s["max_ms"] * 1.001
+    assert h.percentile(0) <= h.percentile(99.9)
