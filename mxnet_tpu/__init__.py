@@ -83,6 +83,7 @@ _LAZY = {
     "recordio": ".recordio",
     "runtime": ".runtime",
     "serving": ".serving",
+    "resilience": ".resilience",
     "test_utils": ".test_utils",
     "np": ".numpy",
     "npx": ".numpy_extension",

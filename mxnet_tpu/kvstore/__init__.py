@@ -26,6 +26,7 @@ import numpy as onp
 
 from .. import base as _base
 from ..ndarray import NDArray
+from ..resilience.faults import inject as _inject
 
 __all__ = ["KVStore", "KVStoreBase", "create"]
 
@@ -137,6 +138,7 @@ class KVStore(KVStoreBase):
         return q
 
     def push(self, key, value, priority=0):
+        _inject("kvstore.push")
         from ..ndarray.sparse import RowSparseNDArray, _RowSparseCot
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
@@ -180,6 +182,7 @@ class KVStore(KVStoreBase):
                 self._store[k]._rebind(agg)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        _inject("kvstore.pull")
         keys, outs = _normalize(key, out)
         for k, o in zip(keys, outs):
             targets = o if isinstance(o, list) else [o]

@@ -126,13 +126,25 @@ class NDArray:
 
     # ------------------------------------------------------------- transfers
     def asnumpy(self) -> onp.ndarray:
-        """Synchronizing device→host copy (MXNet's WaitToRead + copy)."""
+        """Synchronizing device→host copy (MXNet's WaitToRead + copy).
+
+        Always returns an OWNED, writable array.  On the CPU backend
+        ``np.asarray(jax_array)`` is a zero-copy read-only view of the
+        device buffer — and XLA donation (``ShardedTrainer(donate=True)``,
+        the serving cache) reuses that memory without regard for live
+        numpy views, so a supposedly-snapshotted value would silently
+        change under the caller.  The MXNet contract is a copy; pay the
+        memcpy (TPU's device→host transfer already owns its buffer, so
+        nothing is copied twice)."""
         v = self.jax
         if isinstance(v, jax.core.Tracer):
             raise _base.MXNetError(
                 "asnumpy() called inside a hybridized/jitted trace; this "
                 "graph-breaks. Use .item()/asnumpy() outside hybridize.")
-        return onp.asarray(v)
+        a = onp.asarray(v)
+        if a.base is not None or not a.flags.writeable:
+            a = onp.array(a)
+        return a
 
     def asscalar(self):
         if self.size != 1:

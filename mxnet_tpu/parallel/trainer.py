@@ -22,6 +22,7 @@ from .. import base as _base
 from .. import optimizer as opt_mod
 from .. import random as _random
 from ..ndarray import NDArray
+from ..resilience.faults import inject as _inject
 from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
 from .sharding import (ShardingRules, batch_spec, logical_axes_of,
@@ -376,16 +377,37 @@ class ShardedTrainer:
         self._batch_shardings = data_sh + label_sh
         scalar = ns(P())
 
+        # donate on accelerators only: on CPU-XLA donation buys nothing
+        # (host memory, no in-place MXU update) and combined with the
+        # persistent compilation cache it corrupts the heap on cache
+        # HITS — deserialized executables mis-handle the aliased
+        # buffers (observed: NaN params, GC-time segfaults).  Same
+        # gating the serving engine applies to its KV cache donation.
+        donate = self._donate and jax.default_backend() != "cpu"
         self._step_fn = jax.jit(
             pure,
             in_shardings=(param_sh, aux_sh, state_sh, data_sh + label_sh,
                           scalar, scalar, scalar),
             out_shardings=(scalar, param_sh, aux_sh, state_sh),
-            donate_argnums=(0, 1, 2) if self._donate else ())
+            donate_argnums=(0, 1, 2) if donate else ())
 
     # ------------------------------------------------------------------
+    def build(self, data, labels=()):
+        """Settle shapes, shard params and compile WITHOUT stepping —
+        params are untouched, so a resume can restore a checkpoint into
+        a freshly built trainer before any optimizer update runs
+        (ResilientLoop's resume path)."""
+        if not isinstance(data, (tuple, list)):
+            data = (data,)
+        if not isinstance(labels, (tuple, list)):
+            labels = (labels,)
+        if not self._built:
+            self._build(data, labels)
+        return self
+
     def step(self, data, labels=()) -> NDArray:
         """Run one full training step; returns the (replicated) loss."""
+        _inject("trainer.step")
         if not isinstance(data, (tuple, list)):
             data = (data,)
         if not isinstance(labels, (tuple, list)):
@@ -453,6 +475,81 @@ class ShardedTrainer:
             self._pending_states = loaded
             return
         self._apply_loaded_states(loaded)
+
+    # ------------------------------------------------------- flat state dict
+    def state_dict(self) -> Dict[str, NDArray]:
+        """The trainer's whole restorable state as a FLAT ``{key:
+        NDArray}`` dict (params, aux, optimizer-state leaves, step
+        counter) — the unit :class:`~mxnet_tpu.resilience.ResilientLoop`
+        commits through its atomic checkpointer and the portable
+        counterpart of the orbax tree in :meth:`save_checkpoint`.
+
+        Keys are POSITIONAL (``param:0``, ``aux:0``, ``state:0``):
+        parameter *names* carry a process-global counter, so a resumed
+        process (whose fresh net may count from a different base) could
+        never match them; collection order is deterministic for a given
+        model, which is exactly the resume contract.  Shapes are
+        verified on load."""
+        from ..ndarray import array as _nd_array
+        if not self._built:
+            raise _base.MXNetError(
+                "state_dict before build: run build()/step() first so "
+                "params and optimizer states exist")
+        out: Dict[str, NDArray] = {
+            "meta:num_update": _nd_array([self.optimizer.num_update],
+                                         dtype="int64")}
+        for i, (_n, p) in enumerate(self._trainable):
+            out[f"param:{i}"] = p._data
+        for i, (_n, p) in enumerate(self._aux):
+            out[f"aux:{i}"] = p._data
+        for i, l in enumerate(self._state_flat):
+            out[f"state:{i}"] = l
+        return out
+
+    def load_state_dict(self, d: Dict[str, NDArray]):
+        """Inverse of :meth:`state_dict`: rebind every leaf onto its live
+        mesh sharding.  Missing keys or mismatched shapes are an error
+        (a foreign/corrupt checkpoint — refuse, don't half-load)."""
+        if not self._built:
+            raise _base.MXNetError(
+                "load_state_dict needs the trainer built — call "
+                "build() on example data first (shapes/shardings "
+                "must exist)")
+        want = ([f"param:{i}" for i in range(len(self._trainable))]
+                + [f"aux:{i}" for i in range(len(self._aux))]
+                + [f"state:{i}" for i in range(len(self._state_flat))]
+                + ["meta:num_update"])
+        missing = [k for k in want if k not in d]
+        if missing:
+            raise _base.MXNetError(
+                f"state dict is missing {len(missing)} keys "
+                f"(e.g. {missing[:3]}) — not a checkpoint of this "
+                "trainer/model")
+
+        def _check(key, have, want_shape, name):
+            if tuple(have.shape) != tuple(want_shape):
+                raise _base.MXNetError(
+                    f"state dict {key} ({name}) has shape "
+                    f"{tuple(have.shape)}, expected {tuple(want_shape)} "
+                    "— checkpoint of a different model")
+
+        for i, (n, p) in enumerate(self._trainable):
+            _check(f"param:{i}", d[f"param:{i}"], p.shape, n)
+        for i, (n, p) in enumerate(self._aux):
+            _check(f"aux:{i}", d[f"aux:{i}"], p.shape, n)
+        for i, l in enumerate(self._state_flat):
+            _check(f"state:{i}", d[f"state:{i}"], l.shape, "opt state")
+        for i, (_n, p) in enumerate(self._trainable):
+            sh = NamedSharding(self.mesh, self.rules.spec(logical_axes_of(p)))
+            p._data._rebind(_mesh_device_put(d[f"param:{i}"].jax, sh))
+        for i, (_n, p) in enumerate(self._aux):
+            sh = NamedSharding(self.mesh, self.rules.spec(logical_axes_of(p)))
+            p._data._rebind(_mesh_device_put(d[f"aux:{i}"].jax, sh))
+        for i, l in enumerate(self._state_flat):
+            l._rebind(_mesh_device_put(d[f"state:{i}"].jax,
+                                       self._state_shardings[i]))
+        self.optimizer.num_update = int(
+            d["meta:num_update"].asnumpy()[0])
 
     # -------------------------------------------------- sharded checkpoints
     def _checkpoint_tree(self):

@@ -1,0 +1,45 @@
+"""``mxnet_tpu.resilience`` — fault injection, preemption-safe training,
+and the liveness machinery behind the hardened serving engine.
+
+Three coupled layers (docs/resilience.md has the cookbook):
+
+1. :mod:`~mxnet_tpu.resilience.faults` — a seeded, context-scoped
+   :class:`FaultPlan` whose injection sites are threaded through the hot
+   paths (serving decode/forward cycles, ``ShardedTrainer.step``,
+   checkpoint save/restore, kvstore push/pull).  Zero-cost when
+   disabled.
+2. :class:`ResilientLoop` + :class:`AtomicCheckpointer` — training that
+   a kill at any instant cannot corrupt and a fresh process resumes
+   deterministically (same data offset, same per-step RNG).
+3. :class:`Watchdog` — the generic dead/hung-thread detector the serving
+   engine uses to fail stranded requests with ``EngineCrashedError``
+   instead of hanging callers.
+
+The faults layer is imported eagerly (hot paths need ``inject`` at
+module import); the heavier layers load lazily.
+"""
+from .faults import (FaultPlan, FaultSpec, InjectedFault, RetryableFault,
+                     SimulatedPreemption, active_plan, inject)
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
+    "SimulatedPreemption", "active_plan", "inject",
+    "AtomicCheckpointer", "ResilientLoop", "Watchdog",
+]
+
+_LAZY = {
+    "AtomicCheckpointer": ".checkpoint",
+    "ResilientLoop": ".loop",
+    "Watchdog": ".watchdog",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(
+        f"module 'mxnet_tpu.resilience' has no attribute {name!r}")

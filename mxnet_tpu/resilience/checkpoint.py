@@ -1,0 +1,168 @@
+"""Crash-atomic step checkpoints: tmp-dir write + rename commit.
+
+The orbax-backed :mod:`mxnet_tpu.utils.checkpoint` is the pod-scale
+async path; this module is the *resilience* path — a synchronous,
+self-contained format whose commit point is a single ``os.rename`` of a
+fully written temp directory, so a kill at ANY instant of a save leaves
+either the previous committed checkpoint or the new one, never a torn
+"latest":
+
+1. leaves are serialized into ``<dir>/.tmp-<step>-<pid>/state.mxtpu``
+   (the dmlc-container-parity format of
+   :mod:`mxnet_tpu.utils.serialization`, itself written atomically) plus
+   a small ``meta.json``;
+2. the temp dir is renamed to ``<dir>/step-<NNNNNNNN>`` — POSIX-atomic;
+   the injection site ``"checkpoint.commit"`` sits right before this
+   rename, so chaos tests can kill mid-save and prove nothing corrupts;
+3. ``latest_step()`` only ever sees fully renamed directories; stale
+   ``.tmp-*`` dirs from killed saves are swept on construction.
+
+There is deliberately NO separate "latest" marker file: the set of
+committed directories IS the source of truth, so no ordering bug between
+"write data" and "write marker" can exist.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from .faults import inject
+
+__all__ = ["AtomicCheckpointer"]
+
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = ".tmp-"
+_STATE_FILE = "state.mxtpu"
+_META_FILE = "meta.json"
+
+
+class AtomicCheckpointer:
+    """Commit-or-nothing step checkpoints under one directory.
+
+    ``save(step, tree)`` takes a flat ``{name: NDArray}`` dict (see
+    ``ShardedTrainer.state_dict()``); ``restore(step=None)`` returns
+    ``(tree, meta)`` for the requested or latest committed step.
+    ``max_to_keep`` garbage-collects oldest committed steps AFTER each
+    successful commit (never before — a failed save must not eat the
+    fallback).
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        self.directory = os.path.abspath(str(directory))
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+        self._sweep_tmp()
+
+    # ----------------------------------------------------------- inventory
+    def _sweep_tmp(self):
+        for name in os.listdir(self.directory):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            if name.startswith(_TMP_PREFIX + "old-"):
+                # a re-commit moved a COMMITTED step aside and was killed
+                # before finishing: if the step dir is gone, the aside
+                # copy is the only committed state — recover it
+                try:
+                    step = int(name[len(_TMP_PREFIX + "old-"):].split("-")[0])
+                except ValueError:
+                    step = None
+                if step is not None and not os.path.isdir(
+                        self._step_dir(step)):
+                    os.rename(path, self._step_dir(step))
+                    continue
+            shutil.rmtree(path, ignore_errors=True)
+
+    def all_steps(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    out.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
+
+    # ---------------------------------------------------------------- save
+    def save(self, step: int, tree: Dict[str, Any],
+             meta: Optional[dict] = None) -> str:
+        """Write and atomically commit one step.  Returns the committed
+        path.  Re-committing an existing step replaces it (the
+        resume-replays-a-step case; earlier steps stay as fallback)."""
+        from ..utils.serialization import save as _save
+
+        inject("checkpoint.save")
+        step = int(step)
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{step:08d}-{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        _save(os.path.join(tmp, _STATE_FILE), dict(tree))
+        with open(os.path.join(tmp, _META_FILE), "w") as f:
+            json.dump({"step": step, **(meta or {})}, f)
+        inject("checkpoint.commit")
+        final = self._step_dir(step)
+        aside = None
+        if os.path.exists(final):
+            # re-committing an existing step: move the old dir ASIDE
+            # (rename, not delete) so a kill between here and the commit
+            # rename still leaves one committed copy of this step —
+            # .old- dirs are swept with the tmp dirs on construction
+            aside = os.path.join(self.directory,
+                                 f"{_TMP_PREFIX}old-{step:08d}-{os.getpid()}")
+            shutil.rmtree(aside, ignore_errors=True)
+            os.rename(final, aside)
+        try:
+            os.rename(tmp, final)      # THE commit point
+        except BaseException:
+            if aside is not None and not os.path.exists(final):
+                os.rename(aside, final)    # roll the old commit back in
+                aside = None
+            raise
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+        self._gc()
+        return final
+
+    def _gc(self):
+        if self.max_to_keep is None:
+            return
+        steps = self.all_steps()
+        for s in steps[:max(0, len(steps) - self.max_to_keep)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    # ------------------------------------------------------------- restore
+    def restore(self, step: Optional[int] = None) \
+            -> Tuple[Dict[str, Any], dict]:
+        from ..utils.serialization import load as _load
+
+        inject("checkpoint.restore")
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise MXNetError(
+                f"no checkpoint found under {self.directory} "
+                f"(all_steps={self.all_steps()})")
+        path = self._step_dir(int(step))
+        if not os.path.isdir(path):
+            raise MXNetError(
+                f"no checkpoint for step {step} under {self.directory} "
+                f"(all_steps={self.all_steps()})")
+        tree = _load(os.path.join(path, _STATE_FILE))
+        with open(os.path.join(path, _META_FILE)) as f:
+            meta = json.load(f)
+        return tree, meta
+
+    def __repr__(self):
+        return (f"AtomicCheckpointer({self.directory!r}, "
+                f"steps={self.all_steps()})")

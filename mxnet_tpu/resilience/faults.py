@@ -1,0 +1,242 @@
+"""Deterministic fault injection for chaos testing.
+
+The rest of the codebase calls :func:`inject(site)` at named *injection
+sites* on its hot paths (``"serving.decode_step"``, ``"trainer.step"``,
+``"checkpoint.save"``, ``"kvstore.push"``, …).  With no plan active that
+call is one module-global load plus a ``None`` check — provably in the
+noise of any step that launches an XLA program.  Inside a
+``with FaultPlan(...):`` block each call counts a *hit* per site and
+fires whatever the plan registered for that hit:
+
+- ``raise_at``  — raise an exception (:class:`InjectedFault` by default;
+  pass ``retryable=True`` for :class:`RetryableFault`, which the serving
+  engine and :class:`~mxnet_tpu.resilience.ResilientLoop` treat as
+  transient and retry with bounded backoff);
+- ``delay_at``  — sleep, simulating a slow or hung step (what a
+  serving watchdog must detect);
+- ``kill_at``   — raise :class:`SimulatedPreemption`, a ``BaseException``
+  that models SIGKILL/host preemption: generic ``except Exception``
+  recovery must NOT swallow it;
+- ``call_at``   — run an arbitrary callback (e.g. ``os.kill(os.getpid(),
+  SIGTERM)`` to exercise a real signal path at a deterministic step).
+
+Firing is deterministic: ``at=N`` fires on the Nth hit of the site
+(1-based), ``every=K`` on every Kth, and ``prob=p`` draws from a
+``random.Random(seed)`` owned by the plan — the same seed always yields
+the same fault schedule.  Plans are context-manager scoped and
+process-global (the serving scheduler thread must see the plan the test
+thread activated); nesting raises.  ``plan.log`` records every fired
+fault as ``(site, hit, action)`` so tests and
+``tools/chaos_sweep.py`` can assert the schedule actually executed.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
+           "SimulatedPreemption", "inject", "active_plan"]
+
+
+class InjectedFault(MXNetError):
+    """An exception raised on purpose by an active :class:`FaultPlan`."""
+
+
+class RetryableFault(InjectedFault):
+    """A transient injected failure: retry-with-backoff is the correct
+    response (the serving engine and ResilientLoop both honor it)."""
+
+
+class SimulatedPreemption(BaseException):
+    """Models abrupt process death (host preemption, SIGKILL, OOM-kill).
+
+    Deliberately a ``BaseException``: recovery code that catches plain
+    ``Exception`` must not be able to "survive" a kill — only a fresh
+    process (or the test harness standing in for one) resumes from the
+    last committed checkpoint.
+    """
+
+
+class FaultSpec:
+    """One registered fault: where, when, and what."""
+
+    __slots__ = ("site", "action", "at", "every", "prob", "exc", "seconds",
+                 "fn", "max_fires", "fires")
+
+    def __init__(self, site: str, action: str, *, at: Optional[int] = None,
+                 every: Optional[int] = None, prob: Optional[float] = None,
+                 exc: Optional[BaseException] = None, seconds: float = 0.0,
+                 fn: Optional[Callable] = None,
+                 max_fires: Optional[int] = None):
+        if action not in ("raise", "delay", "kill", "call"):
+            raise ValueError(f"unknown fault action {action!r}")
+        if sum(x is not None for x in (at, every, prob)) != 1:
+            raise ValueError("exactly one of at=/every=/prob= must be set")
+        self.site = site
+        self.action = action
+        self.at = at
+        self.every = every
+        self.prob = prob
+        self.exc = exc
+        self.seconds = seconds
+        self.fn = fn
+        # `at` fires once by definition; recurring triggers default unbounded
+        self.max_fires = 1 if at is not None and max_fires is None \
+            else max_fires
+        self.fires = 0
+
+    def should_fire(self, hit: int, rng: _pyrandom.Random) -> bool:
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.at is not None:
+            return hit == self.at
+        if self.every is not None:
+            return hit % self.every == 0
+        return rng.random() < self.prob
+
+    def __repr__(self):
+        when = (f"at={self.at}" if self.at is not None else
+                f"every={self.every}" if self.every is not None else
+                f"prob={self.prob}")
+        return f"FaultSpec({self.site!r}, {self.action}, {when})"
+
+
+# The one active plan.  Written only under _PLAN_LOCK; read lock-free on
+# the hot path (a torn read is impossible for a single reference).
+_ACTIVE: Optional["FaultPlan"] = None
+_PLAN_LOCK = threading.Lock()
+
+
+class FaultPlan:
+    """A seeded, scoped schedule of faults across injection sites.
+
+    Builder methods chain::
+
+        plan = (FaultPlan(seed=7)
+                .kill_at("trainer.step", at=3)
+                .raise_at("serving.decode_step", at=2, retryable=True)
+                .delay_at("serving.forward", every=10, seconds=0.5))
+        with plan:
+            ...   # faults fire; plan.log records them
+
+    Hit counters live on the plan, so a plan that stays active across a
+    kill/resume cycle keeps counting — "kill at hits 3, 7 and 10" lands
+    on three *distinct* steps even though the killed step is replayed.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = _pyrandom.Random(self.seed)
+        self._lock = threading.Lock()
+        self.specs: List[FaultSpec] = []
+        self.hits: dict = {}
+        self.log: List[Tuple[str, int, str]] = []
+
+    # ------------------------------------------------------------- builders
+    def raise_at(self, site: str, *, at: Optional[int] = None,
+                 every: Optional[int] = None, prob: Optional[float] = None,
+                 exc: Optional[BaseException] = None,
+                 retryable: bool = False,
+                 max_fires: Optional[int] = None) -> "FaultPlan":
+        if exc is None:
+            cls = RetryableFault if retryable else InjectedFault
+            exc = cls(f"injected fault at {site}")
+        self.specs.append(FaultSpec(site, "raise", at=at, every=every,
+                                    prob=prob, exc=exc,
+                                    max_fires=max_fires))
+        return self
+
+    def delay_at(self, site: str, seconds: float, *,
+                 at: Optional[int] = None, every: Optional[int] = None,
+                 prob: Optional[float] = None,
+                 max_fires: Optional[int] = None) -> "FaultPlan":
+        self.specs.append(FaultSpec(site, "delay", at=at, every=every,
+                                    prob=prob, seconds=float(seconds),
+                                    max_fires=max_fires))
+        return self
+
+    def kill_at(self, site: str, *, at: Optional[int] = None,
+                every: Optional[int] = None, prob: Optional[float] = None,
+                max_fires: Optional[int] = None) -> "FaultPlan":
+        self.specs.append(FaultSpec(site, "kill", at=at, every=every,
+                                    prob=prob, max_fires=max_fires))
+        return self
+
+    def call_at(self, site: str, fn: Callable, *, at: Optional[int] = None,
+                every: Optional[int] = None, prob: Optional[float] = None,
+                max_fires: Optional[int] = None) -> "FaultPlan":
+        self.specs.append(FaultSpec(site, "call", at=at, every=every,
+                                    prob=prob, fn=fn, max_fires=max_fires))
+        return self
+
+    # -------------------------------------------------------------- firing
+    def fire(self, site: str):
+        """Count a hit at ``site`` and execute whatever is due.  Called
+        from :func:`inject`; any thread."""
+        with self._lock:
+            hit = self.hits.get(site, 0) + 1
+            self.hits[site] = hit
+            due = [s for s in self.specs
+                   if s.site == site and s.should_fire(hit, self._rng)]
+            for s in due:
+                s.fires += 1
+                self.log.append((site, hit, s.action))
+        # act OUTSIDE the lock: a delay must not serialize other sites,
+        # and a raised fault must not leave the plan lock held
+        for s in due:
+            if s.action == "delay":
+                time.sleep(s.seconds)
+            elif s.action == "call":
+                s.fn()
+            elif s.action == "kill":
+                raise SimulatedPreemption(
+                    f"simulated preemption at {site} (hit {hit})")
+            else:
+                # a FRESH instance per fire: raising the same object from
+                # recurring specs (every=/prob=) would share mutable
+                # __traceback__/__context__ across fires and threads
+                try:
+                    exc = type(s.exc)(*s.exc.args)
+                except Exception:
+                    exc = s.exc
+                raise exc
+
+    # -------------------------------------------------------------- scoping
+    def __enter__(self) -> "FaultPlan":
+        global _ACTIVE
+        with _PLAN_LOCK:
+            if _ACTIVE is not None:
+                raise MXNetError("a FaultPlan is already active — plans "
+                                 "are process-global and do not nest")
+            _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        with _PLAN_LOCK:
+            _ACTIVE = None
+
+    def fired(self, site: Optional[str] = None) -> int:
+        """How many faults fired (optionally at one site)."""
+        return len([e for e in self.log if site is None or e[0] == site])
+
+    def __repr__(self):
+        return (f"FaultPlan(seed={self.seed}, specs={len(self.specs)}, "
+                f"fired={len(self.log)})")
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def inject(site: str) -> None:
+    """Injection-site hook.  Zero-cost when no plan is active: one global
+    load and a None check — keep this the ONLY code on the disabled
+    path."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(site)

@@ -1,0 +1,253 @@
+"""Preemption-safe training: checkpoint, die, resume, converge anyway.
+
+:class:`ResilientLoop` wraps a trainer (``ShardedTrainer`` natively; any
+object with ``step(data, labels)`` + ``state_dict()`` /
+``load_state_dict()`` works) and turns "run N steps" into a contract
+that survives the failure modes routine on preemptible TPU pods:
+
+- **atomic checkpoints** every ``save_every`` steps through
+  :class:`~mxnet_tpu.resilience.checkpoint.AtomicCheckpointer` — a kill
+  mid-save can never corrupt the previous committed state;
+- **automatic resume**: a fresh ``run()`` finds ``latest_step()``,
+  rebuilds the trainer on the first batch's shapes, restores
+  params/optimizer-state/num_update, and *replays the data iterator* to
+  the committed offset, so the resumed run consumes exactly the batches
+  the dead run would have;
+- **per-step reseeding**: before every step (and every retry of it) the
+  global RNG is reseeded from ``(seed, step)``, so a replayed step draws
+  the same dropout/shuffle keys as the fault-free run — this is what
+  makes kill-K-times-resume-K-times produce bit-identical parameters on
+  CPU (the chaos-determinism acceptance test);
+- **bounded retry with backoff** around transient step failures
+  (:class:`~mxnet_tpu.resilience.faults.RetryableFault` by default);
+  :class:`~mxnet_tpu.resilience.faults.SimulatedPreemption` and other
+  ``BaseException`` kills are never retried — they propagate, like real
+  process death;
+- **SIGTERM = preemption notice**: on the standard preemption signal the
+  loop finishes the in-flight step, commits a final checkpoint, and
+  returns with ``report["preempted"] = True`` instead of dying dirty.
+
+Counters (``checkpoint_commits``, ``resumes``, ``retries``) land in a
+:class:`~mxnet_tpu.serving.metrics.ServingMetrics` instance so training
+and serving resilience export through one stats surface.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+from .. import base as _base
+from .. import random as _random
+from .checkpoint import AtomicCheckpointer
+from .faults import RetryableFault
+
+__all__ = ["ResilientLoop"]
+
+
+def _normalize_batch(batch) -> Tuple[tuple, tuple]:
+    """Accept (data, labels) with each side an NDArray or tuple/list."""
+    if not (isinstance(batch, (tuple, list)) and len(batch) == 2):
+        raise _base.MXNetError(
+            "ResilientLoop batches must be (data, labels) pairs "
+            f"(got {type(batch).__name__})")
+    data, labels = batch
+    if not isinstance(data, (tuple, list)):
+        data = (data,)
+    if not isinstance(labels, (tuple, list)):
+        labels = (labels,)
+    return tuple(data), tuple(labels)
+
+
+class ResilientLoop:
+    """Drive ``trainer`` for ``steps`` steps, surviving kills.
+
+    Parameters
+    ----------
+    trainer : ShardedTrainer-like — needs ``step(data, labels)``,
+        ``state_dict()``, ``load_state_dict(d)``; ``build(data, labels)``
+        is used when present so a resume can restore state before any
+        optimizer step runs.
+    directory : checkpoint directory (one run = one directory).
+    save_every : commit a checkpoint every N completed steps (the final
+        step always commits).  Smaller = less recomputation after a
+        kill, more write traffic.
+    max_to_keep : GC bound on committed checkpoints.
+    max_retries : per-step budget for retryable failures.
+    backoff / backoff_factor : sleep before retry k is
+        ``backoff * backoff_factor**k``.
+    seed : base of the per-step reseed; ``None`` disables reseeding
+        (resumed runs then draw different randomness — convergence
+        still holds, determinism doesn't).
+    retryable : exception classes worth retrying (transient infra
+        faults); anything else propagates immediately.
+    """
+
+    def __init__(self, trainer, directory, *, save_every: int = 1,
+                 max_to_keep: Optional[int] = 5, max_retries: int = 3,
+                 backoff: float = 0.05, backoff_factor: float = 2.0,
+                 seed: Optional[int] = 0,
+                 retryable: tuple = (RetryableFault,), metrics=None):
+        if save_every < 1:
+            raise _base.MXNetError(
+                f"save_every must be >= 1, got {save_every}")
+        self.trainer = trainer
+        self.checkpointer = AtomicCheckpointer(directory,
+                                               max_to_keep=max_to_keep)
+        self.save_every = int(save_every)
+        self.max_retries = int(max_retries)
+        self.backoff = float(backoff)
+        self.backoff_factor = float(backoff_factor)
+        self.seed = seed
+        self.retryable = tuple(retryable)
+        if metrics is None:
+            from ..serving.metrics import ServingMetrics
+            metrics = ServingMetrics("resilience")
+        self.metrics = metrics
+        self._stop_requested = False
+        self._prev_sigterm = None
+
+    # -------------------------------------------------------------- control
+    def request_stop(self):
+        """Ask the loop to checkpoint and return at the next step
+        boundary (what the SIGTERM handler calls)."""
+        self._stop_requested = True
+
+    def _install_sigterm(self):
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        try:
+            self._prev_sigterm = signal.signal(
+                signal.SIGTERM, lambda signum, frame: self.request_stop())
+            return True
+        except ValueError:       # no signal support in this context
+            return False
+
+    def _restore_sigterm(self, installed: bool):
+        if installed and self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+        self._prev_sigterm = None
+
+    # ------------------------------------------------------------ internals
+    def _reseed(self, step: int):
+        if self.seed is not None:
+            _random.seed((self.seed * 1_000_003 + step) & 0x7FFFFFFF)
+
+    def _ensure_built(self, data, labels):
+        # key off the presence of build(), not the private _built flag:
+        # a duck-typed trainer exposing build() but no _built attribute
+        # must still get built (build() is required to be idempotent —
+        # ShardedTrainer.build is)
+        tr = self.trainer
+        build = getattr(tr, "build", None)
+        if build is not None and getattr(tr, "_built", None) is not True:
+            build(data, labels)
+
+    def _commit(self, step: int, extra_meta: Optional[dict] = None) -> None:
+        sd = self.trainer.state_dict()
+        self.checkpointer.save(step, sd,
+                               meta={"seed": self.seed,
+                                     **(extra_meta or {})})
+        self.metrics.count("checkpoint_commits")
+
+    def _step_with_retry(self, step: int, data, labels):
+        delay = self.backoff
+        for attempt in range(self.max_retries + 1):
+            # reseed per ATTEMPT: a failed try must not have advanced the
+            # key counter a replay would then miss
+            self._reseed(step)
+            try:
+                return self.trainer.step(data, labels)
+            except self.retryable:
+                if attempt >= self.max_retries:
+                    raise
+                self.metrics.count("retries")
+                time.sleep(delay)
+                delay *= self.backoff_factor
+
+    # ------------------------------------------------------------------ run
+    def run(self, make_iter: Optional[Callable[[], Iterator]] = None,
+            steps: Optional[int] = None, *,
+            batch_fn: Optional[Callable[[int], Any]] = None) -> Dict:
+        """Run (or resume) the training loop.
+
+        ``make_iter``: zero-arg callable returning a FRESH iterator of
+        ``(data, labels)`` batches — called once per ``run()``; on
+        resume the first ``latest_step()`` batches are consumed and
+        discarded to replay the offset.  ``batch_fn(step)`` is the
+        random-access alternative (no replay cost).  ``steps`` is the
+        total global step count (not steps-remaining).
+
+        Returns a report dict: ``completed_steps``, ``resumed_from``,
+        ``preempted``, ``retries``, ``final_loss``.
+        """
+        if (make_iter is None) == (batch_fn is None):
+            raise _base.MXNetError(
+                "pass exactly one of make_iter= or batch_fn=")
+        if steps is None or steps < 0:
+            raise _base.MXNetError(f"steps must be >= 0, got {steps}")
+        report = {"completed_steps": 0, "resumed_from": None,
+                  "preempted": False, "retries": 0, "final_loss": None}
+        retries_before = self.metrics.counters.get("retries", 0)
+        start = 0
+        latest = self.checkpointer.latest_step()
+        if latest is not None:
+            # shapes must exist before state can land: build from the
+            # first batch of a throwaway iterator (offset untouched)
+            if batch_fn is not None:
+                probe = batch_fn(min(latest, max(steps - 1, 0)))
+            else:
+                probe = next(iter(make_iter()))
+            data, labels = _normalize_batch(probe)
+            self._ensure_built(data, labels)
+            tree, meta = self.checkpointer.restore(latest)
+            self.trainer.load_state_dict(tree)
+            start = int(meta.get("step", latest))
+            report["resumed_from"] = start
+            self.metrics.count("resumes")
+        it = iter(make_iter()) if make_iter is not None else None
+        if it is not None:
+            for i in range(start):       # replay the data-iterator offset
+                try:
+                    next(it)
+                except StopIteration:
+                    raise _base.MXNetError(
+                        f"resume replay failed: checkpoint is at step "
+                        f"{start} but make_iter() yielded only {i} "
+                        "batches — the iterator must cover GLOBAL steps, "
+                        "not steps-remaining") from None
+
+        self._stop_requested = False
+        installed = self._install_sigterm()
+        loss = None
+        try:
+            step = start
+            while step < steps:
+                batch = batch_fn(step) if batch_fn is not None else next(it)
+                data, labels = _normalize_batch(batch)
+                self._ensure_built(data, labels)
+                loss = self._step_with_retry(step, data, labels)
+                step += 1
+                # read the flag ONCE per boundary: a SIGTERM landing
+                # between a commit-check and a break-check must not
+                # break without committing — it is simply seen at the
+                # next boundary instead
+                stop_requested = self._stop_requested
+                if (step % self.save_every == 0 or step == steps
+                        or stop_requested):
+                    self._commit(step)
+                if stop_requested and step < steps:
+                    report["preempted"] = True
+                    break
+            report["completed_steps"] = step
+        finally:
+            self._restore_sigterm(installed)
+        if loss is not None:
+            try:
+                report["final_loss"] = float(loss.asnumpy())
+            except Exception:
+                report["final_loss"] = None
+        report["retries"] = \
+            self.metrics.counters.get("retries", 0) - retries_before
+        return report

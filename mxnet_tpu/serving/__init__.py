@@ -19,7 +19,8 @@ Quick start::
 """
 from .batcher import BucketLattice, DynamicBatcher
 from .engine import InferenceEngine, InferenceFuture, Request
-from .errors import (EngineStoppedError, InvalidRequestError, QueueFullError,
+from .errors import (DeadlineExceededError, EngineCrashedError,
+                     EngineStoppedError, InvalidRequestError, QueueFullError,
                      RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
@@ -30,5 +31,6 @@ __all__ = [
     "SlotAllocator", "SlotState",
     "LatencyHistogram", "ServingMetrics",
     "ServingError", "QueueFullError", "RequestTimeoutError",
-    "EngineStoppedError", "InvalidRequestError",
+    "DeadlineExceededError", "EngineStoppedError", "EngineCrashedError",
+    "InvalidRequestError",
 ]

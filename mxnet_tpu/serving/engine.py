@@ -27,18 +27,34 @@ Backpressure: a bounded queue sheds at ``submit`` with
 while queued AND mid-generation.  ``stats()`` exposes latency
 percentiles, token counters and the bucket-hit/compile counters;
 scheduler batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
+
+Hardening (docs/resilience.md): a :class:`~mxnet_tpu.resilience.Watchdog`
+monitors the scheduler thread — if it dies, or (with ``hang_timeout``
+set) stops heartbeating while work is pending, every queued and
+in-flight request fails with :class:`EngineCrashedError` instead of
+hanging its caller, and the engine is condemned.  Transient step faults
+(:class:`~mxnet_tpu.resilience.RetryableFault`) are retried with a
+bounded per-request budget.  ``install_signal_handlers()`` turns SIGTERM
+into a graceful ``stop(drain=True)``.  ``health()`` is the
+liveness/readiness probe.  Fault-injection sites on the hot paths:
+``serving.scheduler`` (per cycle, outside the recovery net — a raise
+here IS a scheduler crash), ``serving.prefill``, ``serving.decode_step``
+and ``serving.forward`` (before each compiled call).
 """
 from __future__ import annotations
 
 import itertools
+import signal as _signal
 import threading
 import time
 from typing import Optional, Sequence
 
 import numpy as onp
 
+from ..resilience.faults import RetryableFault, inject as _inject
 from .batcher import BucketLattice, DynamicBatcher
-from .errors import (EngineStoppedError, InvalidRequestError, QueueFullError,
+from .errors import (EngineCrashedError, EngineStoppedError,
+                     InvalidRequestError, QueueFullError,
                      RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import ServingMetrics
@@ -81,12 +97,13 @@ class InferenceFuture:
 class Request:
     __slots__ = ("id", "kind", "payload", "prompt_len", "max_new_tokens",
                  "eos_id", "deadline", "future", "t_submit", "t_enqueue",
-                 "t_schedule", "shape_key")
+                 "t_schedule", "shape_key", "retries_left")
 
     _ids = itertools.count()
 
     def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
                  deadline=None):
+        self.retries_left = 0     # engine grants the budget at submit
         self.id = next(self._ids)
         self.kind = kind
         self.payload = payload
@@ -130,6 +147,14 @@ class InferenceEngine:
         powers of two up to ``max_batch`` / ``max_length``).
     eos_id : stop token for decode requests (overridable per submit).
     default_max_new_tokens : decode budget when ``submit`` omits it.
+    hang_timeout : seconds of stale scheduler heartbeat (with work
+        pending) before the watchdog condemns the engine.  ``None``
+        (default) disables hang detection; dead-thread detection is
+        always on while the engine runs.
+    watchdog_interval : watchdog poll period in seconds.
+    max_request_retries : per-request budget for retryable step faults
+        (transient infra errors / injected ``RetryableFault``).
+    retry_backoff : sleep before a step retry (doubles per attempt).
     """
 
     def __init__(self, net, mode: Optional[str] = None, *,
@@ -142,6 +167,10 @@ class InferenceEngine:
                  seq_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None,
                  default_max_new_tokens: int = 16,
+                 hang_timeout: Optional[float] = None,
+                 watchdog_interval: float = 0.1,
+                 max_request_retries: int = 2,
+                 retry_backoff: float = 0.01,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -185,10 +214,22 @@ class InferenceEngine:
                                          max_batch=self.max_batch)
             self._alloc = None
 
+        self.hang_timeout = hang_timeout
+        self.watchdog_interval = float(watchdog_interval)
+        self.max_request_retries = int(max_request_retries)
+        self.retry_backoff = float(retry_backoff)
         self._cond = threading.Condition()
         self._batcher = DynamicBatcher(queue_depth, cond=self._cond)
         self._step_lock = threading.Lock()
+        self._stop_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog = None
+        self._heartbeat: Optional[float] = None
+        self._compiling = False
+        self._cycle_busy = False
+        self._inflight_fwd = ()
+        self._crashed: Optional[BaseException] = None
+        self._prev_handlers = None
         self._stopping = False
         self._caches = None
         self._shape_seen = set()
@@ -244,14 +285,26 @@ class InferenceEngine:
 
     def _counted(self, key, fn, *args):
         """Run a compiled entry, tracking engine-level bucket hits vs
-        compiles (mirrors jax's per-shape executable cache)."""
+        compiles (mirrors jax's per-shape executable cache).  A first
+        call per key legitimately spends seconds-to-minutes in XLA
+        compilation, so the hang watchdog is suspended for its duration
+        (``_compiling``) — compile-time slowness must not condemn a
+        healthy engine."""
         if key in self._shape_seen:
             self.metrics.count("bucket_hits")
+            first = False
         else:
             self._shape_seen.add(key)
             self.metrics.count("compiles")
-        with self.metrics.span(key[0]):
-            return fn(*args)
+            first = True
+            self._compiling = True
+        try:
+            with self.metrics.span(key[0]):
+                return fn(*args)
+        finally:
+            if first:
+                self._compiling = False
+                self._heartbeat = time.monotonic()
 
     # ---------------------------------------------------------------- lifecycle
     def start(self):
@@ -260,41 +313,224 @@ class InferenceEngine:
         if self._batcher.closed:
             raise ServingError("engine cannot be restarted once stopped "
                                "— build a fresh InferenceEngine")
+        self._heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self._loop,
                                         name="mxnet_tpu-serving",
                                         daemon=True)
         self._thread.start()
+        from ..resilience.watchdog import Watchdog
+        self._watchdog = Watchdog(self._watchdog_check,
+                                  self._watchdog_trip,
+                                  interval=self.watchdog_interval,
+                                  name="mxnet_tpu-serving-watchdog")
+        self._watchdog.start()
         return self
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop the engine.  ``drain=True`` finishes everything queued
         and in flight first; ``drain=False`` fails pending AND in-flight
-        requests with :class:`EngineStoppedError` immediately."""
+        requests with :class:`EngineStoppedError` immediately.  Either
+        way NOTHING is silently dropped: any request still held once the
+        scheduler is down (crashed scheduler, request that slipped in
+        around the stop flag, engine never started) is failed with a
+        typed error.  Concurrent calls serialize (the SIGTERM handler
+        spawns a stop thread, which may race an explicit stop()); if a
+        bounded ``timeout`` expires mid-drain the engine is left RUNNING
+        (still draining, watchdog still guarding) and a ServingError is
+        raised."""
+        with self._stop_lock:
+            self._stop_locked(drain, timeout)
+
+    def _stop_locked(self, drain: bool, timeout: Optional[float]):
         self._batcher.close()
-        if not drain:
-            with self._step_lock:       # scheduler is between cycles here
+        if not drain and self._crashed is None:
+            # a HUNG scheduler holds _step_lock mid-step: a bounded
+            # acquire keeps stop() from deadlocking on it.  Futures are
+            # write-once, so failing them without the lock is safe; only
+            # the slot free is skipped (scheduler-owned state).
+            got = self._step_lock.acquire(timeout=1.0)
+            try:
                 exc = EngineStoppedError("engine stopped without drain")
                 for req in self._batcher.drain():
                     self._fail(req, exc)
-                if self._alloc is not None:
-                    for slot, st in list(self._alloc.items()):
-                        self._alloc.free(slot)
-                        self._fail(st.request, exc)
+                if got:
+                    if self._alloc is not None:
+                        for slot, st in list(self._alloc.items()):
+                            self._alloc.free(slot)
+                            self._fail(st.request, exc)
+                    for req in self._inflight_fwd:
+                        self._fail(req, exc)
+                else:
+                    # hung scheduler owns the lock: fail its riders via
+                    # the race-safe snapshot, leave allocator state alone
+                    for req in self._snapshot_inflight_requests():
+                        self._fail(req, exc)
+            finally:
+                if got:
+                    self._step_lock.release()
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
         t = self._thread
         if t is not None:
-            t.join(timeout)
-            if t.is_alive():
-                raise ServingError("scheduler thread failed to stop "
-                                   f"within {timeout}s")
-        else:
-            # never started: nothing can drain — fail whatever queued
-            exc = EngineStoppedError("engine stopped before starting")
-            for req in self._batcher.drain():
-                self._fail(req, exc)
+            if timeout is None:
+                # unbounded drain, but stay responsive to a watchdog
+                # condemnation landing mid-join (hung scheduler): once
+                # condemned, its futures are failed — grant a short
+                # grace, then give up on the (daemon) thread
+                while t.is_alive() and self._crashed is None:
+                    t.join(0.5)
+                if t.is_alive():
+                    t.join(2.0)
+            else:
+                t.join(timeout)
+            if t.is_alive() and self._crashed is None:
+                # still draining: leave thread + watchdog running (the
+                # queued futures WILL resolve), but release the signal
+                # handlers so the abandoned-engine path can't resurrect
+                self.uninstall_signal_handlers()
+                raise ServingError(
+                    f"scheduler thread still draining after {timeout}s — "
+                    "engine left running; call stop() again to keep "
+                    "waiting")
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        # sweep: whatever survived the drain must resolve, never drop
+        exc = self._crashed or EngineStoppedError(
+            "engine stopped — request was never scheduled")
+        for req in self._batcher.drain():
+            self._fail(req, exc)
+        if self._alloc is not None and (t is None or not t.is_alive()):
+            for slot, st in list(self._alloc.items()):
+                self._alloc.free(slot)
+                self._fail(st.request, exc)
         self._thread = None
+        self.uninstall_signal_handlers()
+
+    # ------------------------------------------------------------- watchdog
+    def _watchdog_check(self) -> Optional[str]:
+        if self._crashed is not None:
+            return None
+        t = self._thread
+        if t is None:
+            return None
+        if not t.is_alive():
+            # after a requested stop a dead thread is a NORMAL exit; a
+            # hang during the drain itself must still trip below, so
+            # _stopping only suppresses the died-check
+            return None if self._stopping else "scheduler thread died"
+        if self.hang_timeout is not None and self._heartbeat is not None \
+                and not self._compiling:
+            age = time.monotonic() - self._heartbeat
+            # _cycle_busy covers work that lives in NEITHER the queue
+            # nor the slot allocator: a forward batch is popped before
+            # the compiled call, so a hang there would otherwise look
+            # idle and strand the popped futures
+            busy = not self._batcher.empty() or self._cycle_busy or \
+                (self._alloc is not None and self._alloc.active_count > 0)
+            if busy and age > self.hang_timeout:
+                return (f"scheduler heartbeat stale for {age:.2f}s "
+                        f"(hang_timeout={self.hang_timeout}s) with work "
+                        "pending")
+        return None
+
+    def _snapshot_inflight_requests(self):
+        """Requests currently riding the scheduler, readable from OTHER
+        threads: slot leases plus a popped forward batch.  The allocator
+        is scheduler-owned, so iterating it here can race a live
+        mutation (RuntimeError) — retry over the tiny window; mutation
+        means the scheduler is alive and will resolve those futures
+        itself."""
+        fwd = list(self._inflight_fwd)
+        if self._alloc is None:
+            return fwd
+        for _ in range(10):
+            try:
+                return fwd + [st.request for _s, st in self._alloc.items()]
+            except RuntimeError:
+                time.sleep(0.005)
+        return fwd
+
+    def _watchdog_trip(self, reason: str):
+        """Condemn the engine: fail every queued and in-flight request so
+        no caller blocks forever.  Runs on the watchdog thread and must
+        not block on the (possibly hung) scheduler."""
+        exc = EngineCrashedError(
+            f"serving scheduler failed: {reason} — all pending requests "
+            "failed; build a fresh InferenceEngine")
+        self._crashed = exc
+        self.metrics.count("watchdog_trips")
+        self.metrics.mark("watchdog_trip")
+        self._batcher.close()
+        with self._cond:
+            self._stopping = True       # a recovered scheduler exits
+            self._cond.notify_all()
+        # futures are write-once and thread-safe: failing them here wins
+        # the race; a zombie scheduler completing later is a no-op.  The
+        # slot allocator stays untouched (scheduler-owned state).
+        for req in self._batcher.drain():
+            self._fail(req, exc)
+        for req in self._snapshot_inflight_requests():
+            self._fail(req, exc)
+
+    # ---------------------------------------------------------------- health
+    def health(self) -> dict:
+        """Liveness/readiness report for external probes.
+
+        ``live``: the scheduler thread exists, runs, and has not been
+        condemned.  ``ready``: live AND accepting new requests (not
+        stopping/stopped).  Counters mirror ``stats()['resilience']``.
+        """
+        t = self._thread
+        alive = t is not None and t.is_alive()
+        live = alive and self._crashed is None
+        hb_age = None if self._heartbeat is None else \
+            round(time.monotonic() - self._heartbeat, 4)
+        c = self.metrics.counters
+        return {
+            "live": live,
+            "ready": live and not self._stopping
+            and not self._batcher.closed,
+            "crashed": None if self._crashed is None else str(self._crashed),
+            "heartbeat_age_s": hb_age,
+            "queued": len(self._batcher),
+            "active_slots": self._alloc.active_count if self._alloc else 0,
+            "retries": c["retries"],
+            "watchdog_trips": c["watchdog_trips"],
+        }
+
+    # ---------------------------------------------------------- SIGTERM drain
+    def install_signal_handlers(self, signals=(_signal.SIGTERM,)):
+        """Route the given signals (default SIGTERM — the preemption
+        notice) to a graceful ``stop(drain=True)`` on a helper thread.
+        Main-thread only; returns the previous handlers (restored by
+        ``uninstall_signal_handlers()`` / ``stop()``)."""
+        prev = {}
+        for s in signals:
+            prev[s] = _signal.signal(s, self._on_term_signal)
+        self._prev_handlers = prev
+        return prev
+
+    def uninstall_signal_handlers(self):
+        # restoring is main-thread-only (CPython rule); when stop() runs
+        # on the drain helper thread the saved handlers are kept so a
+        # later main-thread call can still restore them
+        if self._prev_handlers and \
+                threading.current_thread() is threading.main_thread():
+            for s, h in self._prev_handlers.items():
+                try:
+                    _signal.signal(s, h)
+                except (ValueError, TypeError):
+                    pass
+            self._prev_handlers = None
+
+    def _on_term_signal(self, signum, frame):
+        # never drain inside a signal handler (arbitrary interrupted
+        # frame, possibly holding locks) — hand off to a helper thread
+        threading.Thread(target=self.stop, kwargs={"drain": True},
+                         name="mxnet_tpu-serving-drain",
+                         daemon=True).start()
 
     def __enter__(self):
         if self._thread is None:
@@ -320,6 +556,8 @@ class InferenceEngine:
         (``None``/``0`` = no deadline), enforced while queued and
         mid-generation.
         """
+        if self._crashed is not None:
+            raise EngineCrashedError(str(self._crashed))
         timeout = self.default_timeout if timeout is None else timeout
         deadline = time.monotonic() + timeout if timeout else None
         if self.mode == "decode":
@@ -353,6 +591,7 @@ class InferenceEngine:
         else:
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)())
             req = Request("forward", arr, deadline=deadline)
+        req.retries_left = self.max_request_retries
         self.metrics.count("submitted")
         try:
             self._batcher.put(req)
@@ -439,12 +678,18 @@ class InferenceEngine:
             "seq_buckets": list(self.lattice.seq_buckets)
             if self.mode == "decode" else None,
             "running": self._thread is not None,
+            "crashed": self._crashed is not None,
         }
         return s
 
     # --------------------------------------------------------------- scheduler
     def _loop(self):
         while True:
+            self._heartbeat = time.monotonic()
+            # deliberately OUTSIDE the recovery net: a raise here kills
+            # the scheduler thread, which is exactly the crash the
+            # watchdog exists to detect
+            _inject("serving.scheduler")
             with self._cond:
                 idle = (self._alloc is None
                         or self._alloc.active_count == 0)
@@ -455,13 +700,48 @@ class InferenceEngine:
                     continue
             try:
                 with self._step_lock:
-                    if self.mode == "decode":
-                        self._decode_cycle()
-                    else:
-                        self._forward_cycle()
+                    self._cycle_busy = True
+                    try:
+                        if self.mode == "decode":
+                            self._decode_cycle()
+                        else:
+                            self._forward_cycle()
+                    finally:
+                        self._cycle_busy = False
             except BaseException as e:  # defensive: never leave futures hung
                 with self._step_lock:
                     self._fail_inflight(e)
+
+    def _run_step(self, site: str, key, fn, args, reqs):
+        """One compiled call with the injection site + bounded retry for
+        retryable faults.  ``reqs`` are the requests riding this call:
+        each retry spends one unit of every rider's budget; once any
+        rider is exhausted the fault escalates to the caller's failure
+        path.  Injection fires BEFORE dispatch, so a retried call never
+        re-executes a partially applied step."""
+        delay = self.retry_backoff
+        counted = False
+        while True:
+            try:
+                _inject(site)
+                if counted:
+                    # a retry re-executes device work (an honest span)
+                    # but is the SAME logical step: don't re-count the
+                    # bucket hit or stats() degrades exactly when
+                    # operators read it
+                    with self.metrics.span(key[0]):
+                        return fn(*args)
+                counted = True
+                return self._counted(key, fn, *args)
+            except RetryableFault:
+                if any(r.retries_left <= 0 for r in reqs) or not reqs:
+                    raise
+                for r in reqs:
+                    r.retries_left -= 1
+                self.metrics.count("retries")
+                self.metrics.mark("retry")
+                time.sleep(delay)
+                delay *= 2
 
     def _filter_expired(self, reqs):
         """Fail deadline-blown queued requests; return the live rest."""
@@ -564,10 +844,10 @@ class InferenceEngine:
         self.metrics.count("prefill_batches")
         self.metrics.mark("admit", len(group))
         self._ensure_caches()
-        first, self._caches = self._counted(
-            ("prefill", bb, tb), self._jit_prefill, self._params(),
-            jnp.asarray(toks), jnp.asarray(lens), self._caches,
-            jnp.asarray(sidx))
+        first, self._caches = self._run_step(
+            "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
+            (self._params(), jnp.asarray(toks), jnp.asarray(lens),
+             self._caches, jnp.asarray(sidx)), group)
         first = onp.asarray(first)
         for i, st in enumerate(states):
             st.advance(int(first[i]))
@@ -590,9 +870,11 @@ class InferenceEngine:
             tok[slot] = st.last_token
             pos[slot] = st.pos
         self.metrics.count("decode_steps")
-        nxt, self._caches = self._counted(
-            ("decode",), self._jit_step, self._params(),
-            jnp.asarray(tok), self._caches, jnp.asarray(pos))
+        nxt, self._caches = self._run_step(
+            "serving.decode_step", ("decode",), self._jit_step,
+            (self._params(), jnp.asarray(tok), self._caches,
+             jnp.asarray(pos)),
+            [st.request for _, st in alloc.items()])
         nxt = onp.asarray(nxt)
         for slot, st in alloc.items():
             st.advance(int(nxt[slot]))
@@ -621,17 +903,24 @@ class InferenceEngine:
         self.metrics.count("forward_batches")
         self.metrics.mark("admit", len(live))
         key = ("forward", bb) + live[0].shape_key
+        # the popped batch lives in neither the batcher nor the slot
+        # allocator — publish it so a watchdog trip during a hung
+        # forward can still fail these futures
+        self._inflight_fwd = tuple(live)
         try:
-            outs = self._counted(key, self._jit_forward, self._params(),
-                                 jnp.asarray(xs))
+            outs = self._run_step("serving.forward", key,
+                                  self._jit_forward,
+                                  (self._params(), jnp.asarray(xs)), live)
             outs = [onp.asarray(o) for o in outs]
         except BaseException as e:
-            # the popped batch lives in neither the batcher nor the slot
-            # allocator — fail it HERE or the futures hang forever; the
-            # rest of the queue is untouched (no shared state to poison)
+            # fail the popped batch HERE or the futures hang forever;
+            # the rest of the queue is untouched (no shared state to
+            # poison)
             for r in live:
                 self._fail(r, e)
             return
+        finally:
+            self._inflight_fwd = ()
         done = time.monotonic()
         for i, r in enumerate(live):
             res = outs[0][i] if self._fwd_single else \

@@ -15,7 +15,8 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
-           "EngineStoppedError", "InvalidRequestError"]
+           "DeadlineExceededError", "EngineStoppedError",
+           "EngineCrashedError", "InvalidRequestError"]
 
 
 class ServingError(MXNetError):
@@ -28,14 +29,26 @@ class QueueFullError(ServingError):
 
 
 class RequestTimeoutError(ServingError):
-    """The request's deadline elapsed — while queued, or mid-generation
-    (a partially generated sequence is discarded and its KV slot
-    freed)."""
+    """The request's deadline elapsed — while queued (including while
+    the engine drains toward a stop), or mid-generation (a partially
+    generated sequence is discarded and its KV slot freed)."""
+
+
+#: Canonical deadline-error name; ``RequestTimeoutError`` is the
+#: historical alias — they are the same class, so either catches both.
+DeadlineExceededError = RequestTimeoutError
 
 
 class EngineStoppedError(ServingError):
     """The engine is stopped/stopping and not accepting (or no longer
     able to finish) this request."""
+
+
+class EngineCrashedError(ServingError):
+    """The scheduler thread died or hung: the watchdog condemned the
+    engine and failed every queued and in-flight request with this error
+    so no caller blocks on a future that can never resolve.  The engine
+    cannot be restarted — build a fresh one."""
 
 
 class InvalidRequestError(ServingError):

@@ -95,7 +95,11 @@ class ServingMetrics:
                  "rejected_invalid", "timeouts", "cancelled",
                  "prefill_batches", "decode_steps", "forward_batches",
                  "bucket_hits", "compiles", "tokens_generated",
-                 "prompt_tokens", "padded_tokens")
+                 "prompt_tokens", "padded_tokens",
+                 # resilience: transient-step retries, watchdog
+                 # condemnations, atomic checkpoint commits, resumes
+                 "retries", "watchdog_trips", "checkpoint_commits",
+                 "resumes")
 
     def __init__(self, name: str = "serving"):
         self.name = name
@@ -152,5 +156,8 @@ class ServingMetrics:
                 "hit_rate": round(c["bucket_hits"] / lookups, 4)
                 if lookups else None,
             },
+            "resilience": {k: c[k] for k in
+                           ("retries", "watchdog_trips",
+                            "checkpoint_commits", "resumes")},
             "latency": lat,
         }

@@ -12,17 +12,46 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as onp
 
 from .. import base as _base
 from ..ndarray import NDArray
+from ..resilience.faults import inject as _inject
 
 __all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
 
 
 def _to_jax_tree(tree):
+    """Unwrap NDArrays AND snapshot every leaf into buffers the save
+    exclusively owns.
+
+    The snapshot is load-bearing, not defensive style: an async save
+    hands orbax/tensorstore references to the live param buffers, and a
+    donating train step (``ShardedTrainer(donate=True)``) afterwards
+    lets XLA reuse exactly that memory — donation does not honor
+    outstanding zero-copy views, so a later same-process ``restore``
+    could silently return the NEXT step's bytes or freed-memory
+    garbage.  Fully-addressable leaves are materialized as OWNED host
+    numpy arrays (plain refcounted memory no XLA machinery can reclaim
+    under the writer); multi-host sharded leaves keep a jax device copy
+    so each host still writes only its own shards."""
+    import jax.numpy as jnp
+
+    def leaf(x):
+        v = x.jax if isinstance(x, NDArray) else x
+        if not isinstance(v, jax.Array):
+            return v
+        if getattr(v, "is_fully_addressable", True):
+            # copy only when the host array is a borrowed view (the CPU
+            # zero-copy case); an accelerator D2H transfer already owns
+            # its buffer — don't memcpy multi-GB trees twice
+            a = onp.asarray(v)
+            return a if a.base is None and a.flags.writeable \
+                else onp.array(a)
+        return jnp.copy(v)
+
     return jax.tree_util.tree_map(
-        lambda x: x.jax if isinstance(x, NDArray) else x, tree,
-        is_leaf=lambda x: isinstance(x, NDArray))
+        leaf, tree, is_leaf=lambda x: isinstance(x, NDArray))
 
 
 class CheckpointManager:
@@ -31,6 +60,10 @@ class CheckpointManager:
     `save(step, tree)` returns immediately (background write); call
     `wait_until_finished()` before exiting.  `restore(step, like=tree)`
     restores with the shardings/dtypes of `like`'s leaves.
+
+    Usable as a context manager: exit waits for in-flight async saves
+    and closes.  ``close()`` is idempotent (safe from both an explicit
+    call and a ``with`` block, or called twice by teardown paths).
     """
 
     def __init__(self, directory, max_to_keep: int = 5,
@@ -44,16 +77,23 @@ class CheckpointManager:
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save)
         self._mngr = ocp.CheckpointManager(self.directory, options=opts)
+        self._closed = False
 
     def save(self, step: int, tree: Any) -> bool:
+        _inject("checkpoint.save")
+        if self._closed:
+            raise _base.MXNetError(
+                f"CheckpointManager for {self.directory} is closed")
         return self._mngr.save(step, args=self._ocp.args.StandardSave(
             _to_jax_tree(tree)))
 
     def restore(self, step: Optional[int] = None, like: Any = None):
+        _inject("checkpoint.restore")
         step = self.latest_step() if step is None else step
         if step is None:
             raise _base.MXNetError(
-                f"no checkpoint found under {self.directory}")
+                f"no checkpoint found under {self.directory} "
+                f"(all_steps={list(self.all_steps())})")
         if like is not None:
             abstract = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(
@@ -61,9 +101,11 @@ class CheckpointManager:
                     (x.jax.dtype if isinstance(x, NDArray) else x.dtype),
                     sharding=_sharding_of(x)),
                 like, is_leaf=lambda x: isinstance(x, NDArray))
-            return self._mngr.restore(
+            out = self._mngr.restore(
                 step, args=self._ocp.args.StandardRestore(abstract))
-        return self._mngr.restore(step)
+        else:
+            out = self._mngr.restore(step)
+        return _own_buffers(out)
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -75,27 +117,69 @@ class CheckpointManager:
         self._mngr.wait_until_finished()
 
     def close(self):
+        """Idempotent: closing twice (or after the context manager
+        already closed) is a no-op, so every teardown path may call it
+        unconditionally."""
+        if self._closed:
+            return
+        self._closed = True
         self._mngr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # drain in-flight async writes even on error: a half-landed
+        # "latest" is worse than a slower unwind
+        try:
+            self.wait_until_finished()
+        finally:
+            self.close()
+        return False
 
 
 def _sharding_of(x):
     v = x.jax if isinstance(x, NDArray) else x
-    return getattr(v, "sharding", None)
+    s = getattr(v, "sharding", None)
+    # restore into the DEFAULT memory kind: asking orbax to materialize
+    # into a non-default memory space (e.g. the CPU backend's
+    # unpinned_host) hits a zero-copy path whose buffers the next
+    # allocation can reuse — restored leaves then read back as a later
+    # step's values or NaN.  The caller re-places leaves onto its live
+    # shardings anyway (ShardedTrainer.load_checkpoint).
+    if isinstance(s, jax.sharding.NamedSharding):
+        return jax.sharding.NamedSharding(s.mesh, s.spec)
+    return s
+
+
+def _own_buffers(tree):
+    """Deep-copy every restored jax leaf into buffers this process owns,
+    synchronously, before anything else allocates.  The mirror of the
+    save-side snapshot in :func:`_to_jax_tree`: orbax/tensorstore may
+    hand back (or cache) zero-copy views, and on the CPU backend those
+    can alias memory a later donating step or allocation reuses —
+    observed as a restore returning the NEXT step's values or NaN
+    garbage in long-lived processes (the resume-after-preemption case)."""
+    import jax.numpy as jnp
+
+    def leaf(v):
+        if isinstance(v, jax.Array):
+            c = jnp.copy(v)
+            c.block_until_ready()
+            return c
+        return v
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def save_checkpoint(directory, step: int, tree, async_save=True,
                     max_to_keep=5):
     """One-shot convenience save."""
-    m = CheckpointManager(directory, max_to_keep=max_to_keep,
-                          async_save=async_save)
-    m.save(step, tree)
-    m.wait_until_finished()
-    m.close()
+    with CheckpointManager(directory, max_to_keep=max_to_keep,
+                           async_save=async_save) as m:
+        m.save(step, tree)
 
 
 def load_checkpoint(directory, step=None, like=None):
-    m = CheckpointManager(directory, async_save=False)
-    try:
+    with CheckpointManager(directory, async_save=False) as m:
         return m.restore(step, like=like)
-    finally:
-        m.close()

@@ -11,12 +11,15 @@ save_parameters/export/Trainer.save_states surface.
 from __future__ import annotations
 
 import json
+import os
 import struct
+import tempfile
 from typing import Dict, List, Union
 
 import numpy as onp
 
 from ..ndarray import NDArray, array
+from ..resilience.faults import inject as _inject
 
 MAGIC = b"MXTPU1\n"
 
@@ -30,6 +33,11 @@ def _to_numpy(v: NDArray) -> onp.ndarray:
 
 def save(fname: str, data: Union[Dict[str, NDArray], List[NDArray],
                                  NDArray]):
+    """Write atomically: the container is assembled in a temp file in the
+    target directory and committed with one ``os.replace``, so a crash
+    mid-write (host preemption, OOM-kill) can never corrupt an existing
+    file at ``fname`` — Trainer.save_states over the previous state file
+    either fully replaces it or leaves it untouched."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
@@ -53,12 +61,37 @@ def save(fname: str, data: Union[Dict[str, NDArray], List[NDArray],
                       "dtype": dtype_name, "nbytes": len(payload)})
         blobs.append(payload)
     header = json.dumps({"keyed": keyed, "arrays": metas}).encode()
-    with open(fname, "wb") as f:
-        f.write(MAGIC)
-        f.write(struct.pack("<Q", len(header)))
-        f.write(header)
-        for b in blobs:
-            f.write(b)
+    dirname = os.path.dirname(os.path.abspath(fname))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(fname) + ".tmp-",
+                               dir=dirname)
+    try:
+        # mkstemp creates 0600; match what plain open(fname, 'wb') would
+        # leave behind (checkpoints are often read by another
+        # process/UID): preserve an existing target's mode, else 0644.
+        # Never the os.umask(0)-then-restore dance — umask is
+        # process-global and racing threads would briefly create
+        # world-writable files.
+        try:
+            mode = os.stat(fname).st_mode & 0o777
+        except OSError:
+            mode = 0o644
+        os.fchmod(fd, mode)
+        with os.fdopen(fd, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<Q", len(header)))
+            f.write(header)
+            for b in blobs:
+                f.write(b)
+            f.flush()
+            os.fsync(f.fileno())
+        _inject("serialization.commit")
+        os.replace(tmp, fname)       # atomic commit
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(fname: str):

@@ -39,3 +39,7 @@ def pytest_configure(config):
         "markers",
         "slow: heavy sharded-model / long-sequence tests "
         "(deselect with -m 'not slow' for the <5-min smoke tier)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / preemption chaos tests (deterministic "
+        "and CPU-fast; select with -m chaos)")

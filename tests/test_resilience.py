@@ -1,0 +1,643 @@
+"""mxnet_tpu.resilience — fault injection, preemption-safe training,
+hardened serving.
+
+The two acceptance contracts live here: (1) chaos determinism — a
+training run killed at 3 distinct steps and resumed each time converges
+to bit-identical parameters vs the fault-free run (CPU); (2) no
+stranded futures — across the injected serving fault matrix every
+submitted InferenceFuture resolves with a result or a typed error.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.resilience import (AtomicCheckpointer, FaultPlan,
+                                  InjectedFault, ResilientLoop,
+                                  RetryableFault, SimulatedPreemption,
+                                  active_plan, inject)
+from mxnet_tpu.serving import (DeadlineExceededError, EngineCrashedError,
+                               EngineStoppedError, InferenceEngine,
+                               QueueFullError, RequestTimeoutError,
+                               ServingError)
+
+# ---------------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                 num_heads=2, max_length=32, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 61, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8,))
+    kw.setdefault("default_max_new_tokens", 4)
+    kw.setdefault("watchdog_interval", 0.05)
+    return InferenceEngine(net, **kw)
+
+
+def _join_scheduler(eng, timeout=30):
+    """Wait out a (possibly zombie) scheduler so its injection-site hits
+    can't bleed into the next scenario's plan."""
+    t = eng._thread
+    threads = [t] if t is not None else [
+        th for th in threading.enumerate()
+        if th.name == "mxnet_tpu-serving"]
+    deadline = time.monotonic() + timeout
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+        assert not th.is_alive(), "scheduler did not wind down"
+
+
+# ------------------------------------------------------------- fault plans
+
+
+def test_fault_plan_fires_deterministically():
+    plan = (FaultPlan(seed=5)
+            .raise_at("a", at=3)
+            .raise_at("b", every=2, max_fires=2)
+            .delay_at("c", 0.0, at=1))
+    with plan:
+        for _ in range(2):
+            inject("a")                       # hits 1, 2: no fire
+        with pytest.raises(InjectedFault):
+            inject("a")                       # hit 3 fires
+        inject("a")                           # at= fires exactly once
+        fired_b = 0
+        for _ in range(8):
+            try:
+                inject("b")
+            except InjectedFault:
+                fired_b += 1
+        assert fired_b == 2                   # max_fires bound
+        inject("c")                           # delay of 0 is a no-op fire
+    assert plan.hits["a"] == 4
+    assert plan.fired("a") == 1 and plan.fired("b") == 2
+    assert ("c", 1, "delay") in plan.log
+
+
+def test_fault_plan_seeded_probability_reproducible():
+    def pattern(seed):
+        plan = FaultPlan(seed=seed).raise_at("s", prob=0.3)
+        out = []
+        with plan:
+            for _ in range(64):
+                try:
+                    inject("s")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+        return out
+
+    a, b = pattern(11), pattern(11)
+    assert a == b                              # same seed, same schedule
+    assert sum(a) > 0
+    assert pattern(12) != a                    # seed actually matters
+
+
+def test_fault_plan_scoping_and_zero_cost_disabled():
+    assert active_plan() is None
+    inject("anything")                         # no plan: pure no-op
+    plan = FaultPlan().raise_at("x", at=1)
+    with plan:
+        assert active_plan() is plan
+        with pytest.raises(mx.MXNetError):     # no nesting
+            with FaultPlan():
+                pass
+        with pytest.raises(InjectedFault):
+            inject("x")
+    assert active_plan() is None
+    inject("x")                                # scope ended: no-op again
+
+
+def test_kill_is_base_exception():
+    plan = FaultPlan().kill_at("k", at=1)
+    with plan:
+        try:
+            try:
+                inject("k")
+            except Exception:                  # a generic handler must
+                pytest.fail("kill was swallowed by except Exception")
+        except SimulatedPreemption:
+            pass                               # ...NOT catch a kill
+
+
+# ------------------------------------------------------- atomic checkpoints
+
+
+def test_atomic_checkpointer_roundtrip_gc_and_errors(tmp_path):
+    ck = AtomicCheckpointer(str(tmp_path), max_to_keep=2)
+    with pytest.raises(mx.MXNetError, match=r"all_steps=\[\]"):
+        ck.restore()
+    tree = {"w": nd.array(onp.arange(6, dtype="float32"))}
+    for s in (1, 2, 3):
+        tree["w"] *= 2.0
+        ck.save(s, tree, meta={"note": "t"})
+    assert ck.all_steps() == [2, 3]            # GC kept the last 2
+    assert ck.latest_step() == 3
+    restored, meta = ck.restore()
+    onp.testing.assert_array_equal(restored["w"].asnumpy(),
+                                   tree["w"].asnumpy())
+    assert meta["step"] == 3 and meta["note"] == "t"
+    with pytest.raises(mx.MXNetError, match="all_steps"):
+        ck.restore(9)
+
+
+@pytest.mark.chaos
+def test_kill_mid_save_never_corrupts_latest(tmp_path):
+    ck = AtomicCheckpointer(str(tmp_path))
+    good = {"w": nd.array(onp.ones(4, "float32"))}
+    ck.save(1, good)
+    bad = {"w": nd.array(onp.zeros(4, "float32"))}
+    with FaultPlan().kill_at("checkpoint.commit", at=1):
+        with pytest.raises(SimulatedPreemption):
+            ck.save(2, bad)
+    assert ck.latest_step() == 1               # commit never happened
+    restored, _ = ck.restore()
+    onp.testing.assert_array_equal(restored["w"].asnumpy(),
+                                   onp.ones(4, "float32"))
+    # a "new process" sweeps the dead save's temp dir
+    ck2 = AtomicCheckpointer(str(tmp_path))
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp-")]
+    assert ck2.latest_step() == 1
+
+
+@pytest.mark.chaos
+def test_recommit_kill_window_recovers(tmp_path):
+    """Re-committing an existing step moves the old dir ASIDE (never
+    deletes it); a kill inside the swap window is healed on the next
+    startup by recovering the aside copy."""
+    ck = AtomicCheckpointer(str(tmp_path))
+    ck.save(1, {"w": nd.array(onp.ones(3, "float32"))})
+    ck.save(2, {"w": nd.array(onp.full(3, 2.0, "float32"))})
+    # simulate a kill between the aside-rename and the commit-rename
+    os.rename(str(tmp_path / "step-00000002"),
+              str(tmp_path / f".tmp-old-{2:08d}-{os.getpid()}"))
+    ck2 = AtomicCheckpointer(str(tmp_path))     # "fresh process"
+    assert ck2.all_steps() == [1, 2]            # aside copy recovered
+    restored, _ = ck2.restore(2)
+    onp.testing.assert_array_equal(restored["w"].asnumpy(),
+                                   onp.full(3, 2.0, "float32"))
+    # a normal re-commit still replaces cleanly and leaves no residue
+    ck2.save(2, {"w": nd.array(onp.full(3, 4.0, "float32"))})
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp-")]
+    onp.testing.assert_array_equal(ck2.restore(2)[0]["w"].asnumpy(),
+                                   onp.full(3, 4.0, "float32"))
+
+
+@pytest.mark.chaos
+def test_serialization_save_is_atomic(tmp_path):
+    """A crash mid-write (Trainer.save_states path) leaves the previous
+    file byte-identical — tempfile + os.replace, never in-place."""
+    from mxnet_tpu.utils.serialization import load, save
+    fname = str(tmp_path / "states.mxtpu")
+    save(fname, {"s": nd.array(onp.full(8, 7.0, "float32"))})
+    before = open(fname, "rb").read()
+    with FaultPlan().kill_at("serialization.commit", at=1):
+        with pytest.raises(SimulatedPreemption):
+            save(fname, {"s": nd.array(onp.zeros(8, "float32"))})
+    assert open(fname, "rb").read() == before
+    onp.testing.assert_array_equal(load(fname)["s"].asnumpy(),
+                                   onp.full(8, 7.0, "float32"))
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+
+
+def test_checkpoint_manager_context_and_idempotent_close(tmp_path):
+    from mxnet_tpu.utils.checkpoint import CheckpointManager
+    tree = {"x": nd.array(onp.ones(4, "float32"))}
+    with CheckpointManager(str(tmp_path / "run")) as m:
+        m.save(1, tree)
+    m.close()                                  # second close: no-op
+    m.close()
+    with pytest.raises(mx.MXNetError):         # closed manager refuses
+        m.save(2, tree)
+    with CheckpointManager(str(tmp_path / "run")) as m2:
+        assert m2.latest_step() == 1
+    with CheckpointManager(str(tmp_path / "empty")) as m3:
+        with pytest.raises(mx.MXNetError, match=r"all_steps=\[\]"):
+            m3.restore()
+
+
+# ------------------------------------------------- preemption-safe training
+
+
+def _make_mesh():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs multi-device mesh (conftest forces 8 cpu)")
+    return par.make_mesh(dp=2, devices=jax.devices()[:2])
+
+
+_W1 = onp.random.RandomState(42).randn(16, 6).astype("float32") * 0.1
+_W2 = onp.random.RandomState(43).randn(2, 16).astype("float32") * 0.1
+
+
+def _make_trainer():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(2, in_units=16))
+    net.initialize()
+    net[0].weight.set_data(nd.array(_W1))
+    net[0].bias.set_data(nd.array(onp.zeros(16, "float32")))
+    net[1].weight.set_data(nd.array(_W2))
+    net[1].bias.set_data(nd.array(onp.zeros(2, "float32")))
+    return par.ShardedTrainer(
+        net, "adam", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer_params={"learning_rate": 0.01})
+
+
+def _make_iter():
+    def gen():
+        for i in range(100):
+            rs = onp.random.RandomState(1000 + i)
+            X = rs.randn(8, 6).astype("float32")
+            y = (X.sum(1) > 0).astype("int32")
+            yield (nd.array(X), nd.array(y))
+    return gen()
+
+
+def _params_of(tr):
+    return [p.data().asnumpy().copy() for _, p in tr._trainable]
+
+
+@pytest.mark.chaos
+def test_training_kill_resume_determinism(tmp_path):
+    """THE chaos-determinism acceptance: seeded FaultPlan kills training
+    at 3 distinct steps; ResilientLoop resumes from the atomic latest
+    checkpoint each time (replaying the data-iterator offset) and the
+    final parameters are BIT-IDENTICAL to the fault-free run."""
+    mesh = _make_mesh()
+    STEPS = 12
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "ref"), save_every=2,
+                             seed=7)
+        ref_report = loop.run(_make_iter, STEPS)
+        assert ref_report["completed_steps"] == STEPS
+        ref = _params_of(tr)
+
+        # hits 3/7/10 of trainer.step land on three DISTINCT global
+        # steps because killed steps are replayed after resume
+        plan = (FaultPlan(seed=0)
+                .kill_at("trainer.step", at=3)
+                .kill_at("trainer.step", at=7)
+                .kill_at("trainer.step", at=10))
+        kills, report, resumed_from = 0, None, []
+        with plan:
+            for _ in range(6):
+                tr2 = _make_trainer()          # a "fresh process"
+                loop2 = ResilientLoop(tr2, str(tmp_path / "chaos"),
+                                      save_every=2, seed=7)
+                try:
+                    report = loop2.run(_make_iter, STEPS)
+                    break
+                except SimulatedPreemption:
+                    kills += 1
+                    resumed_from.append(
+                        loop2.checkpointer.latest_step())
+        assert kills == 3
+        assert plan.fired("trainer.step") == 3
+        assert report is not None and report["completed_steps"] == STEPS
+        assert report["resumed_from"] is not None
+        assert loop2.metrics.counters["resumes"] >= 1
+        assert loop2.metrics.counters["checkpoint_commits"] >= 1
+        for a, b in zip(ref, _params_of(tr2)):
+            onp.testing.assert_array_equal(a, b)   # exact on CPU
+        # same contract for the loss the two final steps reported
+        assert report["final_loss"] == ref_report["final_loss"]
+
+
+@pytest.mark.chaos
+def test_transient_step_fault_retried_with_backoff(tmp_path):
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "r"), save_every=4,
+                             seed=3, max_retries=2, backoff=0.001)
+        plan = (FaultPlan()
+                .raise_at("trainer.step", at=2, retryable=True)
+                .raise_at("trainer.step", at=5, retryable=True))
+        with plan:
+            report = loop.run(_make_iter, 6)
+        assert report["completed_steps"] == 6
+        assert report["retries"] == 2
+        assert loop.metrics.counters["retries"] == 2
+
+        # a retry budget of zero escalates instead of looping forever
+        tr3 = _make_trainer()
+        loop3 = ResilientLoop(tr3, str(tmp_path / "r0"), max_retries=0,
+                              seed=3)
+        with FaultPlan().raise_at("trainer.step", at=1, retryable=True):
+            with pytest.raises(RetryableFault):
+                loop3.run(_make_iter, 2)
+
+
+@pytest.mark.chaos
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    """SIGTERM (the preemption notice) makes the loop commit a final
+    checkpoint at the step boundary and return preempted=True; the next
+    run() picks up exactly where it stopped."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "p"), save_every=100,
+                             seed=5)
+        prev_disposition = signal.getsignal(signal.SIGTERM)
+        plan = FaultPlan().call_at(
+            "trainer.step", at=4,
+            fn=lambda: os.kill(os.getpid(), signal.SIGTERM))
+        with plan:
+            report = loop.run(_make_iter, 10)
+        assert report["preempted"] is True
+        assert report["completed_steps"] == 4
+        assert loop.checkpointer.latest_step() == 4
+        # old SIGTERM disposition restored
+        assert signal.getsignal(signal.SIGTERM) is prev_disposition
+
+        tr2 = _make_trainer()
+        loop2 = ResilientLoop(tr2, str(tmp_path / "p"), save_every=100,
+                              seed=5)
+        report2 = loop2.run(_make_iter, 10)
+        assert report2["resumed_from"] == 4
+        assert report2["completed_steps"] == 10
+        assert report2["preempted"] is False
+
+
+def test_resilient_loop_batch_fn_and_validation(tmp_path):
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "b"), seed=1)
+        with pytest.raises(mx.MXNetError):
+            loop.run(None, 3)                  # neither source given
+        with pytest.raises(mx.MXNetError):
+            loop.run(_make_iter, 3, batch_fn=lambda s: None)  # both
+
+        def batch_fn(step):
+            rs = onp.random.RandomState(step)
+            X = rs.randn(8, 6).astype("float32")
+            return (nd.array(X), nd.array((X.sum(1) > 0).astype("int32")))
+
+        report = loop.run(batch_fn=batch_fn, steps=3)
+        assert report["completed_steps"] == 3
+        assert loop.checkpointer.latest_step() == 3
+
+
+# --------------------------------------------------------- serving matrix
+
+
+def _resolve_all(futs, timeout=60):
+    """The no-stranded-futures contract: every future resolves within
+    its timeout with a result or a typed error.  A bare TimeoutError
+    from the wait itself IS a stranded future — fail loudly."""
+    outcomes = []
+    for f in futs:
+        try:
+            outcomes.append(("ok", f.result(timeout=timeout)))
+        except TimeoutError:
+            pytest.fail("stranded future: no resolution within timeout")
+        except Exception as e:
+            outcomes.append((type(e).__name__, None))
+    return outcomes
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_scheduler_crash_fails_all_futures(net):
+    """A scheduler thread killed outside its recovery net strands
+    nothing: the watchdog fails queued AND in-flight requests with
+    EngineCrashedError, and later submits are rejected typed."""
+    eng = _engine(net)
+    plan = FaultPlan().raise_at("serving.scheduler", at=2)
+    with plan:
+        eng.start()
+        futs, rejected = [], 0
+        for p in _prompts((3, 5, 4)):
+            try:
+                futs.append(eng.submit(p))
+            except (EngineCrashedError, EngineStoppedError):
+                rejected += 1
+        outcomes = _resolve_all(futs, timeout=30)
+        assert len(outcomes) + rejected == 3
+        assert all(kind == "EngineCrashedError" for kind, _ in outcomes)
+        h = eng.health()
+        assert h["live"] is False and h["ready"] is False
+        assert h["crashed"] and h["watchdog_trips"] == 1
+    assert eng.stats()["engine"]["crashed"] is True
+    eng.stop(timeout=10)                       # doesn't hang or drop
+    _join_scheduler(eng)
+
+
+@pytest.mark.chaos
+def test_hung_step_tripped_by_watchdog(net):
+    """A hang inside the compiled step can't be interrupted, but the
+    watchdog must fail the futures instead of hanging every caller."""
+    eng = _engine(net, hang_timeout=0.3)
+    plan = FaultPlan().delay_at("serving.decode_step", 1.2, at=1)
+    with plan:
+        with eng:
+            t0 = time.monotonic()
+            fut = eng.submit(_prompts((3,))[0], max_new_tokens=4)
+            with pytest.raises(EngineCrashedError):
+                fut.result(timeout=30)
+            # failed by the watchdog (~0.3s), not by waiting out the hang
+            assert time.monotonic() - t0 < 1.1
+            assert eng.health()["live"] is False
+        _join_scheduler(eng)
+    assert eng.metrics.counters["watchdog_trips"] == 1
+
+
+@pytest.mark.chaos
+def test_stop_does_not_deadlock_on_hung_step(net):
+    """stop(drain=False) must not block forever on the step lock a hung
+    scheduler holds: futures are failed typed and stop() returns."""
+    eng = _engine(net)                 # no hang_timeout: watchdog silent
+    plan = FaultPlan().delay_at("serving.decode_step", 1.5, at=1)
+    with plan:
+        eng.start()
+        fut = eng.submit(_prompts((3,))[0], max_new_tokens=4)
+        time.sleep(0.3)                # scheduler is now asleep mid-step
+        t0 = time.monotonic()
+        eng.stop(drain=False, timeout=5)
+        assert time.monotonic() - t0 < 5.0
+        assert fut.done()
+        with pytest.raises(EngineStoppedError):
+            fut.result(timeout=1)
+    _join_scheduler(eng)
+
+
+@pytest.mark.chaos
+def test_forward_mode_hang_tripped_by_watchdog():
+    """A popped forward batch lives in neither the queue nor the slot
+    allocator — a hang there must still trip the watchdog and fail the
+    batch's futures (not look 'idle' forever)."""
+    from mxnet_tpu.gluon import nn
+    dense = nn.Dense(4, in_units=8)
+    dense.initialize()
+    eng = InferenceEngine(dense, max_batch=2, hang_timeout=0.3,
+                          watchdog_interval=0.05)
+    xs = onp.random.RandomState(3).randn(3, 8).astype("float32")
+    plan = FaultPlan().delay_at("serving.forward", 1.2, at=1)
+    with plan:
+        with eng:
+            futs = [eng.submit(x) for x in xs]
+            for f in futs:
+                with pytest.raises(EngineCrashedError):
+                    f.result(timeout=30)
+        _join_scheduler(eng)
+    assert eng.metrics.counters["watchdog_trips"] == 1
+
+
+@pytest.mark.chaos
+def test_retryable_decode_fault_is_transparent(net):
+    """A transient step fault is retried within the request budget: the
+    caller sees nothing but the same tokens, plus a retries counter."""
+    p = _prompts((3,))[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 4,
+                       temperature=0).asnumpy()[0]
+    eng = _engine(net, max_request_retries=2, retry_backoff=0.001)
+    plan = (FaultPlan()
+            .raise_at("serving.decode_step", at=2, retryable=True)
+            .raise_at("serving.prefill", at=1, retryable=True))
+    with plan:
+        with eng:
+            out = eng.infer(p, max_new_tokens=4)
+    onp.testing.assert_array_equal(ref, out)
+    assert eng.stats()["resilience"]["retries"] == 2
+    assert plan.fired() == 2
+
+
+@pytest.mark.chaos
+def test_retry_budget_exhaustion_fails_typed(net):
+    """When retryable faults outlast the per-request budget the request
+    fails with the fault — typed, never a hang."""
+    eng = _engine(net, max_request_retries=1, retry_backoff=0.001)
+    plan = FaultPlan().raise_at("serving.prefill", every=1, retryable=True)
+    with plan:
+        with eng:
+            fut = eng.submit(_prompts((3,))[0])
+            with pytest.raises(RetryableFault):
+                fut.result(timeout=30)
+    _join_scheduler(eng)
+
+
+@pytest.mark.chaos
+def test_sigterm_drains_gracefully(net):
+    prev_disposition = signal.getsignal(signal.SIGTERM)
+    eng = _engine(net).start()
+    eng.install_signal_handlers()
+    try:
+        futs = [eng.submit(p, max_new_tokens=4)
+                for p in _prompts((3, 5, 4))]
+        os.kill(os.getpid(), signal.SIGTERM)
+        outcomes = _resolve_all(futs, timeout=60)
+        assert all(kind == "ok" for kind, _ in outcomes)
+        for _ in range(200):                   # drain thread finishes stop
+            if eng._thread is None:
+                break
+            time.sleep(0.05)
+        assert eng._thread is None
+        with pytest.raises(EngineStoppedError):
+            eng.submit(_prompts((3,))[0])
+    finally:
+        eng.uninstall_signal_handlers()
+    assert signal.getsignal(signal.SIGTERM) is prev_disposition
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_full_fault_matrix_no_stranded_futures(net):
+    """Sweep the matrix in one engine-per-scenario pass and assert the
+    global invariant: submitted ⇒ resolved (result or typed error)."""
+    scenarios = [
+        ("scheduler_crash",
+         FaultPlan().raise_at("serving.scheduler", at=3)),
+        ("hung_step",
+         FaultPlan().delay_at("serving.decode_step", 1.0, at=1)),
+        ("retryable_prefill",
+         FaultPlan().raise_at("serving.prefill", at=1, retryable=True)),
+        ("nonretryable_decode",
+         FaultPlan().raise_at("serving.decode_step", at=2)),
+        ("no_fault", FaultPlan()),
+    ]
+    for name, plan in scenarios:
+        eng = _engine(net, hang_timeout=0.3, queue_depth=4,
+                      retry_backoff=0.001)
+        submitted, resolved = 0, 0
+        with plan:
+            eng.start()
+            futs = []
+            for p in _prompts((3, 5, 4, 6, 2, 7), seed=9):
+                try:
+                    futs.append(eng.submit(p, max_new_tokens=3,
+                                           timeout=20.0))
+                    submitted += 1
+                except ServingError:
+                    resolved += 1              # typed rejection AT submit
+            resolved += len(_resolve_all(futs, timeout=45))
+            assert resolved == 6, name
+            try:
+                eng.stop(timeout=15)
+            except ServingError:
+                pass                           # hung scheduler: condemned
+        _join_scheduler(eng)
+        for f in futs:                         # the invariant itself
+            assert f.done(), f"{name}: stranded future"
+
+
+def test_engine_stop_never_silently_drops(net):
+    """Satellite: requests still queued when the scheduler is down are
+    failed with EngineStoppedError, never dropped (engine never
+    started = the degenerate dead-scheduler case)."""
+    eng = _engine(net)
+    futs = [eng.submit(p) for p in _prompts((3, 4))]
+    eng.stop(drain=True, timeout=5)            # nothing to drain INTO
+    for f in futs:
+        assert f.done()
+        with pytest.raises(EngineStoppedError):
+            f.result(timeout=1)
+    assert eng.metrics.counters["cancelled"] == 2
+
+
+def test_health_reports_lifecycle(net):
+    eng = _engine(net)
+    h = eng.health()
+    assert h["live"] is False and h["ready"] is False
+    with eng:
+        h = eng.health()
+        assert h["live"] is True and h["ready"] is True
+        assert h["crashed"] is None
+        out = eng.infer(_prompts((3,))[0], max_new_tokens=2)
+        assert len(out) == 5
+    h = eng.health()
+    assert h["live"] is False and h["ready"] is False
+    assert h["crashed"] is None                # clean stop ≠ crash
+    assert "resilience" in eng.stats()
+
+
+def test_deadline_alias_is_exported():
+    assert DeadlineExceededError is RequestTimeoutError
+    from mxnet_tpu.serving import errors
+    assert "DeadlineExceededError" in errors.__all__
+    assert issubclass(EngineCrashedError, ServingError)

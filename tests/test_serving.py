@@ -154,6 +154,26 @@ def test_mixed_length_prompts_share_buckets(net):
     assert s["tokens"]["padded_tokens"] > 0
 
 
+def test_deadline_expiry_racing_drain(net):
+    """A request that expires while QUEUED during a drain must resolve
+    with DeadlineExceededError (== RequestTimeoutError) — not hang, not
+    silently vanish: stop(drain=True) only returns once every future is
+    resolved."""
+    from mxnet_tpu.serving import DeadlineExceededError
+    eng = _engine(net, num_slots=1, max_batch=1).start()
+    # occupy the only slot so the racer stays queued while draining
+    long_fut = eng.submit(_prompts((6,), seed=20)[0], max_new_tokens=8)
+    racer = eng.submit(_prompts((4,), seed=21)[0], max_new_tokens=8,
+                       timeout=0.01)
+    time.sleep(0.05)                  # deadline blows while still queued
+    eng.stop(drain=True, timeout=300)
+    assert racer.done() and long_fut.done()   # nothing outlives stop()
+    with pytest.raises(DeadlineExceededError):
+        racer.result(timeout=1)
+    assert len(long_fut.result(timeout=1)) == 6 + 8
+    assert eng.stats()["requests"]["timeouts"] == 1
+
+
 def test_shutdown_drains_cleanly(net):
     prompts = _prompts((5, 9, 3, 6, 11, 2), seed=8)
     eng = _engine(net).start()

@@ -1,0 +1,327 @@
+"""Chaos sweep: execute the resilience fault matrix and write a JSON
+report.
+
+Runs the same contracts the chaos tests assert, as a standalone tool a
+fleet can run against a build (CPU sanity or a real TPU host):
+
+- serving scenarios (fresh engine per scenario): scheduler crash, hung
+  step, retryable fault, non-retryable step fault, queue overflow,
+  request deadline, SIGTERM drain, fault-free control — the invariant
+  checked is *no stranded futures*: every submitted request resolves
+  with a result or a typed error within its timeout;
+- training scenarios: kill/resume determinism (K kills at distinct
+  steps; final params must match the fault-free run bit-exactly on
+  CPU), transient-fault retry, and kill-mid-checkpoint-commit (the
+  previous committed step must survive).
+
+Usage::
+
+    python tools/chaos_sweep.py --out chaos_report.json [--kills 3]
+
+Exit code 0 iff every scenario passed.  The report records per-scenario
+pass/fail, detail, fired faults, and engine/loop resilience counters.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ----------------------------------------------------------------- helpers
+
+def _tiny_gpt2():
+    import numpy as onp
+
+    from mxnet_tpu.models import get_gpt2
+    onp.random.seed(0)
+    net = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                   num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    return net
+
+
+def _prompts(lens, seed=1):
+    import numpy as onp
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 61, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    from mxnet_tpu.serving import InferenceEngine
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8,))
+    kw.setdefault("default_max_new_tokens", 4)
+    kw.setdefault("watchdog_interval", 0.05)
+    kw.setdefault("retry_backoff", 0.001)
+    return InferenceEngine(net, **kw)
+
+
+def _join_zombies(timeout=30):
+    deadline = time.monotonic() + timeout
+    for th in threading.enumerate():
+        if th.name == "mxnet_tpu-serving":
+            th.join(max(0.1, deadline - time.monotonic()))
+
+
+def _resolve_all(futs, timeout=60):
+    """(ok_count, typed_error_count, stranded_count)"""
+    ok = typed = stranded = 0
+    for f in futs:
+        try:
+            f.result(timeout=timeout)
+            ok += 1
+        except TimeoutError:
+            stranded += 1
+        except Exception:
+            typed += 1
+    return ok, typed, stranded
+
+
+# -------------------------------------------------------- serving scenarios
+
+def _serving_scenario(net, name, plan, submit_kw=None, engine_kw=None,
+                      n_requests=6, sigterm=False):
+    from mxnet_tpu.serving import ServingError
+    eng = _engine(net, **(engine_kw or {}))
+    submitted = rejected_typed = 0
+    futs = []
+    with plan:
+        eng.start()
+        if sigterm:
+            eng.install_signal_handlers()
+        for p in _prompts(tuple(range(2, 2 + n_requests)), seed=9):
+            try:
+                futs.append(eng.submit(p, max_new_tokens=3,
+                                       **(submit_kw or {})))
+                submitted += 1
+            except ServingError:
+                rejected_typed += 1
+        if sigterm:
+            os.kill(os.getpid(), signal.SIGTERM)
+        ok, typed, stranded = _resolve_all(futs, timeout=45)
+        try:
+            eng.stop(timeout=15)
+        except ServingError:
+            pass
+        if sigterm:
+            eng.uninstall_signal_handlers()
+    _join_zombies()
+    passed = stranded == 0 and (ok + typed) == submitted \
+        and (submitted + rejected_typed) == n_requests
+    return {
+        "name": f"serving/{name}",
+        "passed": bool(passed),
+        "detail": {"submitted": submitted, "rejected_typed": rejected_typed,
+                   "ok": ok, "typed_errors": typed, "stranded": stranded,
+                   "faults_fired": plan.fired(),
+                   "health": eng.health(),
+                   "resilience": eng.stats()["resilience"]},
+    }
+
+
+def serving_scenarios(net):
+    """(name, thunk) pairs — each thunk builds its plan fresh and runs
+    one engine through it."""
+    from mxnet_tpu.resilience import FaultPlan
+    return [
+        ("control", lambda: _serving_scenario(net, "control", FaultPlan())),
+        ("scheduler_crash", lambda: _serving_scenario(
+            net, "scheduler_crash",
+            FaultPlan().raise_at("serving.scheduler", at=3))),
+        ("hung_step", lambda: _serving_scenario(
+            net, "hung_step",
+            FaultPlan().delay_at("serving.decode_step", 1.0, at=1),
+            engine_kw={"hang_timeout": 0.3})),
+        ("retryable_fault", lambda: _serving_scenario(
+            net, "retryable_fault",
+            FaultPlan().raise_at("serving.prefill", at=1, retryable=True))),
+        ("nonretryable_step_fault", lambda: _serving_scenario(
+            net, "nonretryable_step_fault",
+            FaultPlan().raise_at("serving.decode_step", at=2))),
+        ("queue_full", lambda: _serving_scenario(
+            net, "queue_full", FaultPlan(),
+            engine_kw={"queue_depth": 2, "max_wait_us": 50000.0})),
+        ("deadline", lambda: _serving_scenario(
+            net, "deadline", FaultPlan(),
+            submit_kw={"timeout": 0.01},
+            engine_kw={"max_wait_us": 100000.0})),
+        ("sigterm_drain", lambda: _serving_scenario(
+            net, "sigterm_drain", FaultPlan(), sigterm=True)),
+    ]
+
+
+# ------------------------------------------------------- training scenarios
+
+def _make_trainer():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn
+    w1 = onp.random.RandomState(42).randn(16, 6).astype("float32") * 0.1
+    w2 = onp.random.RandomState(43).randn(2, 16).astype("float32") * 0.1
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(2, in_units=16))
+    net.initialize()
+    net[0].weight.set_data(nd.array(w1))
+    net[0].bias.set_data(nd.array(onp.zeros(16, "float32")))
+    net[1].weight.set_data(nd.array(w2))
+    net[1].bias.set_data(nd.array(onp.zeros(2, "float32")))
+    return par.ShardedTrainer(
+        net, "adam", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer_params={"learning_rate": 0.01})
+
+
+def _make_iter():
+    import numpy as onp
+
+    from mxnet_tpu import nd
+
+    def gen():
+        for i in range(100):
+            rs = onp.random.RandomState(1000 + i)
+            X = rs.randn(8, 6).astype("float32")
+            yield (nd.array(X), nd.array((X.sum(1) > 0).astype("int32")))
+    return gen()
+
+
+def training_kill_resume(kills=3, steps=12):
+    import numpy as onp
+
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.resilience import (FaultPlan, ResilientLoop,
+                                      SimulatedPreemption)
+    mesh = par.make_mesh(dp=1)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        with par.use_mesh(mesh):
+            tr = _make_trainer()
+            loop = ResilientLoop(tr, os.path.join(workdir, "ref"),
+                                 save_every=2, seed=7)
+            loop.run(_make_iter, steps)
+            ref = [p.data().asnumpy().copy() for _, p in tr._trainable]
+
+            plan = FaultPlan(seed=0)
+            for k in range(kills):
+                plan.kill_at("trainer.step", at=3 + 4 * k)
+            seen_kills, report = 0, None
+            with plan:
+                for _ in range(kills + 3):
+                    tr2 = _make_trainer()
+                    loop2 = ResilientLoop(tr2, os.path.join(workdir, "chaos"),
+                                          save_every=2, seed=7)
+                    try:
+                        report = loop2.run(_make_iter, steps)
+                        break
+                    except SimulatedPreemption:
+                        seen_kills += 1
+            got = [p.data().asnumpy() for _, p in tr2._trainable]
+            exact = all(onp.array_equal(a, b) for a, b in zip(ref, got))
+            passed = (seen_kills == kills and report is not None
+                      and report["completed_steps"] == steps and exact)
+            return {
+                "name": "training/kill_resume_determinism",
+                "passed": bool(passed),
+                "detail": {"kills": seen_kills,
+                           "resumed_from": report and report["resumed_from"],
+                           "params_bit_identical": bool(exact),
+                           "commits": loop2.metrics.counters[
+                               "checkpoint_commits"]},
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def training_commit_kill():
+    import numpy as onp
+
+    from mxnet_tpu.resilience import (AtomicCheckpointer, FaultPlan,
+                                      SimulatedPreemption)
+    from mxnet_tpu import nd
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        ck = AtomicCheckpointer(workdir)
+        ck.save(1, {"w": nd.array(onp.ones(4, "float32"))})
+        died = False
+        with FaultPlan().kill_at("checkpoint.commit", at=1):
+            try:
+                ck.save(2, {"w": nd.array(onp.zeros(4, "float32"))})
+            except SimulatedPreemption:
+                died = True
+        tree, _ = AtomicCheckpointer(workdir).restore()
+        intact = bool(onp.array_equal(tree["w"].asnumpy(),
+                                      onp.ones(4, "float32")))
+        return {
+            "name": "training/kill_mid_commit",
+            "passed": died and ck.latest_step() == 1 and intact,
+            "detail": {"died_mid_save": died, "latest": ck.latest_step(),
+                       "previous_intact": intact},
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+# -------------------------------------------------------------------- main
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="chaos_report.json")
+    ap.add_argument("--kills", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=12)
+    args = ap.parse_args()
+
+    from mxnet_tpu.utils.platform import init_backend
+    platform = init_backend()
+
+    scenarios = []
+
+    def run(fn, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            rec = fn(*a, **kw)
+            recs = rec if isinstance(rec, list) else [rec]
+        except Exception:
+            recs = [{"name": getattr(fn, "__name__", str(fn)),
+                     "passed": False,
+                     "detail": {"error": traceback.format_exc(limit=5)}}]
+        for r in recs:
+            r["seconds"] = round(time.perf_counter() - t0, 2)
+            scenarios.append(r)
+            print(f"[{'PASS' if r['passed'] else 'FAIL'}] {r['name']} "
+                  f"({r['seconds']}s)", flush=True)
+
+    net = _tiny_gpt2()
+    for _name, thunk in serving_scenarios(net):
+        run(thunk)
+    run(training_kill_resume, kills=args.kills, steps=args.steps)
+    run(training_commit_kill)
+
+    report = {
+        "platform": platform,
+        "passed": all(s["passed"] for s in scenarios),
+        "n_scenarios": len(scenarios),
+        "n_failed": sum(not s["passed"] for s in scenarios),
+        "scenarios": scenarios,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(f"chaos_sweep: {report['n_scenarios'] - report['n_failed']}/"
+          f"{report['n_scenarios']} passed -> {args.out}", flush=True)
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
