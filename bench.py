@@ -79,15 +79,20 @@ def _run_steps(trainer, batches, warmup: int, steps: int,
         nth = lambda i: batches()          # noqa: E731
     else:
         nth = lambda i: batches[i % len(batches)]  # noqa: E731
+
+    def as_loss(r):
+        # a guardrails-enabled trainer returns (loss, all_finite)
+        return r[0] if isinstance(r, tuple) else r
+
     for i in range(warmup):
-        loss = trainer.step(*nth(i))
+        loss = as_loss(trainer.step(*nth(i)))
         float(loss.asnumpy())     # hard sync — waitall is not enough
     times = []
     for _ in range(max(1, trials)):
         t0 = time.perf_counter()
         loss = None
         for i in range(steps):
-            loss = trainer.step(*nth(i))
+            loss = as_loss(trainer.step(*nth(i)))
         float(loss.asnumpy())
         times.append(time.perf_counter() - t0)
     return times
@@ -187,6 +192,77 @@ def bench_gpt2(on_tpu: bool, batch_override=None) -> dict:
 
 def bench_gpt2_long(on_tpu: bool, batch_override=None) -> dict:
     return _bench_gpt2_config(on_tpu, long=True, batch_override=batch_override)
+
+
+# ------------------------------------------------------- guardrail overhead
+
+def bench_guardrails(on_tpu: bool, batch_override=None) -> dict:
+    """Guarded vs unguarded GPT-2 step time (docs/guardrails.md).
+
+    The guardrails (in-graph all_finite flag + where-masked update +
+    dynamic loss scaling + global-norm clip) must live INSIDE the one
+    compiled step: this record proves it by timing the same workload
+    with and without them.  ``value`` is the guard overhead in percent
+    of the unguarded step (expected within trial noise — compare with
+    ``spread_pct``); the absolute throughputs ride along.
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_gpt2, gpt2_lm_loss
+
+    if on_tpu:
+        batch, seq, steps, warmup = 16, 1024, 20, 3
+        layers, units, vocab = 12, 768, 50257
+        heads = 12
+    else:
+        batch, seq, steps, warmup = 4, 128, 3, 1
+        layers, units, vocab, heads = 4, 256, 1024, 8
+
+    def make_net():
+        if on_tpu:
+            return get_gpt2("gpt2_124m", max_length=seq, dropout=0.0)
+        return get_gpt2("gpt2_124m", vocab_size=vocab, units=units,
+                        num_layers=layers, num_heads=heads,
+                        max_length=seq, dropout=0.0)
+
+    mesh = par.make_mesh()
+    batch = _fit_batch(batch_override or batch, mesh)
+    toks = mx.nd.array(
+        onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+    labels = mx.nd.array(
+        onp.random.randint(0, vocab, (batch, seq)), dtype="int32")
+
+    results = {}
+    with par.use_mesh(mesh):
+        for name, kw in (("unguarded", {}),
+                         ("guarded", {"guard_nonfinite": True,
+                                      "clip_global_norm": 1.0,
+                                      "loss_scaler": amp.LossScaler()})):
+            net = make_net()
+            net.initialize()
+            trainer = par.ShardedTrainer(
+                net, "adam", loss=gpt2_lm_loss,
+                optimizer_params={"learning_rate": 1e-4}, mesh=mesh, **kw)
+            dts = _run_steps(trainer, [(toks, labels)], warmup, steps)
+            results[name] = [batch * seq * steps / dt for dt in dts]
+
+    un = _median(results["unguarded"])
+    gu = _median(results["guarded"])
+    overhead_pct = 100.0 * (un - gu) / un if un else 0.0
+    rec = _record("gpt2_guarded_step_overhead", overhead_pct, "%", 0.0,
+                  batch=batch)
+    rec["vs_baseline"] = None        # a ratio, not an MFU claim
+    rec["value"] = round(overhead_pct, 2)
+    rec["unguarded_tokens_per_sec"] = round(un, 1)
+    rec["guarded_tokens_per_sec"] = round(gu, 1)
+    rec["guarded_trials"] = [round(v, 1) for v in results["guarded"]]
+    rec["unguarded_trials"] = [round(v, 1) for v in results["unguarded"]]
+    # per-side trial spread: an overhead smaller than this is noise
+    rec["spread_pct"] = round(max(
+        100.0 * (max(v) - min(v)) / _median(v)
+        for v in results.values()), 2)
+    return rec
 
 
 # --------------------------------------------------------------- ResNet-50
@@ -433,7 +509,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="gpt2",
                     choices=["gpt2", "gpt2_long", "resnet50", "resnet50_io",
-                             "bert", "nmt", "all"])
+                             "bert", "nmt", "guardrails", "all"])
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of each workload "
                          "into DIR (for the on-chip where-does-time-go "
@@ -446,12 +522,13 @@ def main():
         from mxnet_tpu import amp
         amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
 
-    names = (["resnet50", "resnet50_io", "bert", "nmt", "gpt2_long",
-              "gpt2"]
+    names = (["resnet50", "resnet50_io", "bert", "nmt", "guardrails",
+              "gpt2_long", "gpt2"]
              if args.workload == "all" else [args.workload])
     table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
              "resnet50": bench_resnet50, "resnet50_io": bench_resnet50_io,
-             "bert": bench_bert, "nmt": bench_nmt}
+             "bert": bench_bert, "nmt": bench_nmt,
+             "guardrails": bench_guardrails}
     import contextlib
     import os
     for name in names:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import warnings
 from typing import Optional
 
 import jax.numpy as jnp
@@ -112,20 +113,58 @@ class LossScaler:
                 self._unskipped = 0
 
 
-def init_trainer(trainer):
-    """Attach a LossScaler to a Trainer (parity: amp.init_trainer)."""
-    trainer._amp_loss_scaler = LossScaler()
-    trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
+_warned_no_scaler = False
+
+
+def _warn_no_scaler(fn_name: str):
+    """The historical behaviour of scale_loss/unscale without an
+    attached scaler was a SILENT no-op — deprecation path: warn once so
+    the user learns their fp16 run is training unscaled."""
+    global _warned_no_scaler
+    if not _warned_no_scaler:
+        _warned_no_scaler = True
+        warnings.warn(
+            f"amp.{fn_name} called on a trainer with no LossScaler "
+            "attached: this is a no-op (the loss is NOT being scaled). "
+            "Call amp.init_trainer(trainer) first — the silent no-op "
+            "path is deprecated and will become an error.",
+            FutureWarning, stacklevel=3)
+
+
+def init_trainer(trainer, loss_scaler: Optional["LossScaler"] = None):
+    """Attach a LossScaler to a trainer (parity: amp.init_trainer).
+
+    gluon ``Trainer``: the scaler is consulted eagerly — ``step()``
+    skips the update and shrinks the scale when gradients overflowed.
+    ``ShardedTrainer``: the scaler's *schedule* compiles into the jitted
+    step (scale/unscale/skip/grow all in-graph; see docs/guardrails.md),
+    so attach before the first ``build()``/``step()``.
+    """
+    scaler = loss_scaler or LossScaler()
+    attach = getattr(trainer, "attach_loss_scaler", None)
+    if attach is not None:           # ShardedTrainer's in-graph path
+        attach(scaler)
+    else:
+        trainer._amp_loss_scaler = scaler
+        trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
     return trainer
 
 
 @contextlib.contextmanager
 def scale_loss(loss, trainer):
     """Scale the loss before backward; trainer.step unscales
-    (parity: amp.scale_loss)."""
+    (parity: amp.scale_loss).
+
+    With a ``ShardedTrainer`` the scaling already happens inside the
+    compiled step, so this yields the loss unchanged (kept so training
+    scripts are portable across the two trainers)."""
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None:
+        _warn_no_scaler("scale_loss")
         yield loss
+        return
+    if getattr(trainer, "attach_loss_scaler", None) is not None:
+        yield loss                   # sharded path scales in-graph
         return
     if isinstance(loss, (list, tuple)):
         yield [l * scaler.loss_scale for l in loss]
@@ -139,7 +178,10 @@ def scale_loss(loss, trainer):
 def unscale(trainer):
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None:
+        _warn_no_scaler("unscale")
         return
+    if getattr(trainer, "attach_loss_scaler", None) is not None:
+        return                       # sharded path unscales in-graph
     inv = 1.0 / scaler.loss_scale
     for p in trainer._params:
         g = p.grad()

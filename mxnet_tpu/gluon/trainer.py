@@ -89,11 +89,28 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce_grads + update, scaled by 1/batch_size."""
+        """allreduce_grads + update, scaled by 1/batch_size.
+
+        With an ``amp.init_trainer``-attached LossScaler the step is
+        guarded: overflowed (non-finite) gradients SKIP the update and
+        shrink the scale instead of poisoning the parameters; finite
+        steps feed the scaler's grow schedule.  ``skipped_steps`` counts
+        the contained overflows.  (The sharded counterpart does the
+        same check in-graph — docs/guardrails.md.)
+        """
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            if scaler.has_overflow(self._params):
+                scaler.update_scale(skip=True)
+                self._scale = getattr(self, "_amp_original_scale", 1.0) / \
+                    scaler.loss_scale
+                self.skipped_steps = getattr(self, "skipped_steps", 0) + 1
+                return
+            scaler.update_scale(skip=False)
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):

@@ -19,6 +19,7 @@ import numpy as onp
 
 from . import base as _base
 from .ndarray import NDArray, array as nd_array
+from .resilience.faults import poison as _poison
 
 # native scan marks multipart logical records with the top bit of the length
 # (mxtpu_io.cc kMultipartBit)
@@ -110,14 +111,55 @@ def _init_data(data, allow_empty, default_name):
     return out
 
 
+def _first_float_nonfinite(arrs) -> bool:
+    """True iff any float-dtype array in ``arrs`` holds a NaN/Inf
+    (integer arrays cannot go non-finite and are skipped)."""
+    for d in arrs:
+        a = d.asnumpy() if isinstance(d, NDArray) else onp.asarray(d)
+        if onp.issubdtype(a.dtype, onp.floating) and \
+                not onp.isfinite(a).all():
+            return True
+    return False
+
+
+def _corrupt_batch(batch: DataBatch, value: float) -> bool:
+    """Splice ``value`` (NaN/Inf from the ``io.bad_batch`` fault site)
+    into the first float-dtype data array of ``batch``; no-op (False)
+    when the batch carries no float data to poison."""
+    for i, d in enumerate(batch.data):
+        a = d.asnumpy() if isinstance(d, NDArray) else onp.asarray(d)
+        if onp.issubdtype(a.dtype, onp.floating) and a.size:
+            a = a.copy()
+            a.flat[0] = value
+            batch.data[i] = nd_array(a)
+            return True
+    return False
+
+
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (parity: mx.io.NDArrayIter), with
-    pad/discard/roll_over last-batch handling."""
+    pad/discard/roll_over last-batch handling.
+
+    ``quarantine_nonfinite=True`` adds input-health quarantine
+    (docs/guardrails.md): each emitted batch's float data/labels are
+    checked host-side and a batch carrying NaN/Inf is SKIPPED and
+    counted (``.quarantined``) instead of being fed to the trainer —
+    the poisoned record never reaches the device, so the training-step
+    guardrails stay a second line of defense, not the first.  The
+    ``io.bad_batch`` fault site injects such batches for chaos tests.
+    Pass ``metrics=`` (a ServingMetrics, e.g. ``ResilientLoop.metrics``)
+    to ALSO export the count as ``quarantined_batches`` through the
+    shared ``stats()["resilience"]`` surface.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label", dtype=None):
+                 label_name="softmax_label", dtype=None,
+                 quarantine_nonfinite=False, metrics=None):
         super().__init__(batch_size)
+        self.quarantine_nonfinite = bool(quarantine_nonfinite)
+        self.quarantined = 0
+        self._metrics = metrics
         self.data = _init_data(data, False, data_name)
         self.label = _init_data(label, True, label_name)
         self.num_data = self.data[0][1].shape[0]
@@ -165,6 +207,23 @@ class NDArrayIter(DataIter):
             idx = onp.concatenate([self._cache_idx[self.cursor:],
                                    self._cache_idx[:end - self.num_data]])
         return [nd_array(onp.take(v, idx, axis=0)) for _, v in arrs]
+
+    def next(self) -> DataBatch:
+        while True:
+            if not self.iter_next():
+                raise StopIteration
+            batch = DataBatch(self.getdata(), self.getlabel(),
+                              pad=self.getpad(), index=self.getindex())
+            bad = _poison("io.bad_batch")
+            if bad is not None:
+                _corrupt_batch(batch, bad)
+            if self.quarantine_nonfinite and _first_float_nonfinite(
+                    list(batch.data) + list(batch.label)):
+                self.quarantined += 1
+                if self._metrics is not None:
+                    self._metrics.count("quarantined_batches")
+                continue             # skip the poisoned batch entirely
+            return batch
 
     def getdata(self):
         return self._take(self.data)

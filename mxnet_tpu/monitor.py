@@ -18,11 +18,24 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as onp
 
-__all__ = ["Monitor"]
+__all__ = ["Monitor", "nonfinite_stat"]
 
 
 def _default_stat(x) -> onp.ndarray:
     return onp.abs(x).sum() / x.size
+
+
+def nonfinite_stat(x) -> onp.ndarray:
+    """Fast-path health stat: the COUNT of non-finite (NaN/Inf) elements
+    — 0 for a clean tensor.  One vectorized ``isfinite`` pass, no
+    reductions beyond a popcount, so it is cheap enough to leave
+    installed while hunting the block that first emits a NaN
+    (docs/guardrails.md: provenance for a tripped guardrail)."""
+    x = onp.asarray(x)
+    if not onp.issubdtype(x.dtype, onp.floating) and \
+            not onp.issubdtype(x.dtype, onp.complexfloating):
+        return onp.int64(0)          # integer tensors cannot go non-finite
+    return onp.int64(x.size - onp.count_nonzero(onp.isfinite(x)))
 
 
 class Monitor:
@@ -68,9 +81,33 @@ class Monitor:
             except Exception:
                 pass               # stat errors must never kill the op
 
+    @classmethod
+    def nonfinite(cls, interval: int = 1, pattern: str = ".*",
+                  sort: bool = False) -> "Monitor":
+        """A Monitor preconfigured with :func:`nonfinite_stat`: every
+        matching op output reports its non-finite element count, so the
+        first entry with a non-zero stat names the block where a NaN/Inf
+        was born (run un-hybridized, like any Monitor session)."""
+        return cls(interval=interval, stat_func=nonfinite_stat,
+                   pattern=pattern, sort=sort)
+
+    def first_nonfinite(self, results=None):
+        """From a ``toc()`` result list (or the live queue), the first
+        ``(step, name, stat)`` whose stat is non-zero — the provenance
+        answer "which op went bad first" — or ``None`` if all clean."""
+        for entry in (self.queue if results is None else results):
+            if float(entry[2]) != 0.0:
+                return entry
+        return None
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
     def install(self):
         """Start observing (parity: executor set_monitor_callback /
-        Module.install_monitor calls this)."""
+        Module.install_monitor calls this).  Idempotent: a second
+        install never double-registers the hook."""
         from .ndarray import ops as _ops
         if not self._installed:
             _ops._invoke_hooks.append(self._hook)
@@ -78,6 +115,8 @@ class Monitor:
         return self
 
     def uninstall(self):
+        """Stop observing; exact inverse of :meth:`install` (idempotent,
+        leaves foreign hooks untouched)."""
         from .ndarray import ops as _ops
         if self._installed:
             _ops._invoke_hooks.remove(self._hook)

@@ -22,7 +22,7 @@ from .. import base as _base
 from .. import optimizer as opt_mod
 from .. import random as _random
 from ..ndarray import NDArray
-from ..resilience.faults import inject as _inject
+from ..resilience.faults import inject as _inject, poison as _poison
 from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
 from .sharding import (ShardingRules, batch_spec, logical_axes_of,
@@ -86,13 +86,35 @@ class ShardedTrainer:
         while the optimizer sees the full effective batch (the
         grad_req='add' accumulation idiom, compiled).  Batch dim must be
         divisible by grad_accum (and the microbatch by dp).
+    guard_nonfinite : compile the training-health guardrails into the
+        step (docs/guardrails.md): an ``all_finite`` flag over loss +
+        gradients is computed IN-GRAPH and the optimizer update is
+        applied through ``jnp.where`` selects, so a non-finite step
+        leaves params/aux/optimizer state bit-identical — no
+        ``lax.cond`` divergence, no recompile, no extra host sync.
+        ``step()`` then returns ``(loss, all_finite)`` (both lazy
+        NDArrays) instead of the bare loss.
+    clip_global_norm : optional in-graph global-norm gradient clipping
+        (the unscaled gradient's global L2 norm is capped at this value
+        before the update).  Implies the guarded step.
+    loss_scaler : an :class:`mxnet_tpu.amp.LossScaler` whose dynamic
+        schedule (init_scale / scale_factor / scale_window) is compiled
+        into the step: the loss is scaled in-graph, gradients unscaled
+        before clipping/update, and the scale shrinks on a non-finite
+        step / grows after ``scale_window`` consecutive finite ones —
+        all as traced scalars, so the scale changing never recompiles.
+        ``amp.init_trainer(trainer)`` attaches one for you.  Implies the
+        guarded step.
     """
 
     def __init__(self, net, optimizer, loss=None, optimizer_params=None,
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  data_specs=None, label_specs=None, seq_axis: Optional[int] = None,
-                 donate: bool = True, grad_accum: int = 1):
+                 donate: bool = True, grad_accum: int = 1,
+                 guard_nonfinite: bool = False,
+                 clip_global_norm: Optional[float] = None,
+                 loss_scaler=None):
         self.net = net
         self.loss = loss
         if grad_accum != int(grad_accum) or int(grad_accum) < 1:
@@ -113,6 +135,15 @@ class ShardedTrainer:
         self._label_specs = label_specs
         self._seq_axis = seq_axis
         self._donate = donate
+        self._guard_nonfinite = bool(guard_nonfinite)
+        if clip_global_norm is not None and clip_global_norm <= 0:
+            raise _base.MXNetError(
+                f"clip_global_norm must be > 0, got {clip_global_norm}")
+        self._clip_global_norm = clip_global_norm
+        self._loss_scaler = loss_scaler
+        self._amp_loss_scaler = loss_scaler   # amp duck-type parity
+        self._scale_arr = None     # traced loss-scale state (device)
+        self._good_arr = None      # consecutive-finite-step counter
         self._built = False
         self._step_fn = None
         self._trainable: List[Tuple[str, Any]] = []
@@ -122,6 +153,39 @@ class ShardedTrainer:
         self._state_shardings: List[NamedSharding] = []
         self._pending_states: Optional[dict] = None
         self._ckpt_managers: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- guardrails
+    @property
+    def _guarded(self) -> bool:
+        return (self._guard_nonfinite or self._loss_scaler is not None
+                or self._clip_global_norm is not None)
+
+    def attach_loss_scaler(self, scaler=None):
+        """Enable in-graph dynamic loss scaling (the guarded step) with
+        the given :class:`~mxnet_tpu.amp.LossScaler`'s schedule — what
+        ``amp.init_trainer`` calls.  Must run before the first
+        ``build()``/``step()``: the schedule compiles into the step."""
+        if self._built:
+            raise _base.MXNetError(
+                "attach_loss_scaler after the trainer is built: the "
+                "scale schedule compiles into the jitted step — attach "
+                "before the first build()/step()")
+        if scaler is None:
+            from .. import amp as _amp
+            scaler = _amp.LossScaler()
+        self._loss_scaler = scaler
+        self._amp_loss_scaler = scaler
+        return scaler
+
+    @property
+    def loss_scale(self) -> float:
+        """Current dynamic loss scale (syncs the device scalar; 1.0
+        when no scaler is attached)."""
+        if self._scale_arr is not None:
+            return float(self._scale_arr)
+        if self._loss_scaler is not None:
+            return float(self._loss_scaler.loss_scale)
+        return 1.0
 
     # ------------------------------------------------------------------
     def _build(self, data, labels):
@@ -224,6 +288,11 @@ class ShardedTrainer:
             st._rebind(_mesh_device_put(st.jax, sh))
         self._state_trees = [_flatten_state(st)[1] for st in self._states]
         self._state_counts = [len(_state_leaves(st)) for st in self._states]
+        if self._guarded:
+            init_scale = (self._loss_scaler.loss_scale
+                          if self._loss_scaler is not None else 1.0)
+            self._scale_arr = jnp.asarray(init_scale, jnp.float32)
+            self._good_arr = jnp.asarray(0, jnp.int32)
         self._compile(data, labels)
         self._built = True
         if self._pending_states is not None:
@@ -282,6 +351,14 @@ class ShardedTrainer:
                 swap_ctx.__exit__(None, None, None)
                 _random.pop_trace_key()
 
+        # NOTE: pure() and pure_guarded() below are deliberate near-twins.
+        # They are NOT folded into one function driven by constant guard
+        # inputs because the unguarded jaxpr must stay byte-identical
+        # across this change: it keys the persistent XLA compile cache for
+        # every existing unguarded run, and relying on XLA to fold away
+        # constant-predicate selects is a bet, not a guarantee.  A fix to
+        # the shared step logic (microbatch scan, optimizer state
+        # wrapping) must be applied to BOTH.
         def pure(param_vals, aux_vals, state_vals, batch_vals, key, lr, t):
             _random.push_trace_key(key)
             ctx = use_mesh(mesh)
@@ -351,7 +428,155 @@ class ShardedTrainer:
                 ctx.__exit__()
                 _random.pop_trace_key()
 
-        return pure
+        if not self._guarded:
+            return pure
+
+        has_scaler = self._loss_scaler is not None
+        scaler = self._loss_scaler
+        clip_norm = self._clip_global_norm
+
+        def pure_guarded(param_vals, aux_vals, state_vals, batch_vals, key,
+                         lr, t, scale, good, lpoison, gpoison):
+            """The guarded step: loss scaling, NaN/Inf injection splice
+            points, global-norm clipping, the in-graph ``all_finite``
+            flag, and a ``jnp.where``-masked optimizer update — one
+            straight-line XLA program (no ``lax.cond``: both arms of a
+            skip are trivially cheap selects, and a single program keeps
+            compile count and step time identical to the happy path).
+            Mirrors ``pure()`` above — keep shared step logic in sync
+            (see the NOTE there for why they are not merged)."""
+            _random.push_trace_key(key)
+            ctx = use_mesh(mesh)
+            ctx.__enter__()
+            try:
+                data_vals = tuple(batch_vals[:n_data])
+                label_vals = tuple(batch_vals[n_data:])
+
+                def scaled_loss(pv, aux_now, d, l, k):
+                    lval, aux_n = forward_loss(pv, aux_now, d, l, k)
+                    # loss poison splice: lpoison is 0.0 (finite → keep
+                    # the real loss) or NaN/Inf from the fault plan
+                    lval = jnp.where(jnp.isfinite(lpoison), lval,
+                                     lpoison.astype(lval.dtype))
+                    out = lval * scale.astype(lval.dtype) \
+                        if has_scaler else lval
+                    return out, (lval, aux_n)
+
+                if accum == 1:
+                    (_slval, (loss_val, new_aux)), grads = \
+                        jax.value_and_grad(
+                            lambda pv: scaled_loss(pv, aux_vals, data_vals,
+                                                   label_vals, key),
+                            has_aux=True)(tuple(param_vals))
+                else:
+                    def split_mb(v):
+                        return v.reshape(
+                            (accum, v.shape[0] // accum) + v.shape[1:])
+
+                    mb_data = tuple(split_mb(v) for v in data_vals)
+                    mb_labels = tuple(split_mb(v) for v in label_vals)
+                    keys = jax.random.split(key, accum)
+
+                    def body(carry, xs):
+                        aux_c, gacc, lacc = carry
+                        k_i, d_i, l_i = xs
+                        (_slv, (lv, aux_n)), g = jax.value_and_grad(
+                            lambda pv: scaled_loss(pv, aux_c, d_i, l_i,
+                                                   k_i),
+                            has_aux=True)(tuple(param_vals))
+                        gacc = tuple(
+                            a + b.astype(jnp.float32)
+                            for a, b in zip(gacc, g))
+                        return (aux_n, gacc,
+                                lacc + lv.astype(jnp.float32)), None
+
+                    g0 = tuple(jnp.zeros(v.shape, jnp.float32)
+                               for v in param_vals)
+                    carry0 = (tuple(aux_vals), g0,
+                              jnp.zeros((), jnp.float32))
+                    (new_aux, gsum, lsum), _ = jax.lax.scan(
+                        body, carry0, (keys, mb_data, mb_labels))
+                    grads = tuple(
+                        (g / accum).astype(v.dtype)
+                        for g, v in zip(gsum, param_vals))
+                    loss_val = lsum / accum
+
+                if has_scaler:       # unscale BEFORE clip/flag/update
+                    inv = 1.0 / scale
+                    grads = tuple(g * inv.astype(g.dtype) for g in grads)
+                # grad poison splice (same contract as lpoison)
+                grads = tuple(
+                    jnp.where(jnp.isfinite(gpoison), g,
+                              gpoison.astype(g.dtype))
+                    for g in grads)
+
+                all_finite = jnp.isfinite(loss_val)
+                for g in grads:
+                    all_finite = all_finite & jnp.all(jnp.isfinite(g))
+
+                if clip_norm is not None:
+                    gnorm = jnp.sqrt(sum(
+                        jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in grads))
+                    coef = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                    grads = tuple(g * coef.astype(g.dtype) for g in grads)
+
+                # zero the grads on a bad step so Inf*0 inside the
+                # optimizer can't mint fresh NaNs; the where-select on
+                # params/aux/state below is what makes the skip
+                # bit-identical
+                grads = tuple(
+                    jnp.where(all_finite, g, jnp.zeros_like(g))
+                    for g in grads)
+
+                new_params, new_states = [], []
+                with optimizer.traced(lr, t):
+                    off = 0
+                    for i, ((name, p), g) in enumerate(zip(trainable,
+                                                           grads)):
+                        w_nd = NDArray(param_vals[i])
+                        n = state_counts[i]
+                        old_states = state_vals[off:off + n]
+                        it = iter(old_states)
+                        st = _wrap_state(state_trees[i], it)
+                        off += n
+                        optimizer.update_multi_precision(
+                            i, w_nd, NDArray(g), st)
+                        new_params.append(
+                            jnp.where(all_finite, w_nd._data,
+                                      param_vals[i]))
+                        new_states.extend(
+                            jnp.where(all_finite, l._data, old)
+                            for l, old in zip(_state_leaves(st),
+                                              old_states))
+                new_aux = tuple(
+                    jnp.where(all_finite, a, old)
+                    for a, old in zip(new_aux, aux_vals))
+
+                if has_scaler:
+                    factor = jnp.float32(scaler._scale_factor)
+                    window = jnp.int32(scaler._scale_window)
+                    shrunk = jnp.maximum(scale / factor, 1.0)
+                    good_ok = good + 1
+                    grow = good_ok >= window
+                    grown = jnp.where(grow, scale * factor, scale)
+                    good_ok = jnp.where(grow, jnp.int32(0), good_ok)
+                    new_scale = jnp.where(all_finite, grown, shrunk)
+                    new_good = jnp.where(all_finite, good_ok,
+                                         jnp.int32(0))
+                else:
+                    new_scale = scale
+                    new_good = jnp.where(all_finite, good + 1,
+                                         jnp.int32(0))
+
+                return (loss_val, all_finite, new_scale, new_good,
+                        tuple(new_params), tuple(new_aux),
+                        tuple(new_states))
+            finally:
+                ctx.__exit__()
+                _random.pop_trace_key()
+
+        return pure_guarded
 
     # ------------------------------------------------------------------
     def _compile(self, data, labels):
@@ -384,12 +609,26 @@ class ShardedTrainer:
         # buffers (observed: NaN params, GC-time segfaults).  Same
         # gating the serving engine applies to its KV cache donation.
         donate = self._donate and jax.default_backend() != "cpu"
-        self._step_fn = jax.jit(
-            pure,
-            in_shardings=(param_sh, aux_sh, state_sh, data_sh + label_sh,
-                          scalar, scalar, scalar),
-            out_shardings=(scalar, param_sh, aux_sh, state_sh),
-            donate_argnums=(0, 1, 2) if donate else ())
+        if self._guarded:
+            # extra traced scalars: loss scale, consecutive-finite
+            # counter, and the two poison splice values — runtime
+            # inputs, so scale updates and fault injection never
+            # recompile (still exactly ONE compiled step function)
+            self._step_fn = jax.jit(
+                pure,
+                in_shardings=(param_sh, aux_sh, state_sh,
+                              data_sh + label_sh, scalar, scalar, scalar,
+                              scalar, scalar, scalar, scalar),
+                out_shardings=(scalar, scalar, scalar, scalar,
+                               param_sh, aux_sh, state_sh),
+                donate_argnums=(0, 1, 2) if donate else ())
+        else:
+            self._step_fn = jax.jit(
+                pure,
+                in_shardings=(param_sh, aux_sh, state_sh,
+                              data_sh + label_sh, scalar, scalar, scalar),
+                out_shardings=(scalar, param_sh, aux_sh, state_sh),
+                donate_argnums=(0, 1, 2) if donate else ())
 
     # ------------------------------------------------------------------
     def build(self, data, labels=()):
@@ -405,8 +644,19 @@ class ShardedTrainer:
             self._build(data, labels)
         return self
 
-    def step(self, data, labels=()) -> NDArray:
-        """Run one full training step; returns the (replicated) loss."""
+    def step(self, data, labels=()):
+        """Run one full training step.
+
+        Returns the (replicated, lazy) loss NDArray — or, when the
+        guardrails are compiled in (``guard_nonfinite`` /
+        ``clip_global_norm`` / an attached loss scaler), the pair
+        ``(loss, all_finite)``: ``all_finite`` is a lazy boolean
+        NDArray that is False iff this step's loss or gradients were
+        non-finite, in which case params/aux/optimizer state were left
+        bit-identical (the update was a no-op select) and the loss
+        scale was shrunk.  Neither return forces a device→host sync;
+        callers that don't read the flag pay nothing for it.
+        """
         _inject("trainer.step")
         if not isinstance(data, (tuple, list)):
             data = (data,)
@@ -434,8 +684,19 @@ class ShardedTrainer:
             for x, sh in zip(tuple(data) + tuple(labels),
                              self._batch_shardings))
 
-        loss, new_params, new_aux, new_states = self._step_fn(
-            param_vals, aux_vals, state_vals, batch_vals, key, lr, t)
+        if self._guarded:
+            lp = _poison("trainer.loss_nonfinite")
+            gp = _poison("trainer.grad_nonfinite")
+            lp = jnp.asarray(0.0 if lp is None else lp, jnp.float32)
+            gp = jnp.asarray(0.0 if gp is None else gp, jnp.float32)
+            (loss, flag, new_scale, new_good, new_params, new_aux,
+             new_states) = self._step_fn(
+                param_vals, aux_vals, state_vals, batch_vals, key, lr, t,
+                self._scale_arr, self._good_arr, lp, gp)
+            self._scale_arr, self._good_arr = new_scale, new_good
+        else:
+            loss, new_params, new_aux, new_states = self._step_fn(
+                param_vals, aux_vals, state_vals, batch_vals, key, lr, t)
 
         for (_, p), v in zip(self._trainable, new_params):
             p._data._rebind(v)
@@ -443,6 +704,8 @@ class ShardedTrainer:
             p._data._rebind(v)
         for l, v in zip(self._state_flat, new_states):
             l._rebind(v)
+        if self._guarded:
+            return NDArray(loss), NDArray(flag)
         return NDArray(loss)
 
     # ------------------------------------------------------------------
@@ -462,6 +725,11 @@ class ShardedTrainer:
                 "not exist yet (nothing to save)")
         data = {"num_update": _nd_array([self.optimizer.num_update],
                                         dtype="int64")}
+        if self._guarded:
+            data["loss_scale"] = _nd_array([self.loss_scale],
+                                           dtype="float32")
+            data["good_steps"] = _nd_array([int(self._good_arr)],
+                                           dtype="int64")
         for i, st in enumerate(self._states):
             for j, l in enumerate(_state_leaves(st)):
                 data[f"state_{i}_{j}"] = l
@@ -498,6 +766,13 @@ class ShardedTrainer:
         out: Dict[str, NDArray] = {
             "meta:num_update": _nd_array([self.optimizer.num_update],
                                          dtype="int64")}
+        if self._guarded:
+            # guard state rides the checkpoint so a resume/rewind also
+            # restores the dynamic loss scale and its grow counter
+            out["meta:loss_scale"] = _nd_array([self.loss_scale],
+                                               dtype="float32")
+            out["meta:good_steps"] = _nd_array([int(self._good_arr)],
+                                               dtype="int64")
         for i, (_n, p) in enumerate(self._trainable):
             out[f"param:{i}"] = p._data
         for i, (_n, p) in enumerate(self._aux):
@@ -550,6 +825,14 @@ class ShardedTrainer:
                                        self._state_shardings[i]))
         self.optimizer.num_update = int(
             d["meta:num_update"].asnumpy()[0])
+        if self._guarded:
+            # optional (a checkpoint from an unguarded run lacks them)
+            if "meta:loss_scale" in d:
+                self._scale_arr = jnp.asarray(
+                    float(d["meta:loss_scale"].asnumpy()[0]), jnp.float32)
+            if "meta:good_steps" in d:
+                self._good_arr = jnp.asarray(
+                    int(d["meta:good_steps"].asnumpy()[0]), jnp.int32)
 
     # -------------------------------------------------- sharded checkpoints
     def _checkpoint_tree(self):
@@ -584,6 +867,13 @@ class ShardedTrainer:
             m = cached[0]
         tree = self._checkpoint_tree()
         tree["num_update"] = jnp.asarray(self.optimizer.num_update, jnp.int32)
+        if self._guarded:
+            # the guard schedule is restorable state on EVERY checkpoint
+            # surface (state_dict carries meta:loss_scale/good_steps):
+            # resuming with a reset scale would overflow-skip until it
+            # re-shrinks
+            tree["loss_scale"] = jnp.asarray(self._scale_arr, jnp.float32)
+            tree["good_steps"] = jnp.asarray(self._good_arr, jnp.int32)
         m.save(step, tree)
         return m
 
@@ -602,6 +892,9 @@ class ShardedTrainer:
             cached[0].wait_until_finished()
         like = self._checkpoint_tree()
         like["num_update"] = jnp.asarray(0, jnp.int32)
+        if self._guarded:
+            like["loss_scale"] = jnp.asarray(0.0, jnp.float32)
+            like["good_steps"] = jnp.asarray(0, jnp.int32)
         m = CheckpointManager(directory, async_save=False)
         try:
             restored = m.restore(step, like=like)
@@ -614,10 +907,22 @@ class ShardedTrainer:
         for i, l in enumerate(self._state_flat):
             l._rebind(restored["states"][f"s{i}"])
         self.optimizer.num_update = int(restored["num_update"])
+        if self._guarded:
+            self._scale_arr = jnp.asarray(float(restored["loss_scale"]),
+                                          jnp.float32)
+            self._good_arr = jnp.asarray(int(restored["good_steps"]),
+                                         jnp.int32)
 
     def _apply_loaded_states(self, loaded):
         if "num_update" in loaded:
             self.optimizer.num_update = int(loaded["num_update"].asnumpy()[0])
+        if self._guarded:
+            if "loss_scale" in loaded:
+                self._scale_arr = jnp.asarray(
+                    float(loaded["loss_scale"].asnumpy()[0]), jnp.float32)
+            if "good_steps" in loaded:
+                self._good_arr = jnp.asarray(
+                    int(loaded["good_steps"].asnumpy()[0]), jnp.int32)
         flat_idx = 0
         for i, st in enumerate(self._states):
             for j, l in enumerate(_state_leaves(st)):

@@ -19,17 +19,19 @@ The faults layer is imported eagerly (hot paths need ``inject`` at
 module import); the heavier layers load lazily.
 """
 from .faults import (FaultPlan, FaultSpec, InjectedFault, RetryableFault,
-                     SimulatedPreemption, active_plan, inject)
+                     SimulatedPreemption, active_plan, inject, poison)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
-    "SimulatedPreemption", "active_plan", "inject",
-    "AtomicCheckpointer", "ResilientLoop", "Watchdog",
+    "SimulatedPreemption", "active_plan", "inject", "poison",
+    "AtomicCheckpointer", "ResilientLoop", "NonFiniteStepError",
+    "Watchdog",
 ]
 
 _LAZY = {
     "AtomicCheckpointer": ".checkpoint",
     "ResilientLoop": ".loop",
+    "NonFiniteStepError": ".loop",
     "Watchdog": ".watchdog",
 }
 

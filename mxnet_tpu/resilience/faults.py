@@ -18,7 +18,14 @@ fires whatever the plan registered for that hit:
   that models SIGKILL/host preemption: generic ``except Exception``
   recovery must NOT swallow it;
 - ``call_at``   — run an arbitrary callback (e.g. ``os.kill(os.getpid(),
-  SIGTERM)`` to exercise a real signal path at a deterministic step).
+  SIGTERM)`` to exercise a real signal path at a deterministic step);
+- ``nonfinite_at`` — *numeric* faults: instead of raising, the site's
+  :func:`poison` query returns NaN/Inf, which the caller splices into
+  its computation (``trainer.grad_nonfinite`` / ``trainer.loss_nonfinite``
+  poison gradients/loss inside the guarded training step,
+  ``io.bad_batch`` corrupts an input batch before iterator-level
+  quarantine).  The training-health guardrails (docs/guardrails.md)
+  must contain these exactly like ResilientLoop contains kills.
 
 Firing is deterministic: ``at=N`` fires on the Nth hit of the site
 (1-based), ``every=K`` on every Kth, and ``prob=p`` draws from a
@@ -39,7 +46,7 @@ from typing import Callable, List, Optional, Tuple
 from ..base import MXNetError
 
 __all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
-           "SimulatedPreemption", "inject", "active_plan"]
+           "SimulatedPreemption", "inject", "poison", "active_plan"]
 
 
 class InjectedFault(MXNetError):
@@ -65,14 +72,14 @@ class FaultSpec:
     """One registered fault: where, when, and what."""
 
     __slots__ = ("site", "action", "at", "every", "prob", "exc", "seconds",
-                 "fn", "max_fires", "fires")
+                 "fn", "value", "max_fires", "fires")
 
     def __init__(self, site: str, action: str, *, at: Optional[int] = None,
                  every: Optional[int] = None, prob: Optional[float] = None,
                  exc: Optional[BaseException] = None, seconds: float = 0.0,
-                 fn: Optional[Callable] = None,
+                 fn: Optional[Callable] = None, value: float = float("nan"),
                  max_fires: Optional[int] = None):
-        if action not in ("raise", "delay", "kill", "call"):
+        if action not in ("raise", "delay", "kill", "call", "corrupt"):
             raise ValueError(f"unknown fault action {action!r}")
         if sum(x is not None for x in (at, every, prob)) != 1:
             raise ValueError("exactly one of at=/every=/prob= must be set")
@@ -84,6 +91,7 @@ class FaultSpec:
         self.exc = exc
         self.seconds = seconds
         self.fn = fn
+        self.value = float(value)
         # `at` fires once by definition; recurring triggers default unbounded
         self.max_fires = 1 if at is not None and max_fires is None \
             else max_fires
@@ -173,15 +181,36 @@ class FaultPlan:
                                     prob=prob, fn=fn, max_fires=max_fires))
         return self
 
+    def nonfinite_at(self, site: str, *, at: Optional[int] = None,
+                     every: Optional[int] = None,
+                     prob: Optional[float] = None,
+                     value: float = float("nan"),
+                     max_fires: Optional[int] = None) -> "FaultPlan":
+        """Register a NUMERIC fault: the site's :func:`poison` query
+        returns ``value`` (NaN by default, ``float('inf')`` for overflow
+        storms) on the scheduled hits.  Unlike the raising actions this
+        never throws — the caller owns splicing the value into its
+        data/loss/gradients, which is what makes the fault land *inside*
+        the computation the guardrails must contain."""
+        if not (value != value or value in (float("inf"), float("-inf"))):
+            raise ValueError(
+                f"nonfinite_at needs a non-finite value, got {value!r}")
+        self.specs.append(FaultSpec(site, "corrupt", at=at, every=every,
+                                    prob=prob, value=value,
+                                    max_fires=max_fires))
+        return self
+
     # -------------------------------------------------------------- firing
     def fire(self, site: str):
         """Count a hit at ``site`` and execute whatever is due.  Called
-        from :func:`inject`; any thread."""
+        from :func:`inject`; any thread.  ``corrupt`` specs never fire
+        here — they are value queries, consumed via :func:`poison`."""
         with self._lock:
             hit = self.hits.get(site, 0) + 1
             self.hits[site] = hit
             due = [s for s in self.specs
-                   if s.site == site and s.should_fire(hit, self._rng)]
+                   if s.site == site and s.action != "corrupt"
+                   and s.should_fire(hit, self._rng)]
             for s in due:
                 s.fires += 1
                 self.log.append((site, hit, s.action))
@@ -204,6 +233,23 @@ class FaultPlan:
                 except Exception:
                     exc = s.exc
                 raise exc
+
+    def poison_value(self, site: str) -> Optional[float]:
+        """Count a hit at ``site`` and return the due ``corrupt`` value
+        (or ``None``).  The raising counterpart of :meth:`fire` for
+        numeric-fault sites; a site should be either raise-style or
+        poison-style, not both."""
+        with self._lock:
+            hit = self.hits.get(site, 0) + 1
+            self.hits[site] = hit
+            val = None
+            for s in self.specs:
+                if s.site == site and s.action == "corrupt" \
+                        and s.should_fire(hit, self._rng):
+                    s.fires += 1
+                    self.log.append((site, hit, "corrupt"))
+                    val = s.value
+        return val
 
     # -------------------------------------------------------------- scoping
     def __enter__(self) -> "FaultPlan":
@@ -240,3 +286,13 @@ def inject(site: str) -> None:
     plan = _ACTIVE
     if plan is not None:
         plan.fire(site)
+
+
+def poison(site: str) -> Optional[float]:
+    """Numeric-fault query hook: ``None`` normally; NaN/Inf when an
+    active plan has a due ``nonfinite_at`` spec for ``site``.  Same
+    zero-cost-when-disabled contract as :func:`inject`."""
+    plan = _ACTIVE
+    if plan is not None:
+        return plan.poison_value(site)
+    return None

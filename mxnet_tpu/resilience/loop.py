@@ -25,9 +25,20 @@ that survives the failure modes routine on preemptible TPU pods:
   process death;
 - **SIGTERM = preemption notice**: on the standard preemption signal the
   loop finishes the in-flight step, commits a final checkpoint, and
-  returns with ``report["preempted"] = True`` instead of dying dirty.
+  returns with ``report["preempted"] = True`` instead of dying dirty;
+- **bad-step policy** (``on_bad_step``): when the trainer runs with the
+  training-health guardrails compiled in (``step()`` returns
+  ``(loss, all_finite)`` — docs/guardrails.md), the loop reads the flag
+  and reacts to non-finite steps: ``"skip"`` (default) counts them and
+  moves on — the guarded trainer already left its state untouched;
+  ``"rewind"`` additionally restores the last committed checkpoint
+  after ``rewind_after`` CONSECUTIVE bad steps (escaping a poisoned
+  parameter region the skip alone can't); ``"raise"`` raises
+  :class:`NonFiniteStepError` at the first bad step (CI-style
+  fail-fast).
 
-Counters (``checkpoint_commits``, ``resumes``, ``retries``) land in a
+Counters (``checkpoint_commits``, ``resumes``, ``retries``,
+``bad_steps``, ``rewinds``) land in a
 :class:`~mxnet_tpu.serving.metrics.ServingMetrics` instance so training
 and serving resilience export through one stats surface.
 """
@@ -43,7 +54,15 @@ from .. import random as _random
 from .checkpoint import AtomicCheckpointer
 from .faults import RetryableFault
 
-__all__ = ["ResilientLoop"]
+__all__ = ["ResilientLoop", "NonFiniteStepError"]
+
+
+class NonFiniteStepError(_base.MXNetError):
+    """A guarded training step reported a non-finite loss/gradient and
+    the loop's ``on_bad_step`` policy chose to escalate (``"raise"``,
+    or ``"rewind"`` with no committed checkpoint to rewind to).  The
+    trainer's state is NOT poisoned — the guarded step skipped the
+    update before this was raised."""
 
 
 def _normalize_batch(batch) -> Tuple[tuple, tuple]:
@@ -82,16 +101,37 @@ class ResilientLoop:
         still holds, determinism doesn't).
     retryable : exception classes worth retrying (transient infra
         faults); anything else propagates immediately.
+    on_bad_step : ``"skip"`` | ``"rewind"`` | ``"raise"`` — reaction to
+        a guarded trainer reporting a non-finite step (trainers whose
+        ``step()`` returns a bare loss are unaffected).  ``"skip"``:
+        count it and continue (the guarded step already left state
+        bit-identical).  ``"rewind"``: after ``rewind_after``
+        consecutive bad steps, restore the last committed checkpoint
+        (params, optimizer state AND loss scale) and keep going — the
+        data stream continues FORWARD past the poisoned region
+        (replaying the same batches would just fail again).
+        ``"raise"``: raise :class:`NonFiniteStepError` immediately.
+        Reading the flag forces per-step device sync, which unguarded
+        runs don't pay.
+    rewind_after : consecutive-bad-step threshold for ``"rewind"``.
     """
 
     def __init__(self, trainer, directory, *, save_every: int = 1,
                  max_to_keep: Optional[int] = 5, max_retries: int = 3,
                  backoff: float = 0.05, backoff_factor: float = 2.0,
                  seed: Optional[int] = 0,
-                 retryable: tuple = (RetryableFault,), metrics=None):
+                 retryable: tuple = (RetryableFault,), metrics=None,
+                 on_bad_step: str = "skip", rewind_after: int = 3):
         if save_every < 1:
             raise _base.MXNetError(
                 f"save_every must be >= 1, got {save_every}")
+        if on_bad_step not in ("skip", "rewind", "raise"):
+            raise _base.MXNetError(
+                f"on_bad_step must be 'skip'|'rewind'|'raise', "
+                f"got {on_bad_step!r}")
+        if rewind_after < 1:
+            raise _base.MXNetError(
+                f"rewind_after must be >= 1, got {rewind_after}")
         self.trainer = trainer
         self.checkpointer = AtomicCheckpointer(directory,
                                                max_to_keep=max_to_keep)
@@ -101,6 +141,8 @@ class ResilientLoop:
         self.backoff_factor = float(backoff_factor)
         self.seed = seed
         self.retryable = tuple(retryable)
+        self.on_bad_step = on_bad_step
+        self.rewind_after = int(rewind_after)
         if metrics is None:
             from ..serving.metrics import ServingMetrics
             metrics = ServingMetrics("resilience")
@@ -180,7 +222,9 @@ class ResilientLoop:
         total global step count (not steps-remaining).
 
         Returns a report dict: ``completed_steps``, ``resumed_from``,
-        ``preempted``, ``retries``, ``final_loss``.
+        ``preempted``, ``retries``, ``final_loss``, ``bad_steps``,
+        ``rewinds`` (the latter two only move when the trainer runs
+        with guardrails compiled in).
         """
         if (make_iter is None) == (batch_fn is None):
             raise _base.MXNetError(
@@ -188,8 +232,11 @@ class ResilientLoop:
         if steps is None or steps < 0:
             raise _base.MXNetError(f"steps must be >= 0, got {steps}")
         report = {"completed_steps": 0, "resumed_from": None,
-                  "preempted": False, "retries": 0, "final_loss": None}
+                  "preempted": False, "retries": 0, "final_loss": None,
+                  "bad_steps": 0, "rewinds": 0}
         retries_before = self.metrics.counters.get("retries", 0)
+        bad_before = self.metrics.counters.get("bad_steps", 0)
+        rewinds_before = self.metrics.counters.get("rewinds", 0)
         start = 0
         latest = self.checkpointer.latest_step()
         if latest is not None:
@@ -221,13 +268,20 @@ class ResilientLoop:
         self._stop_requested = False
         installed = self._install_sigterm()
         loss = None
+        consecutive_bad = 0
         try:
             step = start
             while step < steps:
                 batch = batch_fn(step) if batch_fn is not None else next(it)
                 data, labels = _normalize_batch(batch)
                 self._ensure_built(data, labels)
-                loss = self._step_with_retry(step, data, labels)
+                result = self._step_with_retry(step, data, labels)
+                if isinstance(result, tuple):   # guarded: (loss, flag)
+                    loss, flag = result
+                    consecutive_bad = self._handle_bad_step(
+                        flag, consecutive_bad, step)
+                else:
+                    loss = result
                 step += 1
                 # read the flag ONCE per boundary: a SIGTERM landing
                 # between a commit-check and a break-check must not
@@ -250,4 +304,37 @@ class ResilientLoop:
                 report["final_loss"] = None
         report["retries"] = \
             self.metrics.counters.get("retries", 0) - retries_before
+        report["bad_steps"] = \
+            self.metrics.counters.get("bad_steps", 0) - bad_before
+        report["rewinds"] = \
+            self.metrics.counters.get("rewinds", 0) - rewinds_before
         return report
+
+    # ------------------------------------------------------ bad-step policy
+    def _handle_bad_step(self, flag, consecutive_bad: int,
+                         step: int) -> int:
+        """Apply ``on_bad_step`` to one guarded step's finite-flag;
+        returns the updated consecutive-bad counter.  Reading the flag
+        is the policy's (only) per-step device sync."""
+        if bool(flag.asnumpy()):
+            return 0
+        self.metrics.count("bad_steps")
+        consecutive_bad += 1
+        if self.on_bad_step == "raise":
+            raise NonFiniteStepError(
+                f"non-finite loss/gradients at step {step} "
+                "(on_bad_step='raise'); the update was skipped, "
+                "trainer state is intact")
+        if self.on_bad_step == "rewind" and \
+                consecutive_bad >= self.rewind_after:
+            latest = self.checkpointer.latest_step()
+            if latest is None:
+                raise NonFiniteStepError(
+                    f"{consecutive_bad} consecutive non-finite steps "
+                    f"by step {step} and no committed checkpoint to "
+                    "rewind to (on_bad_step='rewind')")
+            tree, _meta = self.checkpointer.restore(latest)
+            self.trainer.load_state_dict(tree)
+            self.metrics.count("rewinds")
+            return 0
+        return consecutive_bad

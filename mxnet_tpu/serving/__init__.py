@@ -20,7 +20,8 @@ Quick start::
 from .batcher import BucketLattice, DynamicBatcher
 from .engine import InferenceEngine, InferenceFuture, Request
 from .errors import (DeadlineExceededError, EngineCrashedError,
-                     EngineStoppedError, InvalidRequestError, QueueFullError,
+                     EngineStoppedError, InvalidRequestError,
+                     NonFiniteOutputError, QueueFullError,
                      RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
@@ -32,5 +33,5 @@ __all__ = [
     "LatencyHistogram", "ServingMetrics",
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "DeadlineExceededError", "EngineStoppedError", "EngineCrashedError",
-    "InvalidRequestError",
+    "InvalidRequestError", "NonFiniteOutputError",
 ]

@@ -54,8 +54,8 @@ import numpy as onp
 from ..resilience.faults import RetryableFault, inject as _inject
 from .batcher import BucketLattice, DynamicBatcher
 from .errors import (EngineCrashedError, EngineStoppedError,
-                     InvalidRequestError, QueueFullError,
-                     RequestTimeoutError, ServingError)
+                     InvalidRequestError, NonFiniteOutputError,
+                     QueueFullError, RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import ServingMetrics
 
@@ -155,6 +155,13 @@ class InferenceEngine:
     max_request_retries : per-request budget for retryable step faults
         (transient infra errors / injected ``RetryableFault``).
     retry_backoff : sleep before a step retry (doubles per attempt).
+    guard_nonfinite : fail a request whose model output went NaN/Inf
+        with :class:`NonFiniteOutputError` instead of returning garbage
+        tokens (decode: a per-row ``isfinite(logits)`` flag computed
+        IN-GRAPH next to the argmax, so it costs no extra device→host
+        sync; forward: a host-side check of the already-fetched rows).
+        The engine keeps serving — one poisoned request never condemns
+        the batch or trips the watchdog.
     """
 
     def __init__(self, net, mode: Optional[str] = None, *,
@@ -171,6 +178,7 @@ class InferenceEngine:
                  watchdog_interval: float = 0.1,
                  max_request_retries: int = 2,
                  retry_backoff: float = 0.01,
+                 guard_nonfinite: bool = True,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -214,6 +222,7 @@ class InferenceEngine:
                                          max_batch=self.max_batch)
             self._alloc = None
 
+        self.guard_nonfinite = bool(guard_nonfinite)
         self.hang_timeout = hang_timeout
         self.watchdog_interval = float(watchdog_interval)
         self.max_request_retries = int(max_request_retries)
@@ -246,14 +255,31 @@ class InferenceEngine:
 
         net = self.net
         if self.mode == "decode":
+            guard = self.guard_nonfinite
+
+            def row_ok(logits_jax):
+                # per-row health flag, computed IN-GRAPH next to the
+                # argmax: a NaN/Inf logit row fails ITS request typed
+                # instead of silently emitting an argmax over garbage.
+                # Reduced over every non-row axis so (B, V) and
+                # (B, T, V) logits both yield a (B,) flag.
+                axes = tuple(range(1, logits_jax.ndim))
+                return jnp.all(jnp.isfinite(logits_jax), axis=axes)
+
             def prefill(toks, lens, caches, sidx):
                 logits, c = net.prefill_slots(NDArray(toks), lens, caches,
                                               sidx)
-                return jnp.argmax(logits.jax, -1).astype(jnp.int32), c
+                ok = row_ok(logits.jax) if guard else \
+                    jnp.ones((logits.jax.shape[0],), jnp.bool_)
+                return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
+                        ok, c)
 
             def step(tok, caches, pos):
                 logits, c = net.decode_step(NDArray(tok), caches, pos)
-                return jnp.argmax(logits.jax, -1).astype(jnp.int32), c
+                ok = row_ok(logits.jax) if guard else \
+                    jnp.ones((logits.jax.shape[0],), jnp.bool_)
+                return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
+                        ok, c)
 
             self._items, pure_prefill = make_pure_fn(net, prefill)
             _, pure_step = make_pure_fn(net, step)
@@ -641,7 +667,7 @@ class InferenceEngine:
                 self._ensure_caches()
                 s1 = self.num_slots + 1
                 zeros = jnp.zeros((s1,), jnp.int32)
-                _, self._caches = self._counted(
+                _, _ok, self._caches = self._counted(
                     ("decode",), self._jit_step, params, zeros,
                     self._caches, zeros)
                 scratch = self._alloc.scratch
@@ -649,7 +675,7 @@ class InferenceEngine:
                     toks = jnp.zeros((bb, tb), jnp.int32)
                     lens = jnp.ones((bb,), jnp.int32)
                     sidx = jnp.full((bb,), scratch, jnp.int32)
-                    _, self._caches = self._counted(
+                    _, _ok, self._caches = self._counted(
                         ("prefill", bb, tb), self._jit_prefill, params,
                         toks, lens, self._caches, sidx)
             else:
@@ -844,14 +870,40 @@ class InferenceEngine:
         self.metrics.count("prefill_batches")
         self.metrics.mark("admit", len(group))
         self._ensure_caches()
-        first, self._caches = self._run_step(
+        first, ok, self._caches = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
              self._caches, jnp.asarray(sidx)), group)
         first = onp.asarray(first)
+        ok = onp.asarray(ok)
         for i, st in enumerate(states):
+            if self.guard_nonfinite and not ok[i]:
+                self._fail_nonfinite(int(sidx[i]), st, "prefill")
+                continue
             st.advance(int(first[i]))
             self._finish_if_done(int(sidx[i]), st)
+
+    def _fail_nonfinite(self, slot: int, st: SlotState, where: str):
+        """One request's logits went NaN/Inf: free its slot and fail it
+        typed.  Contained per-request — the rest of the batch, the
+        scheduler, and the watchdog are untouched.
+
+        The slot's cache row must be SCRUBBED: a NaN step has already
+        written NaN K/V into the row, and unlike the stale-but-finite
+        garbage a normal free leaves (which the causal mask renders
+        harmless), NaN survives additive masking — ``-inf + NaN`` is
+        NaN — so a later tenant of the row would be poisoned through
+        positions it never wrote."""
+        self._alloc.free(slot)
+        if self._caches is not None:
+            import jax
+            self._caches = jax.tree_util.tree_map(
+                lambda a: a.at[slot].set(0), self._caches)
+        self.metrics.count("nonfinite_outputs")
+        self._fail(st.request, NonFiniteOutputError(
+            f"request {st.request.id}: non-finite logits in {where} "
+            f"after {len(st.generated)} generated tokens — the model "
+            "produced NaN/Inf for this input"))
 
     def _finish_if_done(self, slot: int, st: SlotState):
         if st.done or (st.request.eos_id is not None
@@ -870,13 +922,17 @@ class InferenceEngine:
             tok[slot] = st.last_token
             pos[slot] = st.pos
         self.metrics.count("decode_steps")
-        nxt, self._caches = self._run_step(
+        nxt, ok, self._caches = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
              jnp.asarray(pos)),
             [st.request for _, st in alloc.items()])
         nxt = onp.asarray(nxt)
+        ok = onp.asarray(ok)
         for slot, st in alloc.items():
+            if self.guard_nonfinite and not ok[slot]:
+                self._fail_nonfinite(slot, st, "decode")
+                continue
             st.advance(int(nxt[slot]))
             self._finish_if_done(slot, st)
 
@@ -923,6 +979,14 @@ class InferenceEngine:
             self._inflight_fwd = ()
         done = time.monotonic()
         for i, r in enumerate(live):
+            if self.guard_nonfinite and any(
+                    onp.issubdtype(o.dtype, onp.floating)
+                    and not onp.isfinite(o[i]).all() for o in outs):
+                self.metrics.count("nonfinite_outputs")
+                self._fail(r, NonFiniteOutputError(
+                    f"request {r.id}: non-finite forward output — the "
+                    "model produced NaN/Inf for this input"))
+                continue
             res = outs[0][i] if self._fwd_single else \
                 tuple(o[i] for o in outs)
             self.metrics.observe_request(r.t_schedule - r.t_submit,

@@ -16,7 +16,8 @@ from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
            "DeadlineExceededError", "EngineStoppedError",
-           "EngineCrashedError", "InvalidRequestError"]
+           "EngineCrashedError", "InvalidRequestError",
+           "NonFiniteOutputError"]
 
 
 class ServingError(MXNetError):
@@ -55,3 +56,12 @@ class InvalidRequestError(ServingError):
     """The request can never be served by this engine configuration
     (e.g. prompt longer than the largest sequence bucket, or
     prompt + max_new_tokens exceeding the KV cache length)."""
+
+
+class NonFiniteOutputError(ServingError):
+    """The model produced NaN/Inf for THIS request (non-finite logits
+    in a prefill/decode step, or a non-finite forward output row).  The
+    request fails typed, its KV slot is freed, and the engine keeps
+    serving the rest of the batch — a numerics fault in one request's
+    data must not read as an engine crash or trip the watchdog
+    (docs/guardrails.md)."""

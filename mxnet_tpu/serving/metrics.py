@@ -99,7 +99,13 @@ class ServingMetrics:
                  # resilience: transient-step retries, watchdog
                  # condemnations, atomic checkpoint commits, resumes
                  "retries", "watchdog_trips", "checkpoint_commits",
-                 "resumes")
+                 "resumes",
+                 # training-health guardrails (docs/guardrails.md):
+                 # skipped non-finite training steps, checkpoint
+                 # rewinds, quarantined input batches, and per-request
+                 # non-finite serving outputs
+                 "bad_steps", "rewinds", "quarantined_batches",
+                 "nonfinite_outputs")
 
     def __init__(self, name: str = "serving"):
         self.name = name
@@ -158,6 +164,9 @@ class ServingMetrics:
             },
             "resilience": {k: c[k] for k in
                            ("retries", "watchdog_trips",
-                            "checkpoint_commits", "resumes")},
+                            "checkpoint_commits", "resumes",
+                            "bad_steps", "rewinds",
+                            "quarantined_batches",
+                            "nonfinite_outputs")},
             "latency": lat,
         }

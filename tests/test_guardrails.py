@@ -1,0 +1,543 @@
+"""Training-health guardrails (docs/guardrails.md).
+
+THE guardrail contract: a non-finite step is *contained*, not fatal —
+detection is fused into the compiled training step (an ``all_finite``
+flag over loss + gradients, update applied through ``jnp.where``
+selects), so an injected NaN step leaves params/optimizer state
+bit-identical, halves the dynamic loss scale, is counted by
+``ResilientLoop``, and training then converges anyway.  Around the
+trainer: iterator-level bad-batch quarantine, Monitor NaN provenance,
+and the serving engine's per-request ``NonFiniteOutputError``.
+"""
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, nd
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.monitor import Monitor, nonfinite_stat
+from mxnet_tpu.resilience import (FaultPlan, NonFiniteStepError,
+                                  ResilientLoop)
+from mxnet_tpu.serving import InferenceEngine, NonFiniteOutputError
+
+# ---------------------------------------------------------------- fixtures
+
+
+def _make_mesh():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs multi-device mesh (conftest forces 8 cpu)")
+    return par.make_mesh(dp=2, devices=jax.devices()[:2])
+
+
+_W1 = onp.random.RandomState(42).randn(16, 6).astype("float32") * 0.1
+_W2 = onp.random.RandomState(43).randn(2, 16).astype("float32") * 0.1
+
+
+def _make_trainer(**kw):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(2, in_units=16))
+    net.initialize()
+    net[0].weight.set_data(nd.array(_W1))
+    net[0].bias.set_data(nd.array(onp.zeros(16, "float32")))
+    net[1].weight.set_data(nd.array(_W2))
+    net[1].bias.set_data(nd.array(onp.zeros(2, "float32")))
+    return par.ShardedTrainer(
+        net, "adam", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer_params={"learning_rate": 0.01}, **kw)
+
+
+def _batch(seed=0, n=8):
+    rs = onp.random.RandomState(seed)
+    X = rs.randn(n, 6).astype("float32")
+    y = (X.sum(1) > 0).astype("int32")
+    return nd.array(X), nd.array(y)
+
+
+def _snapshot(tr):
+    return ([p.data().asnumpy().copy() for _, p in tr._trainable],
+            [l.asnumpy().copy() for l in tr._state_flat])
+
+
+# ------------------------------------------------ the guardrail contract
+
+
+@pytest.mark.chaos
+def test_nonfinite_grad_step_is_bit_identical_noop(tmp_path):
+    """Acceptance: with ``trainer.grad_nonfinite`` injected at step N,
+    params AND optimizer state after step N are bit-identical to after
+    step N-1, the loss scale is halved, the flag reads False, and
+    training resumes."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler(
+            init_scale=2.0 ** 16, scale_factor=2.0, scale_window=2000))
+        X, y = _batch()
+        for _ in range(3):
+            loss, flag = tr.step(X, y)
+            assert bool(flag.asnumpy())
+        assert tr.loss_scale == 2.0 ** 16
+        params_before, states_before = _snapshot(tr)
+        num_update_before = tr.optimizer.num_update
+
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=1):
+            loss, flag = tr.step(X, y)
+        assert not bool(flag.asnumpy())
+        params_after, states_after = _snapshot(tr)
+        for a, b in zip(params_before, params_after):
+            onp.testing.assert_array_equal(a, b)      # bit-identical
+        for a, b in zip(states_before, states_after):
+            onp.testing.assert_array_equal(a, b)
+        assert tr.loss_scale == 2.0 ** 15             # halved
+        # a skipped step still advances the host step counter (MXNet
+        # AMP semantics): only the state update was masked
+        assert tr.optimizer.num_update == num_update_before + 1
+
+        loss, flag = tr.step(X, y)                    # resumes cleanly
+        assert bool(flag.asnumpy())
+        assert onp.isfinite(loss.asnumpy()).all()
+
+
+@pytest.mark.chaos
+def test_nonfinite_loss_site_and_inf_value(tmp_path):
+    """``trainer.loss_nonfinite`` poisons the loss (the flag must catch
+    it even with finite grads... the scaled-loss backprop propagates the
+    NaN, either way the step is a no-op); inf injection works too."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(guard_nonfinite=True)
+        X, y = _batch()
+        tr.step(X, y)
+        params_before, states_before = _snapshot(tr)
+        with FaultPlan().nonfinite_at("trainer.loss_nonfinite", at=1,
+                                      value=float("inf")):
+            loss, flag = tr.step(X, y)
+        assert not bool(flag.asnumpy())
+        assert not onp.isfinite(loss.asnumpy()).all()
+        params_after, states_after = _snapshot(tr)
+        for a, b in zip(params_before + states_before,
+                        params_after + states_after):
+            onp.testing.assert_array_equal(a, b)
+
+
+def test_loss_scale_grows_on_schedule():
+    """scale_window consecutive finite steps double the scale — the
+    LossScaler schedule, compiled in-graph."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler(
+            init_scale=1024.0, scale_factor=2.0, scale_window=3))
+        X, y = _batch()
+        for i in range(3):
+            tr.step(X, y)
+        assert tr.loss_scale == 2048.0
+        for i in range(3):
+            tr.step(X, y)
+        assert tr.loss_scale == 4096.0
+
+
+def test_clip_global_norm_caps_update():
+    """In-graph global-norm clipping: with a tiny cap, one SGD step
+    moves the params by at most lr * cap (plus fp slack)."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(clip_global_norm=1e-3)
+        tr.optimizer = mx.optimizer.create("sgd", learning_rate=1.0)
+        X, y = _batch()
+        before, _ = _snapshot(tr)
+        loss, flag = tr.step(X, y)
+        assert bool(flag.asnumpy())
+        after, _ = _snapshot(tr)
+        delta = onp.sqrt(sum(
+            ((a - b) ** 2).sum() for a, b in zip(before, after)))
+        assert delta <= 1e-3 * 1.1, delta
+
+
+def test_step_return_contract():
+    """Unguarded step() returns the bare loss (unchanged contract);
+    any guardrail option switches it to (loss, all_finite)."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        X, y = _batch()
+        plain = _make_trainer()
+        out = plain.step(X, y)
+        assert isinstance(out, mx.nd.NDArray)
+        guarded = _make_trainer(guard_nonfinite=True)
+        out = guarded.step(X, y)
+        assert isinstance(out, tuple) and len(out) == 2
+        loss, flag = out
+        assert loss.shape == () and flag.shape == ()
+        # grad_accum composes with the guard (scan path)
+        accum = _make_trainer(guard_nonfinite=True, grad_accum=2)
+        loss, flag = accum.step(X, y)
+        assert bool(flag.asnumpy())
+
+
+def test_guard_state_rides_state_dict(tmp_path):
+    """loss scale + grow counter checkpoint and restore on EVERY
+    checkpoint surface (state_dict, orbax save/load_checkpoint,
+    save/load_states) — what makes a rewind/resume restore the
+    schedule, not just the params."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler(init_scale=512.0))
+        X, y = _batch()
+        tr.step(X, y)
+        sd = tr.state_dict()
+        assert "meta:loss_scale" in sd and "meta:good_steps" in sd
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=1):
+            tr.step(X, y)
+        assert tr.loss_scale == 256.0
+        tr.load_state_dict(sd)
+        assert tr.loss_scale == 512.0
+
+        # orbax sharded-checkpoint path restores the schedule too
+        m = tr.save_checkpoint(str(tmp_path / "ck"), step=1,
+                               async_save=False)
+        m.wait_until_finished()
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=1):
+            tr.step(X, y)
+        assert tr.loss_scale == 256.0
+        tr.load_checkpoint(str(tmp_path / "ck"))
+        assert tr.loss_scale == 512.0
+
+        # legacy optimizer-states file path
+        tr.save_states(str(tmp_path / "states.mxtpu"))
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=1):
+            tr.step(X, y)
+        assert tr.loss_scale == 256.0
+        tr.load_states(str(tmp_path / "states.mxtpu"))
+        assert tr.loss_scale == 512.0
+
+
+# ------------------------------------------------- ResilientLoop policies
+
+
+def _loop_iter():
+    def gen():
+        for i in range(100):
+            rs = onp.random.RandomState(1000 + i)
+            X = rs.randn(8, 6).astype("float32")
+            yield (nd.array(X), nd.array((X.sum(1) > 0).astype("int32")))
+    return gen()
+
+
+@pytest.mark.chaos
+def test_resilient_loop_counts_and_skips_bad_steps(tmp_path):
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler())
+        loop = ResilientLoop(tr, str(tmp_path / "skip"), save_every=2,
+                             seed=7)
+        plan = (FaultPlan()
+                .nonfinite_at("trainer.grad_nonfinite", at=3)
+                .nonfinite_at("trainer.grad_nonfinite", at=5))
+        with plan:
+            report = loop.run(_loop_iter, 8)
+        assert report["completed_steps"] == 8
+        assert report["bad_steps"] == 2
+        assert report["rewinds"] == 0
+        assert loop.metrics.counters["bad_steps"] == 2
+        assert loop.metrics.stats()["resilience"]["bad_steps"] == 2
+
+
+@pytest.mark.chaos
+def test_resilient_loop_rewind_policy(tmp_path):
+    """rewind_after consecutive bad steps → restore the last committed
+    checkpoint and keep going (data stream continues forward)."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler())
+        loop = ResilientLoop(tr, str(tmp_path / "rw"), save_every=2,
+                             seed=7, on_bad_step="rewind", rewind_after=2)
+        plan = FaultPlan()
+        for hit in (5, 6, 7, 8):
+            plan.nonfinite_at("trainer.grad_nonfinite", at=hit)
+        with plan:
+            report = loop.run(_loop_iter, 10)
+        assert report["completed_steps"] == 10
+        assert report["bad_steps"] == 4
+        assert report["rewinds"] == 2
+        assert all(onp.isfinite(p.data().asnumpy()).all()
+                   for _, p in tr._trainable)
+
+        # rewind with NO committed checkpoint escalates typed
+        tr2 = _make_trainer(guard_nonfinite=True)
+        loop2 = ResilientLoop(tr2, str(tmp_path / "rw2"), save_every=100,
+                              seed=7, on_bad_step="rewind",
+                              rewind_after=1)
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=1):
+            with pytest.raises(NonFiniteStepError):
+                loop2.run(_loop_iter, 4)
+
+
+@pytest.mark.chaos
+def test_resilient_loop_raise_policy(tmp_path):
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(guard_nonfinite=True)
+        loop = ResilientLoop(tr, str(tmp_path / "rs"), seed=1,
+                             on_bad_step="raise")
+        with FaultPlan().nonfinite_at("trainer.grad_nonfinite", at=2):
+            with pytest.raises(NonFiniteStepError):
+                loop.run(_loop_iter, 6)
+        with pytest.raises(mx.MXNetError):
+            ResilientLoop(tr, str(tmp_path / "x"), on_bad_step="bogus")
+
+
+@pytest.mark.chaos
+def test_guarded_training_converges_through_nan_storm(tmp_path):
+    """End-to-end: guardrails enabled, NaN gradients injected at three
+    steps — training still converges on the separable toy task (the
+    convergence bar with faults ON)."""
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer(loss_scaler=amp.LossScaler())
+        loop = ResilientLoop(tr, str(tmp_path / "conv"), save_every=10,
+                             seed=11)
+        plan = FaultPlan()
+        for hit in (4, 11, 23):
+            plan.nonfinite_at("trainer.grad_nonfinite", at=hit)
+        with plan:
+            report = loop.run(_loop_iter, 60)
+        assert report["completed_steps"] == 60
+        assert report["bad_steps"] == 3
+        # accuracy on fresh data: the model actually learned (forward
+        # in numpy — params live sharded on the mesh)
+        rs = onp.random.RandomState(999)
+        X = rs.randn(256, 6).astype("float32")
+        y = (X.sum(1) > 0).astype(onp.int64)
+        w1, b1, w2, b2 = [p.data().asnumpy() for _, p in tr._trainable]
+        h = onp.maximum(X @ w1.T + b1, 0.0)
+        pred = (h @ w2.T + b2).argmax(axis=1)
+        acc = (pred == y).mean()
+        assert acc > 0.9, acc
+
+
+# --------------------------------------------------------------- Monitor
+
+
+def test_monitor_install_uninstall_roundtrip():
+    from mxnet_tpu.ndarray import ops as _ops
+    n_before = len(_ops._invoke_hooks)
+    m = Monitor()
+    assert not m.installed
+    m.install()
+    m.install()                      # idempotent: no double-register
+    assert m.installed
+    assert len(_ops._invoke_hooks) == n_before + 1
+    m.uninstall()
+    m.uninstall()                    # idempotent
+    assert not m.installed
+    assert len(_ops._invoke_hooks) == n_before
+    # context-manager form restores too
+    with Monitor():
+        assert len(_ops._invoke_hooks) == n_before + 1
+    assert len(_ops._invoke_hooks) == n_before
+
+
+def test_monitor_nonfinite_stat_localizes_nan():
+    """The fast-path non-finite stat names the block where the NaN was
+    born: clean first layer, poisoned second layer."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    w = net[1].weight.data().asnumpy().copy()
+    w[0, 0] = onp.nan
+    net[1].weight.set_data(nd.array(w))
+
+    assert int(nonfinite_stat(onp.ones(4))) == 0
+    assert int(nonfinite_stat(onp.array([1.0, onp.nan, onp.inf]))) == 2
+    assert int(nonfinite_stat(onp.arange(3))) == 0       # ints are clean
+
+    m = Monitor.nonfinite()
+    m.install()
+    try:
+        m.tic()
+        X = nd.array(onp.random.RandomState(0).randn(2, 6)
+                     .astype("float32"))
+        net(X)                        # eager (un-hybridized): observable
+        results = m.toc()
+    finally:
+        m.uninstall()
+    assert results, "monitor recorded nothing"
+    first_bad = m.first_nonfinite(results)
+    assert first_bad is not None
+    # the first Dense (FullyConnected0 + Activation0) is clean; the NaN
+    # is born in the SECOND Dense's FullyConnected
+    assert first_bad[1].startswith("FullyConnected"), first_bad
+    assert first_bad[1] != "FullyConnected0"
+    clean = [r for r in results if r[1] in ("FullyConnected0",
+                                            "Activation0")]
+    assert clean and all(float(r[2]) == 0 for r in clean)
+
+
+# ------------------------------------------------------------- quarantine
+
+
+@pytest.mark.chaos
+def test_ndarray_iter_quarantines_bad_batches():
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    metrics = ServingMetrics("resilience")
+    X = onp.random.RandomState(1).randn(24, 4).astype("float32")
+    y = onp.arange(24).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=4, quarantine_nonfinite=True,
+                           last_batch_handle="discard", metrics=metrics)
+    with FaultPlan().nonfinite_at("io.bad_batch", at=2):
+        batches = list(it)
+    assert len(batches) == 5 and it.quarantined == 1
+    assert metrics.stats()["resilience"]["quarantined_batches"] == 1
+    for b in batches:
+        assert onp.isfinite(b.data[0].asnumpy()).all()
+
+    # naturally-poisoned data is quarantined too (no fault plan)
+    Xn = X.copy()
+    Xn[5, 2] = onp.inf                # lands in batch 1
+    it2 = mx.io.NDArrayIter(Xn, y, batch_size=4,
+                            quarantine_nonfinite=True,
+                            last_batch_handle="discard")
+    batches = list(it2)
+    assert len(batches) == 5 and it2.quarantined == 1
+
+    # quarantine off: the bad batch flows through (guard's job then)
+    it3 = mx.io.NDArrayIter(Xn, y, batch_size=4,
+                            last_batch_handle="discard")
+    assert len(list(it3)) == 6 and it3.quarantined == 0
+
+
+# ----------------------------------------------------------- serving guard
+
+
+@pytest.fixture(scope="module")
+def gpt2_net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                 num_heads=2, max_length=32, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def test_serving_forward_nonfinite_fails_one_request():
+    dense = nn.Dense(4, in_units=8)
+    dense.initialize()
+    eng = InferenceEngine(dense, max_batch=2)
+    clean = onp.random.RandomState(0).randn(8).astype("float32")
+    bad = clean.copy()
+    bad[3] = onp.nan
+    with eng:
+        assert eng.infer(clean).shape == (4,)
+        with pytest.raises(NonFiniteOutputError):
+            eng.infer(bad)
+        # engine keeps serving: one poisoned request ≠ a crash
+        assert eng.infer(clean).shape == (4,)
+        assert eng.health()["live"] is True
+    assert eng.metrics.counters["nonfinite_outputs"] == 1
+    assert eng.metrics.counters["watchdog_trips"] == 0
+    assert eng.stats()["resilience"]["nonfinite_outputs"] == 1
+
+
+def test_serving_decode_nonfinite_fails_typed_and_scrubs_slot(gpt2_net):
+    """A NaN mid-generation fails THAT request typed (flag computed
+    in-graph next to the argmax) and scrubs the slot's cache row, so
+    the next tenant of the slot is NOT poisoned by stale NaN K/V."""
+    import copy
+    net = gpt2_net
+    wpe = [p for _n, p in net.collect_params().items()
+           if p.shape == (32, 16)][0]
+    orig = wpe.data().asnumpy().copy()
+    w = orig.copy()
+    w[6, :] = onp.nan                 # poison POSITION 6 only
+    wpe.set_data(nd.array(w))
+    try:
+        eng = InferenceEngine(net, num_slots=2, max_batch=2,
+                              seq_buckets=(4,), max_length=32,
+                              default_max_new_tokens=2)
+        with eng:
+            out = eng.infer(onp.array([1, 2], "int32"),
+                            max_new_tokens=2)          # stays < pos 6
+            assert len(out) == 4
+            with pytest.raises(NonFiniteOutputError):  # reaches pos 6
+                eng.infer(onp.array([1, 2, 3], "int32"),
+                          max_new_tokens=8)
+            # slot reuse after the NaN failure: scrubbed row is clean
+            out2 = eng.infer(onp.array([3, 4], "int32"),
+                             max_new_tokens=2)
+            assert len(out2) == 4
+            assert eng.health()["live"] is True
+        assert eng.metrics.counters["nonfinite_outputs"] == 1
+    finally:
+        wpe.set_data(nd.array(orig))
+
+
+# ------------------------------------------------------------- amp wiring
+
+
+def test_amp_init_trainer_wires_sharded_trainer():
+    mesh = _make_mesh()
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        amp.init_trainer(tr, loss_scaler=amp.LossScaler(init_scale=256.0))
+        assert tr._guarded and tr._loss_scaler is not None
+        X, y = _batch()
+        # scale_loss/unscale are no-op passthroughs on the sharded path
+        # (scaling is in-graph), kept for script portability
+        with amp.scale_loss(nd.array([1.0]), tr) as scaled:
+            assert float(scaled.asnumpy()[0]) == 1.0
+        amp.unscale(tr)
+        loss, flag = tr.step(X, y)
+        assert bool(flag.asnumpy()) and tr.loss_scale == 256.0
+        # attaching after build is an error, not a silent miss
+        with pytest.raises(mx.MXNetError):
+            amp.init_trainer(tr)
+
+
+def test_amp_gluon_trainer_skips_overflowed_step():
+    """The gluon Trainer now consults its scaler: non-finite grads skip
+    the update and shrink the scale instead of poisoning params."""
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer, loss_scaler=amp.LossScaler(
+        init_scale=1024.0, scale_factor=2.0, scale_window=2000))
+    X = nd.array(onp.random.RandomState(0).randn(8, 4).astype("float32"))
+    y = nd.array((onp.arange(8) % 2).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        with amp.scale_loss(loss_fn(net(X), y), trainer) as scaled:
+            scaled.backward()
+    before = net.weight.data().asnumpy().copy()
+    g = net.weight.grad()
+    g._rebind(g.jax * float("nan"))            # poison the gradient
+    trainer.step(8)
+    onp.testing.assert_array_equal(net.weight.data().asnumpy(), before)
+    assert trainer._amp_loss_scaler.loss_scale == 512.0
+    assert trainer.skipped_steps == 1
+    # a clean step still updates
+    with autograd.record():
+        with amp.scale_loss(loss_fn(net(X), y), trainer) as scaled:
+            scaled.backward()
+    trainer.step(8)
+    assert not onp.array_equal(net.weight.data().asnumpy(), before)
+
+
+def test_amp_no_scaler_warns_once():
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd")
+    amp._warned_no_scaler = False
+    with pytest.warns(FutureWarning, match="no LossScaler"):
+        with amp.scale_loss(nd.array([2.0]), trainer) as l:
+            assert float(l.asnumpy()[0]) == 2.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")         # second call: silent
+        with amp.scale_loss(nd.array([2.0]), trainer):
+            pass
+        amp.unscale(trainer)

@@ -163,7 +163,7 @@ def serving_scenarios(net):
 
 # ------------------------------------------------------- training scenarios
 
-def _make_trainer():
+def _make_trainer(**kw):
     import numpy as onp
 
     import mxnet_tpu as mx
@@ -182,7 +182,7 @@ def _make_trainer():
     net[1].bias.set_data(nd.array(onp.zeros(2, "float32")))
     return par.ShardedTrainer(
         net, "adam", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
-        optimizer_params={"learning_rate": 0.01})
+        optimizer_params={"learning_rate": 0.01}, **kw)
 
 
 def _make_iter():
@@ -274,6 +274,138 @@ def training_commit_kill():
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+# ------------------------------------------------- guardrail scenarios
+
+def training_nan_storm(steps=10):
+    """NaN storm (docs/guardrails.md): 3 consecutive steps with
+    injected non-finite gradients.  Contract: each bad step SKIPS the
+    update (params stay finite), the dynamic loss scale halves per bad
+    step, and training then recovers and completes."""
+    import numpy as onp
+
+    from mxnet_tpu import amp
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.resilience import FaultPlan, ResilientLoop
+    mesh = par.make_mesh(dp=1)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        with par.use_mesh(mesh):
+            tr = _make_trainer(
+                loss_scaler=amp.LossScaler(init_scale=2.0 ** 16))
+            loop = ResilientLoop(tr, os.path.join(workdir, "storm"),
+                                 save_every=2, seed=7)
+            plan = FaultPlan().nonfinite_at("trainer.grad_nonfinite",
+                                            every=1, max_fires=3)
+            with plan:
+                report = loop.run(_make_iter, steps)
+            scale = tr.loss_scale
+            finite = all(onp.isfinite(p.data().asnumpy()).all()
+                         for _, p in tr._trainable)
+            passed = (report["completed_steps"] == steps
+                      and report["bad_steps"] == 3
+                      and scale == 2.0 ** 13      # halved 3x, no regrow
+                      and finite)
+            return {
+                "name": "training/nan_storm_scale_halves",
+                "passed": bool(passed),
+                "detail": {"bad_steps": report["bad_steps"],
+                           "loss_scale": scale,
+                           "params_finite": bool(finite),
+                           "faults_fired": plan.fired()},
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def training_persistent_nan_rewind(steps=10):
+    """Persistent NaN: 4 consecutive poisoned steps trip the
+    ``on_bad_step='rewind'`` policy — the loop restores the last
+    committed checkpoint (params + loss scale) and completes."""
+    import numpy as onp
+
+    from mxnet_tpu import amp
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.resilience import FaultPlan, ResilientLoop
+    mesh = par.make_mesh(dp=1)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        with par.use_mesh(mesh):
+            tr = _make_trainer(loss_scaler=amp.LossScaler())
+            loop = ResilientLoop(tr, os.path.join(workdir, "rewind"),
+                                 save_every=2, seed=7,
+                                 on_bad_step="rewind", rewind_after=2)
+            plan = FaultPlan()
+            for hit in (5, 6, 7, 8):
+                plan.nonfinite_at("trainer.grad_nonfinite", at=hit)
+            with plan:
+                report = loop.run(_make_iter, steps)
+            finite = all(onp.isfinite(p.data().asnumpy()).all()
+                         for _, p in tr._trainable)
+            passed = (report["completed_steps"] == steps
+                      and report["bad_steps"] == 4
+                      and report["rewinds"] >= 1 and finite)
+            return {
+                "name": "training/persistent_nan_rewind",
+                "passed": bool(passed),
+                "detail": {"bad_steps": report["bad_steps"],
+                           "rewinds": report["rewinds"],
+                           "params_finite": bool(finite),
+                           "faults_fired": plan.fired()},
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def training_bad_batch_quarantine(steps=4):
+    """A poisoned INPUT batch (``io.bad_batch``) is quarantined by the
+    iterator — skipped and counted, never fed to the trainer — so the
+    training step count is unaffected."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.resilience import FaultPlan, ResilientLoop
+    mesh = par.make_mesh(dp=1)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        with par.use_mesh(mesh):
+            from mxnet_tpu.serving.metrics import ServingMetrics
+            metrics = ServingMetrics("resilience")
+            tr = _make_trainer(guard_nonfinite=True)
+            rs = onp.random.RandomState(0)
+            X = rs.randn(40, 6).astype("float32")
+            y = (X.sum(1) > 0).astype("int32")
+            it = mx.io.NDArrayIter(X, y, batch_size=8,
+                                   quarantine_nonfinite=True,
+                                   last_batch_handle="discard",
+                                   metrics=metrics)
+
+            def make_iter():
+                it.reset()
+                return ((b.data[0], b.label[0]) for b in it)
+
+            loop = ResilientLoop(tr, os.path.join(workdir, "quar"),
+                                 save_every=2, seed=3, metrics=metrics)
+            plan = FaultPlan().nonfinite_at("io.bad_batch", at=2)
+            with plan:
+                report = loop.run(make_iter, steps)
+            exported = metrics.stats()["resilience"]["quarantined_batches"]
+            passed = (report["completed_steps"] == steps
+                      and it.quarantined == 1 and exported == 1
+                      and report["bad_steps"] == 0)
+            return {
+                "name": "training/bad_batch_quarantine",
+                "passed": bool(passed),
+                "detail": {"quarantined": it.quarantined,
+                           "quarantined_batches_exported": exported,
+                           "completed_steps": report["completed_steps"],
+                           "bad_steps": report["bad_steps"],
+                           "faults_fired": plan.fired()},
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 # -------------------------------------------------------------------- main
 
 def main():
@@ -308,6 +440,9 @@ def main():
         run(thunk)
     run(training_kill_resume, kills=args.kills, steps=args.steps)
     run(training_commit_kill)
+    run(training_nan_storm)
+    run(training_persistent_nan_rewind)
+    run(training_bad_batch_quarantine)
 
     report = {
         "platform": platform,
