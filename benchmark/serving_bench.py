@@ -16,6 +16,15 @@ the engine:
   ratios in bench.py.  The record also carries the engine's p50/p95
   total-latency milliseconds.
 
+``--workload prefix`` instead runs the repeated-system-prompt workload
+(docs/serving.md): every request shares a long common prefix and
+carries a short unique tail — the shape of few-shot/system-prompt
+traffic.  It emits ``serving_prefix_ttft_cache_off`` (the baseline:
+full prefill per request) and ``serving_prefix_ttft_cache_on`` (prefix
+cache enabled; ``vs_baseline`` is the median-TTFT speedup, and the
+record carries the measured hit rate, tokens saved, and the TTFT
+reduction percentage).
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -129,11 +138,86 @@ def bench_serving_decode(concurrency: int = 16, max_new: int = None,
                    "p50_ms": lat["p50_ms"], "p95_ms": lat["p95_ms"]})
 
 
+def _build_prefix_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        name = "gpt2_124m"
+        shared_len, tail_len = 1024, 64
+        seq_buckets = (64, 128, 256, 512, 1024, 2048)
+    else:   # CPU sanity: the prefill must be COMPUTE-bound, not
+        # dispatch-bound, or the row copy the cache adds costs more than
+        # the prefill it removes and the measured ratio is meaningless
+        name = "gpt2_124m"
+        cfg = dict(vocab_size=512, units=256, num_layers=4, num_heads=8,
+                   max_length=144, dropout=0.0)
+        shared_len, tail_len = 120, 8
+        seq_buckets = (16, 32, 64, 128)
+    net = get_gpt2(name, **cfg)
+    net.initialize()
+    return net, shared_len, tail_len, seq_buckets
+
+
+def bench_prefix_cache(n_requests: int = 12, max_new: int = 2,
+                       trials: int = 3):
+    """Repeated-system-prompt workload: TTFT with the prefix cache on vs
+    off.  Requests run serially (TTFT isolation — concurrency would
+    hide prefill behind decode of other requests); a fresh engine per
+    trial keeps trials independent; warmup pays all compiles before any
+    timed request."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    net, shared_len, tail_len, seq_buckets = _build_prefix_net(on_tpu)
+    rs = onp.random.RandomState(7)
+    shared = rs.randint(0, net.vocab_size, (shared_len,)).astype("int32")
+    prompts = [onp.concatenate(
+        [shared, rs.randint(0, net.vocab_size, (tail_len,))
+         .astype("int32")]) for _ in range(n_requests)]
+
+    def one_trial(pool_rows):
+        eng = InferenceEngine(
+            net, num_slots=2, max_batch=2, seq_buckets=seq_buckets,
+            default_max_new_tokens=max_new, prefix_pool_rows=pool_rows,
+            prefix_min_tokens=8, name="serving_prefix_bench")
+        eng.warmup()
+        with eng:
+            for p in prompts:
+                eng.infer(p, max_new_tokens=max_new)
+        return eng.stats()
+
+    off_vals, on_vals, last_on = [], [], None
+    for _ in range(max(1, trials)):
+        off_vals.append(one_trial(0)["ttft"]["p50_ms"])
+        last_on = one_trial(2)
+        on_vals.append(last_on["ttft"]["p50_ms"])
+    pc = last_on["prefix_cache"]
+    speedup = round(statistics.median(off_vals) /
+                    statistics.median(on_vals), 4)
+    reduction = round(100.0 * (1.0 - statistics.median(on_vals) /
+                               statistics.median(off_vals)), 1)
+    yield _record("serving_prefix_ttft_cache_off", off_vals, "ms", None,
+                  {"n_requests": n_requests, "shared_prefix": shared_len,
+                   "tail": tail_len})
+    yield _record("serving_prefix_ttft_cache_on", on_vals, "ms", speedup,
+                  {"n_requests": n_requests, "shared_prefix": shared_len,
+                   "tail": tail_len,
+                   "ttft_reduction_pct": reduction,
+                   "prefix_hit_rate": pc["hit_rate"],
+                   "prefix_tokens_saved": pc["prefix_tokens_saved"]})
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--workload", choices=("decode", "prefix"),
+                    default="decode")
     args = ap.parse_args()
 
     from mxnet_tpu.utils.platform import init_backend
@@ -142,8 +226,12 @@ def main():
         print(f"serving_bench: accelerator unavailable; running on "
               f"{platform}", file=sys.stderr)
 
-    for rec in bench_serving_decode(args.concurrency, args.max_new_tokens,
-                                    args.trials):
+    if args.workload == "prefix":
+        recs = bench_prefix_cache(trials=args.trials)
+    else:
+        recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
+                                    args.trials)
+    for rec in recs:
         print(json.dumps(rec), flush=True)
 
 

@@ -97,26 +97,45 @@ class _GPT2Decoding:
         _dense_blocks_only(self)
         return self.init_cache(num_slots, max_length, dtype)
 
-    def prefill_slots(self, tokens_nd, lens, caches, slot_idx):
+    def prefill_slots(self, tokens_nd, lens, caches, slot_idx,
+                      offset=None):
         """Admission prefill for a bucketed batch of prompts: tokens
         (B, Tb) int32 right-PADDED to the bucket length, ``lens`` (B,)
-        true lengths, ``slot_idx`` (B,) destination rows of the (S,...)
+        true lengths, ``slot_idx`` (B,) destination rows of the (R,...)
         caches.  One causal forward writes every layer's K/V for
         positions [0, Tb) into the requests' slots and returns the
         logits at each row's LAST REAL position (B, vocab) — right
         padding never leaks into them (causal mask), and the garbage
         K/V it leaves beyond ``lens`` is overwritten by decode before
-        it can be attended."""
+        it can be attended.
+
+        With ``offset`` (B,) int32 given, row i's tokens are a CHUNK of
+        its prompt starting at absolute position ``offset[i]``: K/V land
+        at ``[offset[i], offset[i]+Tb)`` behind the already-populated
+        ``[0, offset[i])`` region (earlier chunks / a prefix-cache
+        copy), position embeddings follow the absolute positions, and
+        attention runs against the full cache row (see
+        ``MultiHeadAttention.forward_prefill_slots``).  Logits are
+        still at each row's last real CHUNK position ``lens[i]-1`` —
+        only the final chunk's logits are meaningful."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
 
         b = tokens_nd.shape[0]
-        pos = F.arange_like(tokens_nd, axis=1).astype("int32")
+        if offset is None:
+            pos = F.arange_like(tokens_nd, axis=1).astype("int32")
+        else:
+            t = tokens_nd.shape[1]
+            apos = offset[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            # clamp the embedding lookup only: padding columns of a final
+            # chunk can run past the position table; their K/V writes are
+            # OOB scatters (dropped) and their logits are never read
+            pos = NDArray(jnp.minimum(apos, self.max_length - 1))
         x = self.wte(tokens_nd) + self.wpe(pos)
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
-            x, c = blk.forward_prefill_slots(x, cache, slot_idx)
+            x, c = blk.forward_prefill_slots(x, cache, slot_idx, offset)
             new_caches.append(c)
         x = self.ln_f(x)
         last = NDArray(x.jax[jnp.arange(b), lens - 1])      # (B, U)
@@ -129,9 +148,14 @@ class _GPT2Decoding:
         """One continuous-batching decode step over EVERY slot: tok (S,)
         int32 NDArray of last tokens, ``pos`` (S,) int32 jax array of
         their (per-slot) positions → (logits (S, vocab), new caches).
-        Rows whose slot is free run too (fixed shape = one XLA
-        program); their writes land at pos 0 of a row nobody reads
-        until the next prefill overwrites it.  Inference mode assumed."""
+        Rows whose slot is free (or still mid-chunked-prefill) run too
+        (fixed shape = one XLA program); the engine parks them at
+        ``pos = Tmax`` so their write is an out-of-bounds scatter jax
+        DROPS — an in-range dummy position would clobber real K/V, e.g.
+        a prefix-cache copy at position 0 of a mid-prefill row.  The
+        caches may carry more rows than ``S`` (scratch + prefix pool);
+        rows past S are never written or attended here.  Inference mode
+        assumed."""
         from ..ndarray import NDArray
 
         s = tok.shape[0]

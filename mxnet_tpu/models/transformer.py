@@ -165,9 +165,14 @@ class MultiHeadAttention(HybridBlock):
     def forward_step_slots(self, x, cache, pos):
         """Continuous-batching decode: x (S,1,U) where row s is an
         independent request parked in SLOT s of the persistent cache
-        {'k','v': (S,Tmax,H,D)}, at its OWN position ``pos`` (S,) int32.
+        {'k','v': (R,Tmax,H,D)}, at its OWN position ``pos`` (S,) int32.
         Writes K/V at [s, pos[s]] and attends row-wise to keys
-        <= pos[s].  Inference only."""
+        <= pos[s].  The cache may carry MORE rows than the decode batch
+        (R >= S: the scratch and prefix-pool rows live past the slots) —
+        only rows [0, S) are written or attended; an out-of-range
+        ``pos`` (the engine parks idle rows at Tmax) makes the write an
+        out-of-bounds scatter, which jax DROPS, so idle rows never
+        clobber cache state.  Inference only."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
@@ -182,19 +187,32 @@ class MultiHeadAttention(HybridBlock):
             k_new.jax.astype(cache["k"].dtype))
         vc = cache["v"].at[rows, pos].set(
             v_new.jax.astype(cache["v"].dtype))
-        out = _attention_step_slots(q.jax, kc, vc, pos, 1.0 / (d ** 0.5))
+        out = _attention_step_slots(q.jax, kc[:s], vc[:s], pos,
+                                    1.0 / (d ** 0.5))
         out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
         return out, {"k": kc, "v": vc}
 
-    def forward_prefill_slots(self, x, cache, slot_idx):
+    def forward_prefill_slots(self, x, cache, slot_idx, offset=None):
         """Bucketed admission prefill: x (B,Tb,U) is a batch of PADDED
         prompts; row i's K/V for positions [0, Tb) land in cache row
-        ``slot_idx[i]`` of the persistent (S,Tmax,H,D) cache.  Causal
+        ``slot_idx[i]`` of the persistent (R,Tmax,H,D) cache.  Causal
         attention keeps real tokens blind to the right-padding; padded
         positions write garbage K/V beyond each prompt's true length,
         which decode overwrites (position p is rewritten before it is
         ever attended).  Duplicate slot_idx rows (scratch padding) are
-        allowed — last-writer-wins is fine for rows nobody reads."""
+        allowed — last-writer-wins is fine for rows nobody reads.
+
+        CHUNKED/OFFSET variant (``offset`` (B,) int32 given): row i's
+        tokens are the chunk at absolute positions ``[offset[i],
+        offset[i]+Tb)`` of a prompt whose K/V for ``[0, offset[i])`` is
+        ALREADY in cache row ``slot_idx[i]`` (earlier chunks, or a
+        prefix-cache copy) — so each chunk query at absolute position p
+        attends to the row's cached keys ``<= p``, not just the chunk.
+        The chunk K/V are written first, then each row's full cache row
+        is gathered back for the attention (the data dependency through
+        the scatter keeps XLA honest about ordering).  Writes landing at
+        positions >= Tmax (padding columns of a final chunk) are
+        out-of-bounds scatters, which jax drops."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
@@ -206,10 +224,17 @@ class MultiHeadAttention(HybridBlock):
         k = self.k_proj(x).reshape((b, t, h, d))
         v = self.v_proj(x).reshape((b, t, h, d))
         ridx = slot_idx[:, None]
-        cidx = jnp.arange(t)[None, :]
+        cidx = jnp.arange(t)[None, :] if offset is None \
+            else offset[:, None] + jnp.arange(t)[None, :]
         kc = cache["k"].at[ridx, cidx].set(k.jax.astype(cache["k"].dtype))
         vc = cache["v"].at[ridx, cidx].set(v.jax.astype(cache["v"].dtype))
-        out = dot_product_attention(q, k, v, causal=True)
+        if offset is None:
+            out = dot_product_attention(q, k, v, causal=True)
+        else:
+            krow = kc[slot_idx]          # (B, Tmax, H, D)
+            vrow = vc[slot_idx]
+            out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
+                                           1.0 / (d ** 0.5)))
         out = self.out_proj(out.reshape((b, t, h * d)))
         return out, {"k": kc, "v": vc}
 
@@ -228,6 +253,29 @@ def _attention_step(q, k_cache, v_cache, idx, scale):
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
                       v_cache)
+
+
+def _attention_chunk(q, k_rows, v_rows, qpos, scale):
+    """Chunked-prefill attention against populated cache rows: q
+    (B,Tq,H,D) are chunk queries at ABSOLUTE positions ``qpos`` (B,Tq);
+    k_rows/v_rows (B,Tmax,H,D) are each request's full (gathered) cache
+    row, already containing this chunk's K/V plus everything before it.
+    Query (b, i) attends keys at positions <= qpos[b, i] — causal over
+    the whole prompt, not just the chunk.  This is the decode-step mask
+    generalized to Tq queries; O(Tq·Tmax) scores per row, the price of
+    offset prefill without a custom kernel (a flash variant with a
+    kv-length stop is the TPU follow-up)."""
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_rows,
+                        preferred_element_type=jnp.float32) * scale
+    keys = jnp.arange(k_rows.shape[1])
+    keep = keys[None, None, None, :] <= qpos[:, None, :, None]
+    logits = jnp.where(keep, logits, -1e30)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_rows.dtype),
+                      v_rows)
 
 
 def _attention_step_slots(q, k_cache, v_cache, pos, scale):
@@ -484,11 +532,12 @@ class TransformerBlock(HybridBlock):
         x = x + self.ffn(self.ln2(x))
         return x, cache
 
-    def forward_prefill_slots(self, x, cache, slot_idx):
+    def forward_prefill_slots(self, x, cache, slot_idx, offset=None):
         """Bucketed admission prefill through the block (see
-        MultiHeadAttention.forward_prefill_slots)."""
+        MultiHeadAttention.forward_prefill_slots; ``offset`` selects the
+        chunked/offset variant)."""
         a, cache = self.attn.forward_prefill_slots(self.ln1(x), cache,
-                                                   slot_idx)
+                                                   slot_idx, offset)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache

@@ -1,8 +1,12 @@
 """Deterministic fault injection for chaos testing.
 
 The rest of the codebase calls :func:`inject(site)` at named *injection
-sites* on its hot paths (``"serving.decode_step"``, ``"trainer.step"``,
-``"checkpoint.save"``, ``"kvstore.push"``, …).  With no plan active that
+sites* on its hot paths (``"serving.decode_step"``, ``"serving.prefill"``,
+``"serving.prefix_lookup"`` / ``"serving.prefix_copy"`` (the prefix
+cache's host radix-tree ops and device row copies — the engine degrades
+those to a cache miss and disables the cache on repeated faults),
+``"trainer.step"``, ``"checkpoint.save"``, ``"kvstore.push"``, …).  With
+no plan active that
 call is one module-global load plus a ``None`` check — provably in the
 noise of any step that launches an XLA program.  Inside a
 ``with FaultPlan(...):`` block each call counts a *hit* per site and

@@ -5,8 +5,11 @@ out of tree and per-request Python dispatch in it): an in-process
 :class:`InferenceEngine` with dynamic batching, a shape-bucketed compile
 cache (pad-to-lattice so XLA compiles once per bucket, warmup API to
 pre-compile it), continuous batching of LM decode over a slot-managed
-persistent KV cache, bounded-queue load shedding, per-request deadlines
-and latency/throughput metrics.  See docs/serving.md.
+persistent KV cache, a radix-tree PREFIX cache (shared prompt prefixes
+prefill once; later requests copy the cached K/V rows and prefill only
+their suffix), chunked prefill (long prompts interleave with decode),
+bounded-queue load shedding, per-request deadlines and phase-split
+latency/TTFT metrics.  See docs/serving.md.
 
 Quick start::
 
@@ -25,11 +28,13 @@ from .errors import (DeadlineExceededError, EngineCrashedError,
                      RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
+from .prefix_cache import PrefixCache, PrefixEntry
 
 __all__ = [
     "InferenceEngine", "InferenceFuture", "Request",
     "BucketLattice", "DynamicBatcher",
     "SlotAllocator", "SlotState",
+    "PrefixCache", "PrefixEntry",
     "LatencyHistogram", "ServingMetrics",
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "DeadlineExceededError", "EngineStoppedError", "EngineCrashedError",

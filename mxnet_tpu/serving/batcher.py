@@ -77,10 +77,15 @@ class BucketLattice:
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
 
-    def prefill_points(self):
-        """Every (batch_bucket, seq_bucket) pair — the warmup compile set."""
-        return [(b, s) for b in self.batch_buckets
-                for s in self.seq_buckets]
+    def prefill_points(self, max_seq: Optional[int] = None):
+        """Every (batch_bucket, seq_bucket) pair — the warmup compile
+        set.  ``max_seq`` caps the seq side: chunked prefill never runs
+        a chunk longer than the engine's ``prefill_chunk``, so its
+        lattice (and the full-prefill lattice when chunking caps prompt
+        admission) stops at that bucket."""
+        sb = self.seq_buckets if max_seq is None else \
+            tuple(s for s in self.seq_buckets if s <= self.seq(max_seq))
+        return [(b, s) for b in self.batch_buckets for s in sb]
 
     def __len__(self):
         return len(self.batch_buckets) * len(self.seq_buckets)

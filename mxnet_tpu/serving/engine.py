@@ -28,6 +28,18 @@ while queued AND mid-generation.  ``stats()`` exposes latency
 percentiles, token counters and the bucket-hit/compile counters;
 scheduler batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
 
+Prefix reuse (docs/serving.md): with ``prefix_pool_rows > 0`` a
+host-side radix tree (:mod:`.prefix_cache`) maps admitted prompt
+prefixes to a reserved pool of KV cache rows; a request whose prompt
+extends a cached prefix copies the matched K/V into its slot (one
+compiled row-to-row masked copy) and prefills ONLY the suffix.  Prefill
+itself is CHUNKED: K/V for ``[off, off+Tb)`` can be written behind an
+already-populated ``[0, off)`` region, so long prompts (longer than the
+largest seq bucket, or than ``prefill_chunk``) prefill in bucket-sized
+chunks interleaved with decode steps — a long prompt no longer stalls
+in-flight decodes.  Greedy decode is token-identical with the cache on
+or off.
+
 Hardening (docs/resilience.md): a :class:`~mxnet_tpu.resilience.Watchdog`
 monitors the scheduler thread — if it dies, or (with ``hang_timeout``
 set) stops heartbeating while work is pending, every queued and
@@ -39,7 +51,11 @@ into a graceful ``stop(drain=True)``.  ``health()`` is the
 liveness/readiness probe.  Fault-injection sites on the hot paths:
 ``serving.scheduler`` (per cycle, outside the recovery net — a raise
 here IS a scheduler crash), ``serving.prefill``, ``serving.decode_step``
-and ``serving.forward`` (before each compiled call).
+and ``serving.forward`` (before each compiled call), plus the prefix
+cache's ``serving.prefix_lookup`` (host radix-tree ops) and
+``serving.prefix_copy`` (device row-to-row K/V copies) — faults there
+degrade to a cache miss / full prefill, never fail the request, and
+repeated faults disable the cache for the engine's lifetime.
 """
 from __future__ import annotations
 
@@ -58,6 +74,7 @@ from .errors import (EngineCrashedError, EngineStoppedError,
                      QueueFullError, RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 
 __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
 
@@ -155,6 +172,25 @@ class InferenceEngine:
     max_request_retries : per-request budget for retryable step faults
         (transient infra errors / injected ``RetryableFault``).
     retry_backoff : sleep before a step retry (doubles per attempt).
+    prefix_pool_rows : reserved KV rows for the prefix cache (decode
+        mode; 0 = disabled).  Each row costs the same HBM as one slot
+        (Tmax × heads × head_dim × 2 × layers); cached prompt prefixes
+        live there and are copied into a leased slot on a hit so only
+        the suffix prefills.
+    prefill_chunk : cap on tokens prefilled per compiled call (default:
+        the largest seq bucket).  Prompts longer than it (and suffixes
+        after a prefix hit) prefill in chunks of at most this many
+        tokens, one chunk batch per scheduler cycle, interleaved with
+        decode steps.  Also raises the admissible prompt length from
+        the largest seq bucket to ``max_length - max_new_tokens``.
+    prefix_min_tokens : minimum prefix length worth caching/copying —
+        shorter matches prefill from scratch (a row copy costs more
+        than it saves), shorter prompts are never inserted.
+    prefix_fault_limit : consecutive faults at a ``serving.prefix_*``
+        site (per-site streaks — a clean lookup must not launder a
+        permanently failing copy path) before the cache is disabled for
+        the engine's lifetime (each fault already degrades to a plain
+        miss).
     guard_nonfinite : fail a request whose model output went NaN/Inf
         with :class:`NonFiniteOutputError` instead of returning garbage
         tokens (decode: a per-row ``isfinite(logits)`` flag computed
@@ -179,6 +215,10 @@ class InferenceEngine:
                  max_request_retries: int = 2,
                  retry_backoff: float = 0.01,
                  guard_nonfinite: bool = True,
+                 prefix_pool_rows: int = 0,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_min_tokens: int = 4,
+                 prefix_fault_limit: int = 3,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -215,12 +255,38 @@ class InferenceEngine:
                     f"largest seq bucket {self.lattice.max_seq} exceeds "
                     f"KV length max_length={self.max_length}")
             self._alloc = SlotAllocator(self.num_slots)
+            self.prefix_pool_rows = int(prefix_pool_rows)
+            if self.prefix_pool_rows < 0:
+                raise ValueError(f"prefix_pool_rows must be >= 0, got "
+                                 f"{self.prefix_pool_rows}")
+            self.prefill_chunk = int(prefill_chunk) \
+                if prefill_chunk is not None else self.lattice.max_seq
+            if self.prefill_chunk < 1:
+                raise ValueError(f"prefill_chunk must be >= 1, got "
+                                 f"{self.prefill_chunk}")
+            self.prefill_chunk = min(self.prefill_chunk,
+                                     self.lattice.max_seq)
+            self.prefix_min_tokens = max(1, int(prefix_min_tokens))
+            self._prefix = PrefixCache(
+                self.prefix_pool_rows, row_base=self.num_slots + 1,
+                min_tokens=self.prefix_min_tokens) \
+                if self.prefix_pool_rows else None
         else:
             self.max_length = None
             self.num_slots = 0
             self.lattice = BucketLattice(batch_buckets, (1,),
                                          max_batch=self.max_batch)
             self._alloc = None
+            self.prefix_pool_rows = 0
+            self.prefill_chunk = None
+            self.prefix_min_tokens = int(prefix_min_tokens)
+            self._prefix = None
+        self.prefix_fault_limit = int(prefix_fault_limit)
+        # consecutive-fault streaks, PER SITE: a clean host lookup runs
+        # right before every device copy, so a shared counter could
+        # never trip on a permanently failing copy path
+        self._prefix_faults = {"lookup": 0, "copy": 0}
+        self._prefix_disabled = False
 
         self.guard_nonfinite = bool(guard_nonfinite)
         self.hang_timeout = hang_timeout
@@ -266,13 +332,19 @@ class InferenceEngine:
                 axes = tuple(range(1, logits_jax.ndim))
                 return jnp.all(jnp.isfinite(logits_jax), axis=axes)
 
-            def prefill(toks, lens, caches, sidx):
+            def chunk(toks, lens, caches, sidx, off):
                 logits, c = net.prefill_slots(NDArray(toks), lens, caches,
-                                              sidx)
+                                              sidx, offset=off)
                 ok = row_ok(logits.jax) if guard else \
                     jnp.ones((logits.jax.shape[0],), jnp.bool_)
                 return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
                         ok, c)
+
+            def prefill(toks, lens, caches, sidx):
+                # full prefill IS the offset=None case — one body, so the
+                # guard/argmax post-processing can never diverge between
+                # the two prefill programs (greedy parity depends on it)
+                return chunk(toks, lens, caches, sidx, None)
 
             def step(tok, caches, pos):
                 logits, c = net.decode_step(NDArray(tok), caches, pos)
@@ -281,17 +353,39 @@ class InferenceEngine:
                 return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
                         ok, c)
 
+            def copy_rows(caches, src, dst, length):
+                # masked row-to-row K/V copy for the prefix cache:
+                # positions [0, length) of row `src` land in row `dst`,
+                # the rest of `dst` is preserved.  src/dst/length are
+                # traced scalars, so this is ONE compiled program for
+                # every (pool->slot, slot->pool, any length) copy.  The
+                # mask is not optional hygiene: unmasked row garbage
+                # beyond `length` could carry NaN from a scrubbed
+                # neighbour epoch, and NaN survives additive masking.
+                import jax as _jax
+
+                def cp(a):
+                    m = (jnp.arange(a.shape[1]) < length).reshape(
+                        (a.shape[1],) + (1,) * (a.ndim - 2))
+                    return a.at[dst].set(jnp.where(m, a[src], a[dst]))
+                return _jax.tree_util.tree_map(cp, caches)
+
             self._items, pure_prefill = make_pure_fn(net, prefill)
             _, pure_step = make_pure_fn(net, step)
+            _, pure_chunk = make_pure_fn(net, chunk)
             # donate the cache buffers on TPU (in-place update, no copy of
             # the S×Tmax×H×D arrays per step); CPU jax warns on donation
             if jax.default_backend() == "tpu":
                 self._jit_prefill = jax.jit(pure_prefill,
                                             donate_argnums=(3,))
                 self._jit_step = jax.jit(pure_step, donate_argnums=(2,))
+                self._jit_chunk = jax.jit(pure_chunk, donate_argnums=(3,))
+                self._jit_copy = jax.jit(copy_rows, donate_argnums=(0,))
             else:
                 self._jit_prefill = jax.jit(pure_prefill)
                 self._jit_step = jax.jit(pure_step)
+                self._jit_chunk = jax.jit(pure_chunk)
+                self._jit_copy = jax.jit(copy_rows)
         else:
             def forward(xs):
                 out = net(NDArray(xs))
@@ -604,13 +698,14 @@ class InferenceEngine:
                 raise InvalidRequestError(
                     f"need a non-empty prompt and max_new_tokens >= 1 "
                     f"(got len={arr.size}, max_new_tokens={mnt})")
-            if arr.size > self.lattice.max_seq or \
-                    arr.size + mnt > self.max_length:
+            # prompts longer than the largest seq bucket are fine now —
+            # chunked prefill splits them — but prompt + generation must
+            # fit the KV rows
+            if arr.size + mnt > self.max_length:
                 self.metrics.count("rejected_invalid")
                 raise InvalidRequestError(
                     f"prompt len {arr.size} + {mnt} new tokens does not "
-                    f"fit (largest seq bucket {self.lattice.max_seq}, "
-                    f"KV length {self.max_length})")
+                    f"fit the KV length ({self.max_length})")
             req = Request("decode", arr, mnt,
                           self.eos_id if eos_id is None else eos_id,
                           deadline)
@@ -649,11 +744,14 @@ class InferenceEngine:
     def warmup(self, example_shape: Optional[Sequence[int]] = None,
                dtype: str = "float32") -> int:
         """Pre-compile the whole bucket lattice so live traffic never
-        pays an XLA compile.  Decode mode compiles the decode step plus
-        every (batch, seq) prefill point; forward mode needs the
+        pays an XLA compile.  Decode mode compiles the decode step,
+        every (batch, seq) full-prefill point, the CHUNK-prefill lattice
+        (same points, capped at the ``prefill_chunk`` bucket — offset
+        prefill is a distinct program), and the prefix-cache row copy
+        (one program; src/dst/length are traced); forward mode needs the
         per-example ``example_shape`` (no batch dim).  Requires an idle
         engine (no in-flight decodes).  Returns the number of programs
-        compiled."""
+        compiled — after this the ``compiles`` counter must not move."""
         import jax.numpy as jnp
 
         with self._step_lock:
@@ -671,13 +769,23 @@ class InferenceEngine:
                     ("decode",), self._jit_step, params, zeros,
                     self._caches, zeros)
                 scratch = self._alloc.scratch
-                for bb, tb in self.lattice.prefill_points():
+                for bb, tb in self.lattice.prefill_points(
+                        self.prefill_chunk):
                     toks = jnp.zeros((bb, tb), jnp.int32)
                     lens = jnp.ones((bb,), jnp.int32)
                     sidx = jnp.full((bb,), scratch, jnp.int32)
                     _, _ok, self._caches = self._counted(
                         ("prefill", bb, tb), self._jit_prefill, params,
                         toks, lens, self._caches, sidx)
+                    off = jnp.zeros((bb,), jnp.int32)
+                    _, _ok, self._caches = self._counted(
+                        ("chunk", bb, tb), self._jit_chunk, params,
+                        toks, lens, self._caches, sidx, off)
+                if self._prefix is not None:
+                    scr = jnp.asarray(scratch, jnp.int32)
+                    self._caches = self._counted(
+                        ("prefix_copy",), self._jit_copy, self._caches,
+                        scr, scr, jnp.asarray(0, jnp.int32))
             else:
                 if example_shape is None:
                     raise ServingError("forward-mode warmup needs "
@@ -703,6 +811,11 @@ class InferenceEngine:
             "batch_buckets": list(self.lattice.batch_buckets),
             "seq_buckets": list(self.lattice.seq_buckets)
             if self.mode == "decode" else None,
+            "prefill_chunk": self.prefill_chunk,
+            "prefix_pool_rows": self.prefix_pool_rows,
+            "prefix_entries": len(self._prefix)
+            if self._prefix is not None else 0,
+            "prefix_disabled": self._prefix_disabled,
             "running": self._thread is not None,
             "crashed": self._crashed is not None,
         }
@@ -794,19 +907,26 @@ class InferenceEngine:
             self._fail(req, exc)
         if self._alloc is not None:
             for slot, st in list(self._alloc.items()):
-                self._alloc.free(slot)
+                self._release(slot)
                 self._fail(st.request, exc)
             # the cache buffers may be donated-away or poisoned by the
-            # failed step — drop them so the next admission rebuilds
+            # failed step — drop them so the next admission rebuilds.
+            # Every prefix-pool row dies with them: the radix tree must
+            # forget its mappings or a later hit would copy ZEROED K/V
+            # into a slot and silently serve wrong tokens.
             self._caches = None
+            if self._prefix is not None:
+                self._prefix.reset()
 
     def _complete(self, st: SlotState):
         req = st.request
         seq = onp.concatenate(
             [req.payload, onp.asarray(st.generated, "int32")])
         now = time.monotonic()
+        t_first = st.t_first if st.t_first is not None else now
         self.metrics.observe_request(req.t_schedule - req.t_submit,
-                                     now - req.t_schedule)
+                                     t_first - req.t_schedule,
+                                     now - t_first)
         self.metrics.count("completed")
         self.metrics.count("tokens_generated", len(st.generated))
         req.future.set_result(seq)
@@ -814,8 +934,21 @@ class InferenceEngine:
     # ------------------------------------------------------------ decode path
     def _ensure_caches(self):
         if self._caches is None:
-            self._caches = self.net.init_slot_cache(self.num_slots + 1,
-                                                    self.max_length)
+            # slots + scratch + prefix pool share one array per layer so
+            # row-to-row copies and slot reads stay in a single buffer
+            self._caches = self.net.init_slot_cache(
+                self.num_slots + 1 + self.prefix_pool_rows,
+                self.max_length)
+
+    def _release(self, slot: int) -> SlotState:
+        """End a slot lease, dropping any prefix-cache read pin the
+        (possibly unfinished) prefill still holds."""
+        st = self._alloc.free(slot)
+        if st.pinned is not None:
+            if self._prefix is not None:
+                self._prefix.unpin(st.pinned)
+            st.pinned = None
+        return st
 
     def _decode_cycle(self):
         alloc = self._alloc
@@ -823,65 +956,265 @@ class InferenceEngine:
         # mid-flight deadline enforcement
         for slot, st in alloc.items():
             if st.request.expired(now):
-                alloc.free(slot)
+                self._release(slot)
                 self._fail(st.request, RequestTimeoutError(
                     f"request {st.request.id} timed out after "
                     f"{len(st.generated)} tokens"))
-        # admission: fill free slots from the queue; only an IDLE engine
-        # waits out the batching window — with requests in flight the
-        # arrivals ride the next cycle (continuous batching)
+        # admission: lease free slots to queued requests (prefix-cache
+        # lookup + copy happens at lease); only an IDLE engine waits out
+        # the batching window — with requests in flight the arrivals
+        # ride the next cycle (continuous batching)
         free = alloc.free_count
         if free and not self._batcher.empty():
             wait_us = self.max_wait_us if alloc.active_count == 0 else 0
             reqs = self._batcher.get_batch(
                 min(free, self.lattice.max_batch), wait_us, wait=False)
-            live = self._filter_expired(reqs)
-            groups = {}
-            for r in live:
-                groups.setdefault(self.lattice.seq(r.prompt_len),
-                                  []).append(r)
-            for tb in sorted(groups):
-                self._admit_group(groups[tb], tb)
-        if alloc.active_count:
+            self._admit(self._filter_expired(reqs))
+        self._prefill_cycle()
+        if any(not st.prefilling for _s, st in alloc.items()):
             self._decode_step()
 
-    def _admit_group(self, group, tb):
-        import jax.numpy as jnp
+    # --------------------------------------------------------- prefix cache
+    def _prefix_usable(self) -> bool:
+        return self._prefix is not None and not self._prefix_disabled
 
+    def _prefix_fault(self, where: str):
+        """Contain a fault at a serving.prefix_* site: the request just
+        loses the shortcut (full prefill), never fails.  Repeated
+        consecutive faults at EITHER site disable the cache — a
+        flapping lookup/copy path must not keep adding latency to every
+        admission."""
+        self.metrics.count("prefix_faults")
+        self.metrics.mark("prefix_fault")
+        self._prefix_faults[where] += 1
+        if self._prefix_faults[where] >= self.prefix_fault_limit and \
+                not self._prefix_disabled:
+            self._prefix_disabled = True
+            self.metrics.mark("prefix_disabled")
+
+    def _prefix_admit(self, st: SlotState, slot: int):
+        """Lease-time prefix reuse: longest-prefix lookup, pin, and the
+        device row copy.  On success ``st.filled`` skips the matched
+        region; on any contained fault the request prefills in full."""
+        req = st.request
+        try:
+            _inject("serving.prefix_lookup")
+            hit = self._prefix.lookup(req.payload)
+        except Exception:           # incl. RetryableFault: a host-side
+            self._prefix_fault("lookup")   # tree op has nothing to retry
+            return
+        # the limit counts CONSECUTIVE faults: a clean op resets ITS streak
+        self._prefix_faults["lookup"] = 0
+        if hit is None:
+            self.metrics.count("prefix_misses")
+            return
+        # always leave >= 1 suffix token: the final chunk's logits are
+        # where the FIRST generated token comes from
+        match, entry = hit
+        match = min(match, st.prompt_len - 1)
+        if match < self.prefix_min_tokens:
+            self.metrics.count("prefix_misses")
+            return
+        self._prefix.pin(entry)
+        try:
+            import jax.numpy as jnp
+            self._ensure_caches()
+            # riders=() — the copy is an OPTIONAL optimization: a
+            # retryable fault here must degrade to a miss immediately,
+            # not spend the request's retry budget (which a mandatory
+            # prefill/decode step may later need)
+            self._caches = self._run_step(
+                "serving.prefix_copy", ("prefix_copy",), self._jit_copy,
+                (self._caches, jnp.asarray(entry.row, jnp.int32),
+                 jnp.asarray(slot, jnp.int32),
+                 jnp.asarray(match, jnp.int32)), ())
+        except Exception:
+            # injection fires BEFORE dispatch, so the slot row is
+            # untouched and a full prefill from 0 is always correct.
+            # (A real device fault after a TPU donation would invalidate
+            # the cache buffers — but then the request's own prefill
+            # fails too and _fail_inflight rebuilds caches + resets the
+            # tree, same as any other step failure.)
+            self._prefix.unpin(entry)
+            self._prefix_fault("copy")
+            return
+        self._prefix_faults["copy"] = 0
+        st.filled = match
+        st.pinned = entry            # read-pinned until prefill completes
+        self.metrics.count("prefix_hits")
+        self.metrics.count("prefix_tokens_saved", match)
+
+    def _prefix_insert(self, st: SlotState, slot: int):
+        """After a request's prefill completes, cache its full prompt:
+        reserve a pool row (LRU-evicting zero-reader entries under
+        pressure) and copy the slot's K/V [0, prompt_len) into it.  A
+        failed copy removes the mapping — the tree must never point at
+        a row that does not hold what it promises."""
+        if not self._prefix_usable() or \
+                st.prompt_len < self.prefix_min_tokens:
+            return
+        try:
+            _inject("serving.prefix_lookup")
+            ev0 = self._prefix.evictions
+            entry = self._prefix.insert(st.tokens)
+            self.metrics.count("prefix_evictions",
+                               self._prefix.evictions - ev0)
+        except Exception:           # incl. RetryableFault, as in lookup
+            self._prefix_fault("lookup")
+            return
+        self._prefix_faults["lookup"] = 0
+        if entry is None:
+            return
+        try:
+            import jax.numpy as jnp
+            self._caches = self._run_step(
+                "serving.prefix_copy", ("prefix_copy",), self._jit_copy,
+                (self._caches, jnp.asarray(slot, jnp.int32),
+                 jnp.asarray(entry.row, jnp.int32),
+                 jnp.asarray(st.prompt_len, jnp.int32)), ())
+        except Exception:
+            self._prefix.remove(entry)
+            self._prefix_fault("copy")
+            return
+        self._prefix_faults["copy"] = 0
+        self.metrics.count("prefix_inserts")
+
+    # ------------------------------------------------------------ admission
+    def _admit(self, live):
+        """Lease a slot per request; prefix-cache hits copy their
+        matched K/V now, so the prefill phase only sees suffixes."""
         alloc = self._alloc
-        bb = self.lattice.batch(len(group))
-        toks = onp.zeros((bb, tb), "int32")
-        lens = onp.ones((bb,), "int32")
-        sidx = onp.full((bb,), alloc.scratch, "int32")
-        states = []
         now = time.monotonic()
         n_prompt = 0
-        for i, req in enumerate(group):
-            toks[i, :req.prompt_len] = req.payload
-            lens[i] = req.prompt_len
-            n_prompt += req.prompt_len
-            st = SlotState(req, req.prompt_len, req.max_new_tokens)
-            sidx[i] = alloc.alloc(st)
+        for req in live:
+            st = SlotState(req, req.prompt_len, req.max_new_tokens,
+                           tokens=req.payload)
+            slot = alloc.alloc(st)
             req.t_schedule = now
-            states.append(st)
-        self.metrics.count("admitted", len(group))
-        self.metrics.count("prompt_tokens", n_prompt)
-        self.metrics.count("padded_tokens", bb * tb - n_prompt)
+            n_prompt += req.prompt_len
+            if self._prefix_usable() and req.prompt_len > 1:
+                self._prefix_admit(st, slot)
+        if live:
+            self.metrics.count("admitted", len(live))
+            self.metrics.count("prompt_tokens", n_prompt)
+            self.metrics.mark("admit", len(live))
+
+    # -------------------------------------------------------------- prefill
+    def _prefill_cycle(self):
+        """Run prefill work: full-prompt groups (fresh short prompts —
+        the unchanged fast path) all at once, then at most ONE chunked
+        batch (suffixes behind a prefix hit, and long prompts) so a
+        giant prompt never starves the decode step more than one
+        chunk's worth per cycle."""
+        full, chunked = {}, []
+        for slot, st in self._alloc.items():
+            if not st.prefilling:
+                continue
+            if st.filled == 0 and st.prompt_len <= self.prefill_chunk:
+                full.setdefault(self.lattice.seq(st.prompt_len),
+                                []).append((slot, st))
+            else:
+                chunked.append((slot, st))
+        for tb in sorted(full):
+            self._prefill_full(full[tb], tb)
+        if chunked:
+            # oldest-admitted first, NOT slot order: under sustained
+            # long-prompt load the LIFO slot free list keeps re-leasing
+            # low slot numbers, and slot-ordered selection would starve
+            # high-numbered mid-prefill rows into deadline timeouts
+            chunked.sort(key=lambda it: it[1].request.t_schedule)
+            self._prefill_chunk_batch(chunked[:self.lattice.max_batch])
+
+    def _prefill_full(self, rows, tb):
+        import jax.numpy as jnp
+
+        bb = self.lattice.batch(len(rows))
+        toks = onp.zeros((bb, tb), "int32")
+        lens = onp.ones((bb,), "int32")
+        sidx = onp.full((bb,), self._alloc.scratch, "int32")
+        n_real = 0
+        for i, (slot, st) in enumerate(rows):
+            toks[i, :st.prompt_len] = st.tokens
+            lens[i] = st.prompt_len
+            sidx[i] = slot
+            n_real += st.prompt_len
+        self.metrics.count("padded_tokens", bb * tb - n_real)
         self.metrics.count("prefill_batches")
-        self.metrics.mark("admit", len(group))
         self._ensure_caches()
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
-             self._caches, jnp.asarray(sidx)), group)
+             self._caches, jnp.asarray(sidx)),
+            [st.request for _s, st in rows])
         first = onp.asarray(first)
         ok = onp.asarray(ok)
-        for i, st in enumerate(states):
+        for i, (slot, st) in enumerate(rows):
             if self.guard_nonfinite and not ok[i]:
-                self._fail_nonfinite(int(sidx[i]), st, "prefill")
+                self._fail_nonfinite(slot, st, "prefill")
                 continue
-            st.advance(int(first[i]))
-            self._finish_if_done(int(sidx[i]), st)
+            st.filled = st.prompt_len
+            self._first_token(slot, st, int(first[i]))
+
+    def _prefill_chunk_batch(self, rows):
+        """One chunked/offset prefill call over up to max_batch
+        prefilling rows: row i writes K/V for its next
+        ``min(remaining, prefill_chunk)`` prompt tokens behind its
+        already-populated [0, filled) region.  Rows at different
+        offsets with different chunk lengths share the call — ``lens``
+        and ``off`` are runtime arrays, only (bb, tb) picks the
+        program."""
+        import jax.numpy as jnp
+
+        take = [min(st.prompt_len - st.filled, self.prefill_chunk)
+                for _s, st in rows]
+        tb = self.lattice.seq(max(take))
+        bb = self.lattice.batch(len(rows))
+        toks = onp.zeros((bb, tb), "int32")
+        lens = onp.ones((bb,), "int32")
+        off = onp.zeros((bb,), "int32")
+        sidx = onp.full((bb,), self._alloc.scratch, "int32")
+        for i, (slot, st) in enumerate(rows):
+            toks[i, :take[i]] = st.tokens[st.filled:st.filled + take[i]]
+            lens[i] = take[i]
+            off[i] = st.filled
+            sidx[i] = slot
+        self.metrics.count("padded_tokens", bb * tb - sum(take))
+        self.metrics.count("prefill_chunks")
+        self._ensure_caches()
+        first, ok, self._caches = self._run_step(
+            "serving.prefill", ("chunk", bb, tb), self._jit_chunk,
+            (self._params(), jnp.asarray(toks), jnp.asarray(lens),
+             self._caches, jnp.asarray(sidx), jnp.asarray(off)),
+            [st.request for _s, st in rows])
+        first = onp.asarray(first)
+        ok = onp.asarray(ok)
+        for i, (slot, st) in enumerate(rows):
+            if self.guard_nonfinite and not ok[i]:
+                # ANY chunk's non-finite logits mean the activations —
+                # and therefore the K/V just written — are poisoned:
+                # fail now, not at the final chunk
+                self._fail_nonfinite(slot, st, "prefill")
+                continue
+            st.filled += take[i]
+            if st.filled == st.prompt_len:
+                self._first_token(slot, st, int(first[i]))
+
+    def _first_token(self, slot: int, st: SlotState, token: int):
+        """A request's prefill just completed: record TTFT, donate its
+        prefix to the cache (K/V [0, prompt_len) are final — decode
+        writes at prompt_len and beyond), release the read pin on its
+        own source entry, and enter decode."""
+        st.t_first = time.monotonic()
+        # release the read pin BEFORE inserting: in a pool at capacity
+        # the LRU victim may be this request's own source entry, and a
+        # still-held pin would block the insert forever (the source row
+        # is no longer read once prefill completed)
+        if st.pinned is not None:
+            self._prefix.unpin(st.pinned)
+            st.pinned = None
+        self._prefix_insert(st, slot)
+        st.advance(token)
+        self._finish_if_done(slot, st)
 
     def _fail_nonfinite(self, slot: int, st: SlotState, where: str):
         """One request's logits went NaN/Inf: free its slot and fail it
@@ -894,7 +1227,7 @@ class InferenceEngine:
         harmless), NaN survives additive masking — ``-inf + NaN`` is
         NaN — so a later tenant of the row would be poisoned through
         positions it never wrote."""
-        self._alloc.free(slot)
+        self._release(slot)
         if self._caches is not None:
             import jax
             self._caches = jax.tree_util.tree_map(
@@ -908,7 +1241,7 @@ class InferenceEngine:
     def _finish_if_done(self, slot: int, st: SlotState):
         if st.done or (st.request.eos_id is not None
                        and st.last_token == st.request.eos_id):
-            self._alloc.free(slot)
+            self._release(slot)
             self._complete(st)
 
     def _decode_step(self):
@@ -917,19 +1250,29 @@ class InferenceEngine:
         alloc = self._alloc
         s1 = self.num_slots + 1
         tok = onp.zeros((s1,), "int32")
-        pos = onp.zeros((s1,), "int32")
+        # idle rows (free slots, the scratch row, and slots still mid-
+        # chunked-prefill) park at position Tmax: their fixed-shape K/V
+        # write becomes an out-of-bounds scatter, which jax DROPS — they
+        # must not write at position 0, where a mid-prefill slot already
+        # holds real (copied or chunk-prefilled) prefix K/V
+        pos = onp.full((s1,), self.max_length, "int32")
+        riders = []
         for slot, st in alloc.items():
+            if st.prefilling:
+                continue
             tok[slot] = st.last_token
             pos[slot] = st.pos
+            riders.append(st.request)
         self.metrics.count("decode_steps")
         nxt, ok, self._caches = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
-             jnp.asarray(pos)),
-            [st.request for _, st in alloc.items()])
+             jnp.asarray(pos)), riders)
         nxt = onp.asarray(nxt)
         ok = onp.asarray(ok)
         for slot, st in alloc.items():
+            if st.prefilling:
+                continue
             if self.guard_nonfinite and not ok[slot]:
                 self._fail_nonfinite(slot, st, "decode")
                 continue

@@ -1,18 +1,22 @@
 """KV-cache slot management for continuous batching.
 
 The engine owns ONE persistent batched KV cache per layer, shaped
-(S+1, Tmax, H, D): rows 0..S-1 are SLOTS a generation request leases for
-its lifetime, row S is SCRATCH (the write target for padding rows of a
-bucketed prefill, and for free slots during a decode step — XLA wants a
-fixed shape, so every row computes every step).  This is the
-fixed-shape, XLA-friendly version of vLLM's paged KV blocks: instead of
-paging, a request leases a whole row, and "continuous batching" (Orca)
-falls out of rows being at independent positions — admission drops a new
-request into any free row mid-flight without disturbing the others.
+(S+1+P, Tmax, H, D): rows 0..S-1 are SLOTS a generation request leases
+for its lifetime, row S is SCRATCH (the write target for padding rows of
+a bucketed prefill, and for free slots during a decode step — XLA wants
+a fixed shape, so every row computes every step), and rows S+1..S+P are
+the PREFIX POOL (prefix_cache.py) holding the K/V of cached prompt
+prefixes — decode and prefill only ever index rows < S+1, so pool rows
+are never written except by the engine's explicit row-to-row copies.
+This is the fixed-shape, XLA-friendly version of vLLM's paged KV blocks:
+instead of paging, a request leases a whole row, and "continuous
+batching" (Orca) falls out of rows being at independent positions —
+admission drops a new request into any free row mid-flight without
+disturbing the others.
 
-:class:`SlotAllocator` tracks the lease lifecycle (admit → decode… →
-free) plus per-slot decode state; it is scheduler-thread-only (no
-locks) — the engine serializes all access.
+:class:`SlotAllocator` tracks the lease lifecycle (admit → [prefix copy
+→ chunked prefill…] → decode… → free) plus per-slot decode state; it is
+scheduler-thread-only (no locks) — the engine serializes all access.
 """
 from __future__ import annotations
 
@@ -22,12 +26,21 @@ __all__ = ["SlotState", "SlotAllocator"]
 
 
 class SlotState:
-    """Decode-time state of one leased slot."""
+    """Decode-time state of one leased slot.
+
+    A slot is PREFILLING from lease until its first token: ``filled``
+    counts cache positions already populated (a prefix-cache copy plus
+    any completed prefill chunks); once the final chunk's logits yield
+    the first token, ``last_token`` is set and the slot joins the decode
+    batch.  ``pinned`` holds the prefix-cache entry this slot copied
+    from, refcounted for the whole prefill so LRU eviction can never
+    reassign a row a retried copy might still read."""
 
     __slots__ = ("request", "prompt_len", "pos", "last_token", "generated",
-                 "max_new_tokens")
+                 "max_new_tokens", "tokens", "filled", "pinned", "t_first")
 
-    def __init__(self, request, prompt_len: int, max_new_tokens: int):
+    def __init__(self, request, prompt_len: int, max_new_tokens: int,
+                 tokens=None):
         self.request = request
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
@@ -37,10 +50,18 @@ class SlotState:
         self.pos = prompt_len
         self.last_token: Optional[int] = None
         self.generated: List[int] = []
+        self.tokens = tokens          # full prompt (decode mode)
+        self.filled = 0               # populated K/V positions [0, filled)
+        self.pinned = None            # PrefixEntry read-pinned while prefilling
+        self.t_first: Optional[float] = None   # first-token wall time (TTFT)
 
     @property
     def done(self) -> bool:
         return len(self.generated) >= self.max_new_tokens
+
+    @property
+    def prefilling(self) -> bool:
+        return self.last_token is None
 
     def advance(self, token: int):
         """Record one generated token; generated[i] sits at position

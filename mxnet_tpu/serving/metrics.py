@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .. import profiler as _profiler
 
@@ -88,14 +88,25 @@ class LatencyHistogram:
 
 
 class ServingMetrics:
-    """All engine counters + the three per-request latency histograms
-    (queue = submit→scheduled, compute = scheduled→done, total)."""
+    """All engine counters + the per-request PHASE latency histograms:
+    queue (submit→scheduled), prefill (scheduled→first token, including
+    any prefix-cache copy and every chunk), decode (first token→done),
+    total, and TTFT (submit→first token — the latency users feel and the
+    number the prefix cache exists to cut)."""
 
     _COUNTERS = ("submitted", "admitted", "completed", "rejected_queue_full",
                  "rejected_invalid", "timeouts", "cancelled",
-                 "prefill_batches", "decode_steps", "forward_batches",
+                 "prefill_batches", "prefill_chunks", "decode_steps",
+                 "forward_batches",
                  "bucket_hits", "compiles", "tokens_generated",
                  "prompt_tokens", "padded_tokens",
+                 # prefix cache (docs/serving.md): admission hits/misses,
+                 # prompt tokens whose prefill was skipped via a cached
+                 # prefix, LRU evictions under pool pressure, entries
+                 # inserted, and host/copy faults contained at the
+                 # serving.prefix_* injection sites
+                 "prefix_hits", "prefix_misses", "prefix_tokens_saved",
+                 "prefix_evictions", "prefix_inserts", "prefix_faults",
                  # resilience: transient-step retries, watchdog
                  # condemnations, atomic checkpoint commits, resumes
                  "retries", "watchdog_trips", "checkpoint_commits",
@@ -112,19 +123,31 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self.counters = {k: 0 for k in self._COUNTERS}
         self.queue = LatencyHistogram()
-        self.compute = LatencyHistogram()
+        self.prefill = LatencyHistogram()
+        self.decode = LatencyHistogram()
         self.total = LatencyHistogram()
+        self.ttft = LatencyHistogram()
 
     # ------------------------------------------------------------- counters
     def count(self, key: str, n: int = 1):
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
 
-    def observe_request(self, queue_s: float, compute_s: float):
+    def observe_request(self, queue_s: float, prefill_s: float,
+                        decode_s: Optional[float] = None):
+        """Record one completed request.  ``decode_s=None`` means the
+        request HAD no decode phase (forward mode): the decode and TTFT
+        histograms are skipped entirely — token-phase percentiles over a
+        tokenless mode would just be rows of zeros on a dashboard.  A
+        real 0.0 (a decode request finishing on its first token) is
+        counted."""
         with self._lock:
             self.queue.observe(queue_s)
-            self.compute.observe(compute_s)
-            self.total.observe(queue_s + compute_s)
+            self.prefill.observe(prefill_s)
+            self.total.observe(queue_s + prefill_s + (decode_s or 0.0))
+            if decode_s is not None:
+                self.decode.observe(decode_s)
+                self.ttft.observe(queue_s + prefill_s)
 
     # ------------------------------------------------- profiler integration
     def span(self, kind: str):
@@ -142,17 +165,20 @@ class ServingMetrics:
         with self._lock:
             c = dict(self.counters)
             lat = {"queue": self.queue.summary(),
-                   "compute": self.compute.summary(),
+                   "prefill": self.prefill.summary(),
+                   "decode": self.decode.summary(),
                    "total": self.total.summary()}
+            ttft = self.ttft.summary()
         lookups = c["bucket_hits"] + c["compiles"]
+        pref = c["prefix_hits"] + c["prefix_misses"]
         return {
             "requests": {k: c[k] for k in
                          ("submitted", "admitted", "completed",
                           "rejected_queue_full", "rejected_invalid",
                           "timeouts", "cancelled")},
             "batches": {k: c[k] for k in
-                        ("prefill_batches", "decode_steps",
-                         "forward_batches")},
+                        ("prefill_batches", "prefill_chunks",
+                         "decode_steps", "forward_batches")},
             "tokens": {k: c[k] for k in
                        ("tokens_generated", "prompt_tokens",
                         "padded_tokens")},
@@ -162,6 +188,17 @@ class ServingMetrics:
                 "hit_rate": round(c["bucket_hits"] / lookups, 4)
                 if lookups else None,
             },
+            "prefix_cache": {
+                "prefix_hits": c["prefix_hits"],
+                "prefix_misses": c["prefix_misses"],
+                "prefix_tokens_saved": c["prefix_tokens_saved"],
+                "prefix_evictions": c["prefix_evictions"],
+                "prefix_inserts": c["prefix_inserts"],
+                "prefix_faults": c["prefix_faults"],
+                "hit_rate": round(c["prefix_hits"] / pref, 4)
+                if pref else None,
+            },
+            "ttft": ttft,
             "resilience": {k: c[k] for k in
                            ("retries", "watchdog_trips",
                             "checkpoint_commits", "resumes",

@@ -1,0 +1,260 @@
+"""Host-side prefix cache: a radix tree over admitted prompt token
+sequences mapping matched prefixes to a reserved pool of KV cache rows.
+
+The serving KV cache (kv_slots.py) grows a POOL segment: per-layer rows
+``[S+1, S+1+P)`` of the ``(S+1+P, Tmax, H, D)`` arrays hold the K/V of
+cached prompt prefixes.  Because a token's K/V depends only causally on
+the tokens before it, two prompts sharing a prefix of length L share the
+K/V for positions ``[0, L)`` exactly — so a request whose prompt extends
+a cached prefix can copy those rows' K/V into its leased slot (one
+compiled row-to-row masked copy) and prefill ONLY the suffix.  This is
+the fixed-shape, XLA-friendly cousin of SGLang's RadixAttention /
+vLLM's prefix caching: instead of sharing pages in place, the matched
+region is duplicated into the slot row at lease time, which keeps every
+downstream program (decode, chunked prefill) reading exactly one row
+per request.
+
+Any PREFIX of a cached sequence is usable: an entry caching tokens
+``[t0..t55]`` serves a request sharing only ``[t0..t47]`` — the copy
+just stops at 48.  ``lookup`` therefore returns the longest common
+prefix between the query and ANY cached sequence (a walk of the radix
+tree), not just exact entry matches.
+
+Lifecycle: entries are LRU-evicted under pool pressure, but only at
+ZERO readers — the engine pins the source entry from lookup until the
+request's (possibly multi-cycle, chunked) prefill completes, so a
+mid-prefill source can never be reassigned under a retryable-copy
+retry.  Like :class:`~.kv_slots.SlotAllocator` this object is
+scheduler-thread-only (no locks); the engine exports observability
+through its own locked metrics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["PrefixCache", "PrefixEntry"]
+
+
+class _Node:
+    """One radix-tree node.  ``edge`` is the token run from the parent
+    (path compression); ``children`` keys on the first token of each
+    child's edge; ``entry`` is set iff a cached sequence ends here."""
+
+    __slots__ = ("edge", "children", "entry", "parent")
+
+    def __init__(self, edge: Tuple[int, ...], parent: Optional["_Node"]):
+        self.edge = edge
+        self.children: Dict[int, "_Node"] = {}
+        self.entry: Optional["PrefixEntry"] = None
+        self.parent = parent
+
+
+class PrefixEntry:
+    """One cached prefix: pool row ``row`` holds K/V for positions
+    ``[0, length)`` of the sequence spelled by the tree path."""
+
+    __slots__ = ("row", "length", "refs", "last_used", "node")
+
+    def __init__(self, row: int, length: int, node: _Node):
+        self.row = row
+        self.length = length
+        self.refs = 0           # in-flight readers (engine pin/unpin)
+        self.last_used = 0      # LRU tick, monotone per cache
+        self.node = node
+
+    def __repr__(self):
+        return (f"PrefixEntry(row={self.row}, len={self.length}, "
+                f"refs={self.refs})")
+
+
+class PrefixCache:
+    """Radix tree + pool-row free list.  ``row_base`` is the absolute
+    cache-row index of pool row 0 (``num_slots + 1`` in the engine's
+    layout); ``lookup``/``insert`` speak absolute rows so the engine can
+    hand them straight to the compiled copy."""
+
+    def __init__(self, pool_rows: int, row_base: int,
+                 min_tokens: int = 1):
+        if pool_rows < 1:
+            raise ValueError(f"pool_rows must be >= 1, got {pool_rows}")
+        self.pool_rows = int(pool_rows)
+        self.row_base = int(row_base)
+        self.min_tokens = max(1, int(min_tokens))
+        self.evictions = 0      # lifetime counter (engine snapshots deltas)
+        self._free: List[int] = list(
+            range(self.row_base + self.pool_rows - 1, self.row_base - 1, -1))
+        self._root = _Node((), None)
+        self._entries: List[PrefixEntry] = []
+        self._tick = 0
+
+    # ------------------------------------------------------------- queries
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free)
+
+    def lookup(self, tokens) -> Optional[Tuple[int, PrefixEntry]]:
+        """Longest common prefix between ``tokens`` and any cached
+        sequence: returns ``(match_len, entry)`` where ``entry.row``
+        holds valid K/V for at least ``[0, match_len)``, or ``None``.
+        Touches the entry's LRU tick."""
+        node, depth = self._walk(tokens)
+        if depth < self.min_tokens:
+            return None
+        entry = self._any_entry(node)
+        if entry is None:
+            return None
+        self._touch(entry)
+        return min(depth, entry.length), entry
+
+    def _walk(self, tokens) -> Tuple[_Node, int]:
+        """Descend as far as ``tokens`` matches the tree; returns the
+        deepest node whose subtree agrees with the matched prefix and
+        the match depth.  A partial-edge match still counts — every
+        entry below that edge spells the same tokens over it."""
+        node, depth, n = self._root, 0, len(tokens)
+        while depth < n:
+            child = node.children.get(int(tokens[depth]))
+            if child is None:
+                break
+            edge, m = child.edge, 0
+            while m < len(edge) and depth + m < n \
+                    and edge[m] == int(tokens[depth + m]):
+                m += 1
+            depth += m
+            node = child
+            if m < len(edge):       # diverged (or query ended) mid-edge
+                break
+        return node, depth
+
+    def _any_entry(self, node: _Node) -> Optional[PrefixEntry]:
+        """Any entry at-or-below ``node`` (all spell the matched prefix);
+        prefer the most recently used so LRU keeps hot rows alive."""
+        best, stack = None, [node]
+        while stack:
+            cur = stack.pop()
+            if cur.entry is not None and \
+                    (best is None or cur.entry.last_used > best.last_used):
+                best = cur.entry
+            stack.extend(cur.children.values())
+        return best
+
+    def _touch(self, entry: PrefixEntry):
+        self._tick += 1
+        entry.last_used = self._tick
+
+    # ------------------------------------------------------------ refcounts
+    def pin(self, entry: PrefixEntry):
+        entry.refs += 1
+
+    def unpin(self, entry: PrefixEntry):
+        if entry.refs <= 0:
+            raise RuntimeError(f"unpin of unpinned {entry!r}")
+        entry.refs -= 1
+
+    # -------------------------------------------------------------- insert
+    def insert(self, tokens) -> Optional[PrefixEntry]:
+        """Register ``tokens`` as a cached prefix and reserve a pool row
+        for it.  Returns the new entry — the CALLER owns copying K/V
+        ``[0, len(tokens))`` into ``entry.row`` and must :meth:`remove`
+        the entry if that copy fails.  Returns ``None`` when the exact
+        sequence is already cached (touched instead), too short, or no
+        row can be freed (pool full of pinned entries)."""
+        if len(tokens) < self.min_tokens:
+            return None
+        node = self._insert_node(tokens)
+        if node.entry is not None:
+            self._touch(node.entry)
+            return None
+        row = self._alloc_row()
+        if row is None:
+            # undo the structural insert: a refused entry must not leave
+            # a dead node behind (unbounded host growth + slower walks
+            # under a pool pinned full)
+            self._prune(node)
+            return None
+        entry = PrefixEntry(row, len(tokens), node)
+        node.entry = entry
+        self._entries.append(entry)
+        self._touch(entry)
+        return entry
+
+    def _insert_node(self, tokens) -> _Node:
+        """Standard radix insert: walk, splitting edges at divergence,
+        until a node spelling exactly ``tokens`` exists."""
+        node, i, n = self._root, 0, len(tokens)
+        while i < n:
+            child = node.children.get(int(tokens[i]))
+            if child is None:
+                leaf = _Node(tuple(int(t) for t in tokens[i:]), node)
+                node.children[int(tokens[i])] = leaf
+                return leaf
+            edge, m = child.edge, 0
+            while m < len(edge) and i + m < n \
+                    and edge[m] == int(tokens[i + m]):
+                m += 1
+            if m == len(edge):
+                node, i = child, i + m
+                continue
+            # split child's edge at m
+            mid = _Node(edge[:m], node)
+            node.children[edge[0]] = mid
+            child.edge = edge[m:]
+            child.parent = mid
+            mid.children[child.edge[0]] = child
+            if i + m == n:          # tokens end exactly at the split
+                return mid
+            node, i = mid, i + m
+        return node
+
+    def _alloc_row(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for e in self._entries:
+            if e.refs == 0 and (victim is None
+                                or e.last_used < victim.last_used):
+                victim = e
+        if victim is None:          # every entry pinned by a reader
+            return None
+        row = victim.row
+        self._detach(victim)
+        self.evictions += 1
+        return row
+
+    # ------------------------------------------------------------- removal
+    def remove(self, entry: PrefixEntry):
+        """Drop an entry and return its row to the free pool (the
+        engine's failed-insert-copy path — the row holds garbage)."""
+        self._detach(entry)
+        self._free.append(entry.row)
+
+    def _detach(self, entry: PrefixEntry):
+        self._entries.remove(entry)
+        entry.node.entry = None
+        self._prune(entry.node)
+
+    def _prune(self, node: _Node):
+        """Drop now-dead leaves so the tree doesn't grow unboundedly."""
+        while node.parent is not None and node.entry is None \
+                and not node.children:
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+
+    def reset(self):
+        """Forget everything — the engine calls this whenever the device
+        cache buffers are dropped/rebuilt (step failure), because every
+        pool row's K/V died with them; serving a stale mapping would be
+        silent corruption."""
+        self._free = list(
+            range(self.row_base + self.pool_rows - 1, self.row_base - 1, -1))
+        self._root = _Node((), None)
+        self._entries = []
+
+    def __repr__(self):
+        return (f"PrefixCache(rows={self.pool_rows}, "
+                f"entries={len(self._entries)}, free={len(self._free)}, "
+                f"evictions={self.evictions})")

@@ -44,14 +44,15 @@ def _engine(net, **kw):
 def test_batched_greedy_parity_and_bounded_compiles(net):
     """The acceptance contract: a mixed-length concurrent workload decoded
     by the engine is token-identical to per-request net.generate, and the
-    number of XLA programs stays <= the bucket lattice (+1 decode step)."""
+    number of XLA programs stays <= twice the bucket lattice (full +
+    chunked prefill variants) + decode step + prefix row copy."""
     prompts = _prompts((3, 5, 9, 12, 5, 7, 16, 2))
     refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
                          temperature=0).asnumpy()[0] for p in prompts]
     eng = _engine(net)
     n_warm = eng.warmup()
-    lattice_size = len(eng.lattice)
-    assert n_warm <= lattice_size + 1          # prefill points + decode
+    bound = 2 * len(eng.lattice) + 2       # full+chunk lattices, decode, copy
+    assert n_warm <= bound
     with eng:
         futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
         outs = [f.result(timeout=120) for f in futs]
@@ -60,7 +61,7 @@ def test_batched_greedy_parity_and_bounded_compiles(net):
     s = eng.stats()
     # mixed-shape traffic after warmup NEVER compiles: all bucket hits
     assert s["compile_cache"]["compiles"] == n_warm
-    assert s["compile_cache"]["compiles"] <= lattice_size + 1
+    assert s["compile_cache"]["compiles"] <= bound
     assert s["compile_cache"]["bucket_hits"] > 0
     assert s["requests"]["completed"] == len(prompts)
     assert s["tokens"]["tokens_generated"] == 8 * len(prompts)
@@ -122,7 +123,9 @@ def test_request_timeout_in_queue(net):
 def test_invalid_requests_rejected(net):
     eng = _engine(net)
     with pytest.raises(InvalidRequestError):
-        eng.submit(onp.arange(17, dtype="int32"))        # > largest bucket
+        # prompts longer than the largest seq bucket are admissible now
+        # (chunked prefill) — but prompt + generation must fit the KV rows
+        eng.submit(onp.arange(60, dtype="int32"))        # 60 + 8 > 64
     with pytest.raises(InvalidRequestError):
         eng.submit(onp.arange(16, dtype="int32"),
                    max_new_tokens=64)                     # KV overflow
@@ -208,6 +211,253 @@ def test_forward_mode_batching_parity(net):
     s = eng.stats()
     assert s["compile_cache"]["compiles"] == n_warm
     assert s["requests"]["completed"] == 5
+    # forward mode has no token phases: compute lands in "prefill",
+    # the decode and TTFT histograms stay EMPTY (not padded with zeros)
+    assert s["latency"]["prefill"]["count"] == 5
+    assert s["latency"]["decode"]["count"] == 0
+    assert s["ttft"]["count"] == 0
+
+
+# ------------------------------------------------------- prefix cache
+
+def _shared_prefix_prompts(n, shared_len=10, tail_len=4, seed=9):
+    rs = onp.random.RandomState(seed)
+    shared = rs.randint(0, 97, (shared_len,)).astype("int32")
+    return [onp.concatenate([shared,
+                             rs.randint(0, 97, (tail_len,)).astype("int32")])
+            for _ in range(n)]
+
+
+def test_prefix_cache_parity_on_vs_off(net):
+    """THE acceptance contract: greedy decode through the engine is
+    token-identical with the prefix cache enabled vs disabled (and vs
+    per-request generate), while the cache actually hits and the
+    compiles counter stays frozen after warmup."""
+    prompts = _shared_prefix_prompts(6)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    off = _engine(net)
+    off.warmup()
+    with off:
+        outs_off = [off.infer(p, max_new_tokens=8) for p in prompts]
+    on = _engine(net, prefix_pool_rows=4, prefix_min_tokens=2)
+    n_warm = on.warmup()
+    with on:
+        # serial submits so every later request can hit the first insert
+        outs_on = [on.infer(p, max_new_tokens=8) for p in prompts]
+    for r, o_off, o_on in zip(refs, outs_off, outs_on):
+        onp.testing.assert_array_equal(r, o_off)
+        onp.testing.assert_array_equal(r, o_on)
+    s = on.stats()
+    assert s["compile_cache"]["compiles"] == n_warm   # frozen after warmup
+    pc = s["prefix_cache"]
+    assert pc["prefix_hits"] >= len(prompts) - 1
+    assert pc["prefix_tokens_saved"] >= (len(prompts) - 1) * 9
+    assert pc["prefix_inserts"] >= 1
+    assert off.stats()["prefix_cache"]["prefix_hits"] == 0
+
+
+def test_prefix_cache_eviction_under_slot_pressure(net):
+    """A 1-row pool under a stream of distinct prompts must LRU-evict
+    (zero-reader entries only) and keep serving correct tokens."""
+    prompts = _prompts((12, 13, 14, 12, 11), seed=23)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 6,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, prefix_pool_rows=1, prefix_min_tokens=2)
+    eng.warmup()
+    with eng:
+        outs = [eng.infer(p, max_new_tokens=6) for p in prompts]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    pc = eng.stats()["prefix_cache"]
+    assert pc["prefix_evictions"] >= 3       # 5 distinct prompts, 1 row
+    assert eng.stats()["engine"]["prefix_entries"] == 1
+
+
+def test_prefix_cache_radix_and_refcounts():
+    """PrefixCache unit semantics: longest-common-prefix lookup across
+    entries (any prefix of a cached row is usable), LRU eviction under
+    pool pressure, and pinned (refcounted) entries are NEVER evicted —
+    shared prefixes are freed only at zero readers."""
+    from mxnet_tpu.serving import PrefixCache
+    pc = PrefixCache(pool_rows=2, row_base=100, min_tokens=2)
+    a = pc.insert([1, 2, 3, 4, 5, 6])
+    assert a is not None and a.row == 100 and a.length == 6
+    # partial match against a longer entry: [1,2,3,9] shares [1,2,3)
+    m = pc.lookup([1, 2, 3, 9, 9])
+    assert m is not None and m[0] == 3 and m[1] is a
+    # exact re-insert is a no-op (touched, not duplicated)
+    assert pc.insert([1, 2, 3, 4, 5, 6]) is None and len(pc) == 1
+    b = pc.insert([7, 8, 9])
+    assert b is not None and len(pc) == 2 and pc.free_rows == 0
+    # pool full: next insert evicts the LRU zero-reader entry (a)
+    c = pc.insert([5, 5, 5, 5])
+    assert c is not None and c.row == a.row and pc.evictions == 1
+    assert pc.lookup([1, 2, 3, 4]) is None           # a is gone
+    # pin both survivors: NOTHING is evictable, insert must refuse —
+    # and a refused insert must not leak radix nodes (regression: a
+    # pool pinned full used to grow one dead node per refusal)
+    def n_nodes():
+        stack, n = [pc._root], 0
+        while stack:
+            cur = stack.pop()
+            n += 1
+            stack.extend(cur.children.values())
+        return n
+    pc.pin(b), pc.pin(c)
+    before = n_nodes()
+    for _ in range(5):
+        assert pc.insert([6, 6, 6]) is None
+    assert pc.evictions == 1 and n_nodes() == before
+    # one unpin frees exactly that entry for eviction
+    pc.unpin(c)
+    d = pc.insert([6, 6, 6])
+    assert d is not None and d.row == c.row and pc.evictions == 2
+    assert pc.lookup([7, 8, 9])[1] is b              # pinned b survived
+    with pytest.raises(RuntimeError):
+        pc.unpin(c)                                  # already at zero refs
+    # reset forgets everything (engine calls it when device caches drop)
+    pc.reset()
+    assert len(pc) == 0 and pc.free_rows == 2
+    assert pc.lookup([7, 8, 9]) is None
+
+
+def test_chunked_prefill_longer_than_largest_bucket(net):
+    """A prompt LONGER than the largest seq bucket prefills in chunks
+    (token-identical to generate) and never stalls an in-flight short
+    decode: both complete, compiles stay frozen."""
+    long_p = _prompts((40,), seed=33)[0]       # largest bucket is 16
+    short_p = _prompts((5,), seed=34)[0]
+    ref_long = net.generate(mx.nd.array(long_p[None], dtype="int32"), 8,
+                            temperature=0).asnumpy()[0]
+    ref_short = net.generate(mx.nd.array(short_p[None], dtype="int32"), 8,
+                             temperature=0).asnumpy()[0]
+    eng = _engine(net, prefill_chunk=16)
+    n_warm = eng.warmup()
+    with eng:
+        f_long = eng.submit(long_p, max_new_tokens=8)
+        f_short = eng.submit(short_p, max_new_tokens=8)
+        onp.testing.assert_array_equal(ref_long, f_long.result(timeout=120))
+        onp.testing.assert_array_equal(ref_short,
+                                       f_short.result(timeout=120))
+    s = eng.stats()
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["batches"]["prefill_chunks"] >= 3     # 40 tokens / 16-chunks
+    # chunking also composes with the prefix cache: a second engine
+    # serving the same long prompt twice hits on the whole prefix
+    eng2 = _engine(net, prefill_chunk=16, prefix_pool_rows=2,
+                   prefix_min_tokens=2)
+    eng2.warmup()
+    with eng2:
+        o1 = eng2.infer(long_p, max_new_tokens=8)
+        o2 = eng2.infer(long_p, max_new_tokens=8)
+    onp.testing.assert_array_equal(ref_long, o1)
+    onp.testing.assert_array_equal(ref_long, o2)
+    pc = eng2.stats()["prefix_cache"]
+    assert pc["prefix_hits"] == 1 and pc["prefix_tokens_saved"] == 39
+
+
+def test_prefix_fault_injection_keeps_serving(net):
+    """Faults at the serving.prefix_* sites degrade to cache misses —
+    tokens stay correct, nothing is stranded — and repeated faults
+    disable the cache for the engine's lifetime."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _shared_prefix_prompts(6, seed=41)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 6,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, prefix_pool_rows=4, prefix_min_tokens=2,
+                  prefix_fault_limit=3)
+    eng.warmup()
+    plan = (FaultPlan()
+            .raise_at("serving.prefix_copy", at=2)
+            .raise_at("serving.prefix_lookup", every=1, max_fires=8))
+    with plan:
+        with eng:
+            outs = [eng.infer(p, max_new_tokens=6) for p in prompts]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["requests"]["completed"] == len(prompts)
+    assert s["prefix_cache"]["prefix_faults"] >= 3
+    assert s["engine"]["prefix_disabled"]          # tripped the limit
+    assert plan.fired("serving.prefix_lookup") >= 3
+
+
+def test_prefix_copy_fault_streak_disables(net):
+    """A permanently failing COPY path must trip the disable limit even
+    though every copy is preceded by a clean lookup (per-site streaks),
+    and copy faults must not spend the request's retry budget — tokens
+    stay correct throughout."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _shared_prefix_prompts(6, seed=71)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 6,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, prefix_pool_rows=4, prefix_min_tokens=2,
+                  prefix_fault_limit=3)
+    eng.warmup()
+    plan = FaultPlan().raise_at("serving.prefix_copy", every=1,
+                                retryable=True)
+    with plan:
+        with eng:
+            outs = [eng.infer(p, max_new_tokens=6) for p in prompts]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["requests"]["completed"] == len(prompts)
+    assert s["engine"]["prefix_disabled"]
+    assert s["prefix_cache"]["prefix_inserts"] == 0
+    # retryable copy faults degrade immediately — no budgeted retries
+    assert s["resilience"]["retries"] == 0
+
+
+def test_phase_latency_and_ttft_reported(net):
+    with _engine(net, prefix_pool_rows=2) as eng:
+        eng.infer(_prompts((6,), seed=50)[0], max_new_tokens=4)
+    s = eng.stats()
+    lat = s["latency"]
+    for phase in ("queue", "prefill", "decode", "total"):
+        assert lat[phase]["count"] == 1
+    assert s["ttft"]["count"] == 1
+    for k in ("p50_ms", "p95_ms", "p99_ms"):
+        assert s["ttft"][k] >= 0
+    # decode happened after the first token: total >= prefill component
+    assert lat["total"]["mean_ms"] >= lat["prefill"]["mean_ms"]
+
+
+@pytest.mark.slow
+@pytest.mark.serving_perf
+def test_prefix_cache_cuts_ttft():
+    """Perf contract (CPU sanity of the --workload prefix bench): on a
+    repeated-system-prompt workload the cache cuts median TTFT >= 25%
+    at a >= 80% hit rate.  Needs a COMPUTE-bound prefill (the module
+    fixture's model is dispatch-bound — a 120-token prefill there costs
+    less than the row copy it avoids), so it builds its own net;
+    excluded from the tier-1 smoke run via the slow marker."""
+    big = get_gpt2("gpt2_124m", vocab_size=512, units=256, num_layers=4,
+                   num_heads=8, max_length=144, dropout=0.0)
+    big.initialize()
+    rs = onp.random.RandomState(7)
+    shared = rs.randint(0, 512, (120,)).astype("int32")
+    prompts = [onp.concatenate(
+        [shared, rs.randint(0, 512, (8,)).astype("int32")])
+        for _ in range(12)]
+
+    def run(**kw):
+        eng = InferenceEngine(big, num_slots=2, max_batch=2,
+                              seq_buckets=(16, 32, 64, 128),
+                              default_max_new_tokens=2, **kw)
+        eng.warmup()
+        with eng:
+            for p in prompts:
+                eng.infer(p, max_new_tokens=2)
+        return eng.stats()
+
+    s_off = run()
+    s_on = run(prefix_pool_rows=2, prefix_min_tokens=8)
+    ttft_off = s_off["ttft"]["p50_ms"]
+    ttft_on = s_on["ttft"]["p50_ms"]
+    assert s_on["prefix_cache"]["hit_rate"] >= 0.8
+    assert ttft_on <= 0.75 * ttft_off, (ttft_off, ttft_on)
 
 
 # ------------------------------------------------------- component units

@@ -158,7 +158,65 @@ def serving_scenarios(net):
             engine_kw={"max_wait_us": 100000.0})),
         ("sigterm_drain", lambda: _serving_scenario(
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
+        ("prefix_storm", lambda: serving_prefix_storm(net)),
     ]
+
+
+def serving_prefix_storm(net):
+    """Prefix-cache chaos (docs/serving.md): a 1-row pool THRASHED by
+    shared-prefix prompts of varying lengths (insert-evict churn on
+    every request) while faults land mid-copy (plain and retryable) and
+    mid-lookup.  The invariant is NO STALE K/V SERVED: every request
+    must complete with tokens identical to a fault-free per-request
+    ``net.generate`` — a prefix row evicted/re-filled at the wrong
+    moment, or a partially applied copy, would show up as a token
+    mismatch.  Prompts are longer than the seq bucket, so the storm
+    also crosses the chunked-prefill path."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import FaultPlan
+
+    rs = onp.random.RandomState(5)
+    shared = rs.randint(0, 61, (12,)).astype("int32")
+    prompts = [onp.concatenate([shared[:8 + (i % 5)],
+                                rs.randint(0, 61, (4,)).astype("int32")])
+               for i in range(8)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    plan = (FaultPlan()
+            .raise_at("serving.prefix_copy", at=2)
+            .raise_at("serving.prefix_copy", at=5, retryable=True)
+            .raise_at("serving.prefix_lookup", at=4))
+    eng = _engine(net, prefix_pool_rows=1, prefix_min_tokens=2)
+    mismatched = stranded = 0
+    with plan:
+        eng.start()
+        for p, ref in zip(prompts, refs):
+            try:
+                out = eng.infer(p, max_new_tokens=3)
+                if not onp.array_equal(out, ref):
+                    mismatched += 1
+            except Exception:
+                stranded += 1
+        try:
+            eng.stop(timeout=15)
+        except Exception:
+            pass
+    _join_zombies()
+    s = eng.stats()
+    passed = (mismatched == 0 and stranded == 0
+              and s["prefix_cache"]["prefix_hits"] >= 1
+              and s["prefix_cache"]["prefix_faults"] >= 2)
+    return {
+        "name": "serving/prefix_storm",
+        "passed": bool(passed),
+        "detail": {"requests": len(prompts), "mismatched": mismatched,
+                   "stranded": stranded,
+                   "prefix": s["prefix_cache"],
+                   "faults_fired": plan.fired(),
+                   "prefix_disabled": s["engine"]["prefix_disabled"]},
+    }
 
 
 # ------------------------------------------------------- training scenarios
