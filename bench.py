@@ -546,6 +546,14 @@ def main():
             rec = {"metric": f"{name}_error", "value": None, "unit": "",
                    "vs_baseline": None, "platform": platform,
                    "error": f"{type(e).__name__}: {e}"[:400]}
+        # perf trajectory and process counters travel together: embed
+        # the observability registry snapshot (non-zero counters/gauges)
+        # taken right after the workload (docs/observability.md)
+        try:
+            from mxnet_tpu.observability import flatten
+            rec["registry"] = flatten()
+        except Exception:
+            pass
         print(json.dumps(rec), flush=True)
 
 

@@ -231,7 +231,15 @@ def main():
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)
+    from mxnet_tpu.observability import flatten
     for rec in recs:
+        # the final registry snapshot rides each record, so the BENCH
+        # json carries compile/bucket/prefix counters next to the
+        # throughput they explain (docs/observability.md)
+        try:
+            rec["registry"] = flatten(prefix="mxtpu_serving")
+        except Exception:
+            pass
         print(json.dumps(rec), flush=True)
 
 

@@ -84,6 +84,7 @@ _LAZY = {
     "runtime": ".runtime",
     "serving": ".serving",
     "resilience": ".resilience",
+    "observability": ".observability",
     "test_utils": ".test_utils",
     "np": ".numpy",
     "npx": ".numpy_extension",

@@ -222,6 +222,14 @@ class NDArrayIter(DataIter):
                 self.quarantined += 1
                 if self._metrics is not None:
                     self._metrics.count("quarantined_batches")
+                # fleet-stable name, independent of whether a metrics=
+                # sink was attached (quarantine is rare; the registry
+                # get-or-create is off any hot path)
+                from .observability.registry import default_registry
+                default_registry().counter(
+                    "mxtpu_io_quarantined_batches_total",
+                    help="input batches skipped by non-finite "
+                         "quarantine").inc()
                 continue             # skip the poisoned batch entirely
             return batch
 

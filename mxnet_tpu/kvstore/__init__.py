@@ -80,6 +80,17 @@ class KVStore(KVStoreBase):
         self._optimizer = None
         self._compression: Optional[dict] = None
         self._residuals: Dict = {}   # (key, device_idx) -> error feedback
+        # fleet counters (docs/observability.md): push/pull traffic per
+        # kvstore type.  Created once here — inc() on the push/pull
+        # path is a per-metric lock, not a registry lookup.
+        from ..observability.registry import default_registry
+        _reg = default_registry()
+        self._obs_push = _reg.counter("mxtpu_kvstore_push_total",
+                                      help="kvstore push calls",
+                                      type=kv_type)
+        self._obs_pull = _reg.counter("mxtpu_kvstore_pull_total",
+                                      help="kvstore pull calls",
+                                      type=kv_type)
 
     # -- identity ---------------------------------------------------------
     @property
@@ -139,6 +150,7 @@ class KVStore(KVStoreBase):
 
     def push(self, key, value, priority=0):
         _inject("kvstore.push")
+        self._obs_push.inc()
         from ..ndarray.sparse import RowSparseNDArray, _RowSparseCot
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
@@ -183,6 +195,7 @@ class KVStore(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         _inject("kvstore.pull")
+        self._obs_pull.inc()
         keys, outs = _normalize(key, out)
         for k, o in zip(keys, outs):
             targets = o if isinstance(o, list) else [o]

@@ -1,0 +1,49 @@
+"""``mxnet_tpu.observability`` — the unified telemetry plane.
+
+One coherent surface over what used to be four disconnected ones
+(serving histograms, profiler markers, monitor NaN provenance, ad-hoc
+resilience/guardrail/io counter dicts):
+
+- :mod:`.registry` — a process-wide, lock-guarded
+  :class:`MetricsRegistry` of labeled counters/gauges/histograms that
+  serving, resilience (loop/watchdog/checkpoint), guardrails, kvstore
+  and ``io`` all register into: ONE ``collect()`` snapshot covers the
+  whole process under stable metric names (catalog:
+  docs/observability.md).
+- :mod:`.trace` — low-overhead span tracing with request-id/trace-id
+  propagation across the serving scheduler thread boundary and around
+  ``ResilientLoop`` / ``ShardedTrainer.step``; bounded ring buffer,
+  per-request timeline dump, zero-cost when disabled (one global +
+  ``None`` check — the FaultPlan pattern).
+- :mod:`.export` — Prometheus text-format and JSON-lines exporters plus
+  a :class:`BackgroundExporter` thread with graceful drain (wired into
+  ``InferenceEngine.stop()`` and SIGTERM handling).
+
+Quick start::
+
+    from mxnet_tpu import observability as obs
+
+    tracer = obs.enable_tracing()               # span recording on
+    reg = obs.default_registry()
+    exp = obs.BackgroundExporter(path="metrics.prom", interval=5.0)
+    with InferenceEngine(net).attach_exporter(exp) as eng:
+        fut = eng.submit(prompt)
+        out = fut.result()
+        print(obs.to_prometheus(reg.collect()))
+        print(tracer.timeline(fut.trace_id))    # submit→…→complete
+"""
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       default_registry)
+from .trace import (Span, Tracer, active as active_tracer,
+                    disable as disable_tracing, enable as enable_tracing)
+from .export import (BackgroundExporter, flatten, parse_prometheus,
+                     to_json_lines, to_prometheus)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry",
+    "Span", "Tracer", "enable_tracing", "disable_tracing",
+    "active_tracer",
+    "BackgroundExporter", "to_prometheus", "to_json_lines",
+    "parse_prometheus", "flatten",
+]

@@ -1,0 +1,240 @@
+"""Low-overhead request/span tracing with cross-thread propagation.
+
+A **span** is a named ``[t0, t1)`` interval tagged with a ``trace_id``;
+every span of one serving request (or one training run) shares the id,
+so a bounded ring buffer of spans can be re-assembled into a per-request
+timeline: ``submit → queue → prefix lookup/copy → chunked prefill
+cycles → decode steps → complete`` for serving,
+``loop.step → trainer.step → checkpoint.commit`` for training.
+
+Design rules (same contract as :mod:`mxnet_tpu.resilience.faults`):
+
+- **zero-cost when disabled** — every instrumentation site does ONE
+  module-global load plus a ``None`` check and nothing else.  The
+  serving engine's decode medians must stay within trial noise with
+  tracing off (asserted by the ``obs`` bench contract).
+- **propagation crosses threads by value**: the caller thread stamps
+  ``trace_id`` on the request at ``submit``; the scheduler thread reads
+  it — no thread-locals to lose across the queue boundary.  Batched
+  device calls (one prefill/decode program serving many requests)
+  record ONE span carrying ``trace_ids`` of every rider, so a request's
+  timeline includes the shared steps it rode.
+- **bounded memory**: spans land in a ``deque(maxlen=capacity)`` ring;
+  a forgotten-enabled tracer can never OOM a serving host.
+- **device-trace bridge**: with ``profiler_markers=True`` each span
+  also opens a :class:`mxnet_tpu.profiler.Marker` range, so the same
+  span names land inside the ``jax.profiler`` device trace next to the
+  XLA ops they cover.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "enable", "disable", "active"]
+
+
+class Span:
+    """One recorded interval (or instant, when ``t1 == t0``)."""
+
+    __slots__ = ("name", "trace_id", "trace_ids", "t0", "t1", "attrs")
+
+    def __init__(self, name: str, trace_id: Optional[int], t0: float,
+                 t1: float, trace_ids: Optional[tuple] = None,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.trace_ids = trace_ids or ()
+        self.t0 = t0
+        self.t1 = t1
+        self.attrs = attrs or {}
+
+    def in_trace(self, trace_id: int) -> bool:
+        return self.trace_id == trace_id or trace_id in self.trace_ids
+
+    @property
+    def duration_s(self) -> float:
+        return self.t1 - self.t0
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "trace_ids": list(self.trace_ids), "t0": self.t0,
+                "t1": self.t1,
+                "duration_ms": round(1e3 * (self.t1 - self.t0), 4),
+                "attrs": dict(self.attrs)}
+
+    def __repr__(self):
+        tid = self.trace_id if self.trace_id is not None else \
+            list(self.trace_ids)
+        return (f"Span({self.name!r}, trace={tid}, "
+                f"{1e3 * (self.t1 - self.t0):.3f}ms)")
+
+
+class _LiveSpan:
+    """A started-but-unfinished span; also usable as a context manager.
+    Recording happens at ``finish()`` so a span abandoned by a crashed
+    step simply never lands in the ring (no torn half-spans)."""
+
+    __slots__ = ("_tracer", "name", "trace_id", "trace_ids", "t0",
+                 "attrs", "_marker")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 trace_id: Optional[int], trace_ids: Optional[tuple],
+                 attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.trace_ids = trace_ids
+        self.attrs = attrs
+        self._marker = None
+        if tracer.profiler_markers:
+            from .. import profiler as _profiler
+            self._marker = _profiler.device_span(name)
+            self._marker.start()
+        self.t0 = time.monotonic()
+
+    def finish(self, **attrs):
+        t1 = time.monotonic()
+        if self._marker is not None:
+            self._marker.stop()
+            self._marker = None
+        if attrs:
+            self.attrs.update(attrs)
+        self._tracer._record(Span(self.name, self.trace_id, self.t0, t1,
+                                  self.trace_ids, self.attrs))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        if etype is not None:
+            self.attrs["error"] = etype.__name__
+        self.finish()
+
+
+class Tracer:
+    """Ring-buffered span recorder.  Thread-safe throughout."""
+
+    def __init__(self, capacity: int = 4096,
+                 profiler_markers: bool = False):
+        self.capacity = int(capacity)
+        self.profiler_markers = bool(profiler_markers)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._ids = itertools.count(1)
+        self.dropped = 0          # spans evicted by the ring bound
+
+    # ---------------------------------------------------------------- ids
+    def new_trace_id(self) -> int:
+        """A fresh process-unique trace id (itertools.count is GIL-atomic)."""
+        return next(self._ids)
+
+    # ------------------------------------------------------------- recording
+    def _record(self, span: Span):
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(span)
+
+    def span(self, name: str, trace_id: Optional[int] = None,
+             trace_ids: Optional[tuple] = None, **attrs) -> _LiveSpan:
+        """Start a span; finish via ``with`` or ``.finish()``."""
+        return _LiveSpan(self, name, trace_id,
+                         tuple(trace_ids) if trace_ids else None, attrs)
+
+    def record_span(self, name: str, t0: float, t1: float,
+                    trace_id: Optional[int] = None,
+                    trace_ids: Optional[tuple] = None, **attrs):
+        """Record a RETROSPECTIVE span from timestamps the caller
+        already holds (e.g. the queue phase, measured by request
+        timestamps) — no live bookkeeping on the hot path."""
+        self._record(Span(name, trace_id, t0, t1,
+                          tuple(trace_ids) if trace_ids else None, attrs))
+
+    def event(self, name: str, trace_id: Optional[int] = None, **attrs):
+        """Instant (zero-duration) span."""
+        now = time.monotonic()
+        self._record(Span(name, trace_id, now, now, None, attrs))
+
+    # --------------------------------------------------------------- queries
+    def spans(self, trace_id: Optional[int] = None,
+              name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._ring)
+        if trace_id is not None:
+            out = [s for s in out if s.in_trace(trace_id)]
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def timeline(self, trace_id: int) -> List[dict]:
+        """Every span of one trace, oldest-first, offsets relative to
+        the trace's first span — the per-request timeline dump.
+        ``None`` (a future whose request predates tracing) is NOT a
+        wildcard here: it returns an empty timeline, never the whole
+        ring dressed up as one request."""
+        if trace_id is None:
+            return []
+        spans = sorted(self.spans(trace_id), key=lambda s: (s.t0, s.t1))
+        if not spans:
+            return []
+        base = spans[0].t0
+        out = []
+        for s in spans:
+            d = s.as_dict()
+            d["offset_ms"] = round(1e3 * (s.t0 - base), 4)
+            out.append(d)
+        return out
+
+    def trace_ids(self) -> List[int]:
+        seen: Dict[int, None] = {}
+        with self._lock:
+            for s in self._ring:
+                if s.trace_id is not None:
+                    seen.setdefault(s.trace_id, None)
+                for t in s.trace_ids:
+                    seen.setdefault(t, None)
+        return list(seen)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+
+# The one active tracer.  Written under _LOCK; read lock-free on hot
+# paths (a torn read of a single reference is impossible in CPython).
+_ACTIVE: Optional[Tracer] = None
+_LOCK = threading.Lock()
+
+
+def enable(capacity: int = 4096,
+           profiler_markers: bool = False) -> Tracer:
+    """Install (or replace) the process-global tracer and return it.
+    Replacing drops the previous ring — tracing config is a process
+    decision, not a nesting scope like FaultPlan."""
+    global _ACTIVE
+    tracer = Tracer(capacity=capacity, profiler_markers=profiler_markers)
+    with _LOCK:
+        _ACTIVE = tracer
+    return tracer
+
+
+def disable() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def active() -> Optional[Tracer]:
+    """The hot-path hook: one global load.  Instrumentation sites do
+    ``tr = active()`` / ``if tr is not None: ...`` and NOTHING else on
+    the disabled path."""
+    return _ACTIVE

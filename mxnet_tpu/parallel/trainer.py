@@ -22,6 +22,7 @@ from .. import base as _base
 from .. import optimizer as opt_mod
 from .. import random as _random
 from ..ndarray import NDArray
+from ..observability.trace import active as _trace_active
 from ..resilience.faults import inject as _inject, poison as _poison
 from ..ndarray.ndarray import swap_values
 from .mesh import current_mesh, use_mesh
@@ -153,6 +154,12 @@ class ShardedTrainer:
         self._state_shardings: List[NamedSharding] = []
         self._pending_states: Optional[dict] = None
         self._ckpt_managers: Dict[str, Any] = {}
+        # fleet counters (docs/observability.md): process-wide step and
+        # guarded-bad-step counts, shared across trainer instances
+        from ..observability.registry import default_registry
+        self._obs_steps = default_registry().counter(
+            "mxtpu_trainer_steps_total",
+            help="ShardedTrainer.step calls, all trainers")
 
     # ----------------------------------------------------------- guardrails
     @property
@@ -657,7 +664,16 @@ class ShardedTrainer:
         scale was shrunk.  Neither return forces a device→host sync;
         callers that don't read the flag pay nothing for it.
         """
+        tr = _trace_active()
+        if tr is None:              # zero-cost: one global + None check
+            return self._step(data, labels)
+        with tr.span("trainer.step", step=self.optimizer.num_update + 1,
+                     guarded=self._guarded):
+            return self._step(data, labels)
+
+    def _step(self, data, labels=()):
         _inject("trainer.step")
+        self._obs_steps.inc()
         if not isinstance(data, (tuple, list)):
             data = (data,)
         if not isinstance(labels, (tuple, list)):

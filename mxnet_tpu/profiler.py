@@ -17,7 +17,7 @@ from typing import Optional
 from . import base as _base
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
-           "Task", "Frame", "Marker", "scope"]
+           "Task", "Frame", "Marker", "scope", "device_span"]
 
 _config = {
     "filename": "profile.json",
@@ -139,3 +139,28 @@ class Marker:
 def scope(name: str):
     """Context manager annotating a named range (jax.profiler bridge)."""
     return _Annotation(name)
+
+
+class _SafeAnnotation(_Annotation):
+    """An annotation that degrades to a no-op if jax (or its profiler)
+    is unusable — the observability trace bridge must never let a
+    device-trace decoration failure break the span it decorates."""
+
+    def start(self):
+        try:
+            super().start()
+        except Exception:
+            self._ann = None
+
+    def stop(self):
+        try:
+            super().stop()
+        except Exception:
+            self._ann = None
+
+
+def device_span(name: str) -> _SafeAnnotation:
+    """A named range for the jax device trace that NEVER raises — the
+    bridge :mod:`mxnet_tpu.observability.trace` uses to land its spans
+    inside ``jax.profiler`` captures next to the XLA ops they cover."""
+    return _SafeAnnotation(f"span:{name}")

@@ -29,6 +29,7 @@ import shutil
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..base import MXNetError
+from ..observability.trace import active as _trace_active
 from .faults import inject
 
 __all__ = ["AtomicCheckpointer"]
@@ -99,9 +100,19 @@ class AtomicCheckpointer:
         """Write and atomically commit one step.  Returns the committed
         path.  Re-committing an existing step replaces it (the
         resume-replays-a-step case; earlier steps stay as fallback)."""
+        inject("checkpoint.save")
+        tr = _trace_active()
+        if tr is None:
+            return self._save(step, tree, meta)
+        # context-managed like every other site, so a failed save tags
+        # its span with error=<type> instead of looking clean
+        with tr.span("checkpoint.save", step=int(step)):
+            return self._save(step, tree, meta)
+
+    def _save(self, step: int, tree: Dict[str, Any],
+              meta: Optional[dict]) -> str:
         from ..utils.serialization import save as _save
 
-        inject("checkpoint.save")
         step = int(step)
         tmp = os.path.join(self.directory,
                            f"{_TMP_PREFIX}{step:08d}-{os.getpid()}")
@@ -132,6 +143,15 @@ class AtomicCheckpointer:
         if aside is not None:
             shutil.rmtree(aside, ignore_errors=True)
         self._gc()
+        # fleet counter for DIRECT checkpointer users; ResilientLoop
+        # additionally counts its own commits into stats()["resilience"]
+        try:
+            from ..observability.registry import default_registry
+            default_registry().counter(
+                "mxtpu_checkpoint_commits_total",
+                help="atomic checkpoint commits (rename succeeded)").inc()
+        except Exception:
+            pass
         return final
 
     def _gc(self):

@@ -51,6 +51,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 from .. import base as _base
 from .. import random as _random
+from ..observability.trace import active as _trace_active
 from .checkpoint import AtomicCheckpointer
 from .faults import RetryableFault
 
@@ -187,10 +188,18 @@ class ResilientLoop:
             build(data, labels)
 
     def _commit(self, step: int, extra_meta: Optional[dict] = None) -> None:
-        sd = self.trainer.state_dict()
-        self.checkpointer.save(step, sd,
-                               meta={"seed": self.seed,
-                                     **(extra_meta or {})})
+        tr = _trace_active()
+        if tr is None:
+            sd = self.trainer.state_dict()
+            self.checkpointer.save(step, sd,
+                                   meta={"seed": self.seed,
+                                         **(extra_meta or {})})
+        else:
+            with tr.span("checkpoint.commit", step=step):
+                sd = self.trainer.state_dict()
+                self.checkpointer.save(step, sd,
+                                       meta={"seed": self.seed,
+                                             **(extra_meta or {})})
         self.metrics.count("checkpoint_commits")
 
     def _step_with_retry(self, step: int, data, labels):
@@ -200,7 +209,14 @@ class ResilientLoop:
             # key counter a replay would then miss
             self._reseed(step)
             try:
-                return self.trainer.step(data, labels)
+                tr = _trace_active()
+                if tr is None:
+                    return self.trainer.step(data, labels)
+                # one span per ATTEMPT: a retried step shows up as two
+                # loop.step spans (the first tagged error=...), so the
+                # timeline tells retry storms from clean runs
+                with tr.span("loop.step", step=step, attempt=attempt):
+                    return self.trainer.step(data, labels)
             except self.retryable:
                 if attempt >= self.max_retries:
                     raise
@@ -336,5 +352,9 @@ class ResilientLoop:
             tree, _meta = self.checkpointer.restore(latest)
             self.trainer.load_state_dict(tree)
             self.metrics.count("rewinds")
+            tr = _trace_active()
+            if tr is not None:
+                tr.event("loop.rewind", step=step, restored=latest,
+                         consecutive_bad=consecutive_bad)
             return 0
         return consecutive_bad

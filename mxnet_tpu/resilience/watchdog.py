@@ -47,6 +47,22 @@ class Watchdog(threading.Thread):
             if reason is not None:
                 self.tripped = True
                 self.trip_reason = reason
+                # observability: trips are rare and load-bearing — both
+                # the fleet counter and the trace ring should carry them
+                # even when the monitored loop's own metrics are dead
+                try:
+                    from ..observability.registry import default_registry
+                    from ..observability.trace import active as _tr_active
+                    default_registry().counter(
+                        "mxtpu_watchdog_trips_total",
+                        help="watchdog condemnations, any monitored loop",
+                        watchdog=self.name).inc()
+                    tr = _tr_active()
+                    if tr is not None:
+                        tr.event("watchdog.trip", watchdog=self.name,
+                                 reason=reason)
+                except Exception:
+                    pass           # telemetry must never mask the trip
                 try:
                     self._on_trip(reason)
                 finally:

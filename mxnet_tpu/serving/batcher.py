@@ -111,6 +111,10 @@ class DynamicBatcher:
         self._cond = cond or threading.Condition()
         self._q: deque = deque()
         self._closed = False
+        # deepest the queue has ever been (exported as the
+        # mxtpu_serving_queue_depth_highwater gauge): the capacity-
+        # planning number — how close admission came to shedding
+        self.depth_highwater = 0
 
     @property
     def cond(self) -> threading.Condition:
@@ -134,6 +138,8 @@ class DynamicBatcher:
                     f"{self.max_depth} — shedding load")
             req.t_enqueue = time.monotonic()
             self._q.append(req)
+            if len(self._q) > self.depth_highwater:
+                self.depth_highwater = len(self._q)
             self._cond.notify_all()
 
     def get_batch(self, max_batch: int, max_wait_us: float,

@@ -63,10 +63,12 @@ import itertools
 import signal as _signal
 import threading
 import time
+import weakref
 from typing import Optional, Sequence
 
 import numpy as onp
 
+from ..observability.trace import active as _trace_active
 from ..resilience.faults import RetryableFault, inject as _inject
 from .batcher import BucketLattice, DynamicBatcher
 from .errors import (EngineCrashedError, EngineStoppedError,
@@ -80,14 +82,18 @@ __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
 
 
 class InferenceFuture:
-    """Write-once result holder; safe across threads."""
+    """Write-once result holder; safe across threads.  ``trace_id`` is
+    the request's observability trace id (None with tracing disabled) —
+    the handle a caller passes to ``Tracer.timeline()`` to dump the
+    request's span timeline."""
 
-    __slots__ = ("_ev", "_result", "_exc")
+    __slots__ = ("_ev", "_result", "_exc", "trace_id")
 
     def __init__(self):
         self._ev = threading.Event()
         self._result = None
         self._exc = None
+        self.trace_id = None
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -114,13 +120,16 @@ class InferenceFuture:
 class Request:
     __slots__ = ("id", "kind", "payload", "prompt_len", "max_new_tokens",
                  "eos_id", "deadline", "future", "t_submit", "t_enqueue",
-                 "t_schedule", "shape_key", "retries_left")
+                 "t_schedule", "shape_key", "retries_left", "trace_id")
 
     _ids = itertools.count()
 
     def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
                  deadline=None):
         self.retries_left = 0     # engine grants the budget at submit
+        # trace-id propagation crosses the scheduler thread boundary BY
+        # VALUE on the request itself (no thread-locals to lose)
+        self.trace_id = None
         self.id = next(self._ids)
         self.kind = kind
         self.payload = payload
@@ -309,7 +318,65 @@ class InferenceEngine:
         self._caches = None
         self._shape_seen = set()
         self._fwd_single = None
+        self._exporter = None
         self._build_fns()
+        self._register_gauges()
+
+    def _register_gauges(self):
+        """Compile-event and bucket-lattice gauges in the process-wide
+        observability registry (docs/observability.md).  Bound via
+        WEAKREF: a collected engine's gauges drop out of the next
+        scrape instead of resurrecting it; a new engine under the same
+        name replaces the registrations."""
+        from ..observability.registry import default_registry
+        reg = default_registry()
+        ref = weakref.ref(self)
+
+        def bound(fn):
+            def sample():
+                eng = ref()
+                if eng is None:
+                    raise ReferenceError("engine collected")
+                return fn(eng)
+            return sample
+
+        lbl = {"engine": self.metrics.name}
+        reg.gauge("mxtpu_serving_queue_depth",
+                  help="requests waiting in the admission queue",
+                  fn=bound(lambda e: len(e._batcher)), **lbl)
+        reg.gauge("mxtpu_serving_queue_depth_highwater",
+                  help="deepest the admission queue has been "
+                       "(capacity-planning: distance to shedding)",
+                  fn=bound(lambda e: e._batcher.depth_highwater), **lbl)
+        reg.gauge("mxtpu_serving_active_slots",
+                  help="KV cache slots currently leased",
+                  fn=bound(lambda e: e._alloc.active_count
+                           if e._alloc else 0), **lbl)
+        reg.gauge("mxtpu_serving_num_slots",
+                  help="decode concurrency (total KV cache slots)",
+                  fn=bound(lambda e: e.num_slots), **lbl)
+        reg.gauge("mxtpu_serving_compile_cache_entries",
+                  help="distinct compiled program shapes seen",
+                  fn=bound(lambda e: len(e._shape_seen)), **lbl)
+        reg.gauge("mxtpu_serving_bucket_lattice_points",
+                  help="size of the (batch, seq) shape-bucket lattice "
+                       "— the upper bound on compiles",
+                  fn=bound(lambda e: len(e.lattice)), **lbl)
+        reg.gauge("mxtpu_serving_prefix_entries",
+                  help="live prefix-cache radix-tree entries",
+                  fn=bound(lambda e: len(e._prefix)
+                           if e._prefix is not None else 0), **lbl)
+
+    # ------------------------------------------------------------- exporter
+    def attach_exporter(self, exporter) -> "InferenceEngine":
+        """Tie a :class:`~mxnet_tpu.observability.BackgroundExporter`
+        to this engine's lifecycle: started here (if not already) and
+        drained — final flush + join — by ``stop()``, including the
+        SIGTERM path.  Returns ``self`` for chaining."""
+        self._exporter = exporter
+        if exporter.ident is None:       # never started
+            exporter.start()
+        return self
 
     # ------------------------------------------------------------ compiled fns
     def _build_fns(self):
@@ -527,6 +594,16 @@ class InferenceEngine:
                 self._fail(st.request, exc)
         self._thread = None
         self.uninstall_signal_handlers()
+        # graceful exporter drain LAST: the final flush must see the
+        # terminal counters (sweep failures included).  Never raises —
+        # a broken exporter must not turn a clean stop into an error.
+        exp = self._exporter
+        if exp is not None:
+            self._exporter = None
+            try:
+                exp.stop(flush=True)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- watchdog
     def _watchdog_check(self) -> Optional[str]:
@@ -713,12 +790,22 @@ class InferenceEngine:
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)())
             req = Request("forward", arr, deadline=deadline)
         req.retries_left = self.max_request_retries
+        tr = _trace_active()
+        if tr is not None:
+            # trace-id allocation happens on the CALLER thread; every
+            # later span of this request — recorded from the scheduler
+            # thread — joins it through req.trace_id
+            req.trace_id = req.future.trace_id = tr.new_trace_id()
+            tr.event("serving.submit", trace_id=req.trace_id,
+                     request=req.id, kind=req.kind)
         self.metrics.count("submitted")
         try:
             self._batcher.put(req)
         except QueueFullError:
             self.metrics.count("rejected_queue_full")
             self.metrics.mark("shed")
+            if tr is not None:
+                tr.event("serving.shed", trace_id=req.trace_id)
             raise
         return req.future
 
@@ -901,6 +988,10 @@ class InferenceEngine:
             self.metrics.mark("timeout")
         elif isinstance(exc, EngineStoppedError):
             self.metrics.count("cancelled")
+        tr = _trace_active()
+        if tr is not None and req.trace_id is not None:
+            tr.event("serving.error", trace_id=req.trace_id,
+                     error=type(exc).__name__)
 
     def _fail_inflight(self, exc: BaseException):
         for req in self._batcher.drain():
@@ -929,6 +1020,19 @@ class InferenceEngine:
                                      now - t_first)
         self.metrics.count("completed")
         self.metrics.count("tokens_generated", len(st.generated))
+        tr = _trace_active()
+        if tr is not None and req.trace_id is not None:
+            # phase spans are RETROSPECTIVE — rebuilt from the request
+            # timestamps the engine keeps anyway, so a completing
+            # request costs three ring appends, no live bookkeeping
+            tr.record_span("serving.prefill_phase", req.t_schedule,
+                           t_first, trace_id=req.trace_id)
+            tr.record_span("serving.decode_phase", t_first, now,
+                           trace_id=req.trace_id,
+                           tokens=len(st.generated))
+            tr.record_span("serving.request", req.t_submit, now,
+                           trace_id=req.trace_id, request=req.id)
+            tr.event("serving.complete", trace_id=req.trace_id)
         req.future.set_result(seq)
 
     # ------------------------------------------------------------ decode path
@@ -997,12 +1101,17 @@ class InferenceEngine:
         device row copy.  On success ``st.filled`` skips the matched
         region; on any contained fault the request prefills in full."""
         req = st.request
+        tr = _trace_active()
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             _inject("serving.prefix_lookup")
             hit = self._prefix.lookup(req.payload)
         except Exception:           # incl. RetryableFault: a host-side
             self._prefix_fault("lookup")   # tree op has nothing to retry
             return
+        if tr is not None and req.trace_id is not None:
+            tr.record_span("serving.prefix_lookup", t0, time.monotonic(),
+                           trace_id=req.trace_id, hit=hit is not None)
         # the limit counts CONSECUTIVE faults: a clean op resets ITS streak
         self._prefix_faults["lookup"] = 0
         if hit is None:
@@ -1016,6 +1125,7 @@ class InferenceEngine:
             self.metrics.count("prefix_misses")
             return
         self._prefix.pin(entry)
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             import jax.numpy as jnp
             self._ensure_caches()
@@ -1038,6 +1148,9 @@ class InferenceEngine:
             self._prefix.unpin(entry)
             self._prefix_fault("copy")
             return
+        if tr is not None and req.trace_id is not None:
+            tr.record_span("serving.prefix_copy", t0, time.monotonic(),
+                           trace_id=req.trace_id, tokens=match)
         self._prefix_faults["copy"] = 0
         st.filled = match
         st.pinned = entry            # read-pinned until prefill completes
@@ -1085,12 +1198,16 @@ class InferenceEngine:
         matched K/V now, so the prefill phase only sees suffixes."""
         alloc = self._alloc
         now = time.monotonic()
+        tr = _trace_active()
         n_prompt = 0
         for req in live:
             st = SlotState(req, req.prompt_len, req.max_new_tokens,
                            tokens=req.payload)
             slot = alloc.alloc(st)
             req.t_schedule = now
+            if tr is not None and req.trace_id is not None:
+                tr.record_span("serving.queue", req.t_submit, now,
+                               trace_id=req.trace_id, slot=slot)
             n_prompt += req.prompt_len
             if self._prefix_usable() and req.prompt_len > 1:
                 self._prefix_admit(st, slot)
@@ -1141,11 +1258,22 @@ class InferenceEngine:
         self.metrics.count("padded_tokens", bb * tb - n_real)
         self.metrics.count("prefill_batches")
         self._ensure_caches()
+        tr = _trace_active()
+        t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
              self._caches, jnp.asarray(sidx)),
             [st.request for _s, st in rows])
+        if tr is not None:
+            # ONE span for the batched device call, carrying every
+            # rider's trace id — each request's timeline includes the
+            # shared steps it rode
+            tr.record_span(
+                "serving.prefill", t0, time.monotonic(),
+                trace_ids=tuple(st.request.trace_id for _s, st in rows
+                                if st.request.trace_id is not None),
+                batch=bb, seq=tb)
         first = onp.asarray(first)
         ok = onp.asarray(ok)
         for i, (slot, st) in enumerate(rows):
@@ -1181,11 +1309,19 @@ class InferenceEngine:
         self.metrics.count("padded_tokens", bb * tb - sum(take))
         self.metrics.count("prefill_chunks")
         self._ensure_caches()
+        tr = _trace_active()
+        t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("chunk", bb, tb), self._jit_chunk,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
              self._caches, jnp.asarray(sidx), jnp.asarray(off)),
             [st.request for _s, st in rows])
+        if tr is not None:
+            tr.record_span(
+                "serving.prefill_chunk", t0, time.monotonic(),
+                trace_ids=tuple(st.request.trace_id for _s, st in rows
+                                if st.request.trace_id is not None),
+                batch=bb, seq=tb)
         first = onp.asarray(first)
         ok = onp.asarray(ok)
         for i, (slot, st) in enumerate(rows):
@@ -1264,10 +1400,18 @@ class InferenceEngine:
             pos[slot] = st.pos
             riders.append(st.request)
         self.metrics.count("decode_steps")
+        tr = _trace_active()
+        t0 = time.monotonic() if tr is not None else 0.0
         nxt, ok, self._caches = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
              jnp.asarray(pos)), riders)
+        if tr is not None:
+            tr.record_span(
+                "serving.decode_step", t0, time.monotonic(),
+                trace_ids=tuple(r.trace_id for r in riders
+                                if r.trace_id is not None),
+                riders=len(riders))
         nxt = onp.asarray(nxt)
         ok = onp.asarray(ok)
         for slot, st in alloc.items():
@@ -1306,11 +1450,19 @@ class InferenceEngine:
         # allocator — publish it so a watchdog trip during a hung
         # forward can still fail these futures
         self._inflight_fwd = tuple(live)
+        tr = _trace_active()
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             outs = self._run_step("serving.forward", key,
                                   self._jit_forward,
                                   (self._params(), jnp.asarray(xs)), live)
             outs = [onp.asarray(o) for o in outs]
+            if tr is not None:
+                tr.record_span(
+                    "serving.forward", t0, time.monotonic(),
+                    trace_ids=tuple(r.trace_id for r in live
+                                    if r.trace_id is not None),
+                    batch=bb)
         except BaseException as e:
             # fail the popped batch HERE or the futures hang forever;
             # the rest of the queue is untouched (no shared state to
@@ -1335,4 +1487,10 @@ class InferenceEngine:
             self.metrics.observe_request(r.t_schedule - r.t_submit,
                                          done - r.t_schedule)
             self.metrics.count("completed")
+            if tr is not None and r.trace_id is not None:
+                tr.record_span("serving.queue", r.t_submit, r.t_schedule,
+                               trace_id=r.trace_id)
+                tr.record_span("serving.request", r.t_submit, done,
+                               trace_id=r.trace_id, request=r.id)
+                tr.event("serving.complete", trace_id=r.trace_id)
             r.future.set_result(res)

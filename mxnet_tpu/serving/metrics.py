@@ -17,11 +17,16 @@ from __future__ import annotations
 
 import math
 import threading
+import weakref
 from typing import Dict, List, Optional
 
 from .. import profiler as _profiler
 
 __all__ = ["LatencyHistogram", "ServingMetrics"]
+
+#: bump when the stats() key layout changes, so fleet scrapers can
+#: version their parsing instead of guessing from key presence
+STATS_SCHEMA_VERSION = 1
 
 
 class LatencyHistogram:
@@ -42,6 +47,7 @@ class LatencyHistogram:
         self.total = 0
         self.sum = 0.0
         self.max = 0.0
+        self.min = math.inf
 
     def observe(self, seconds: float):
         seconds = max(float(seconds), 0.0)
@@ -57,9 +63,21 @@ class LatencyHistogram:
         self.total += 1
         self.sum += seconds
         self.max = max(self.max, seconds)
+        self.min = min(self.min, seconds)
 
     def percentile(self, q: float) -> float:
-        """q in [0, 100]; 0 with no samples."""
+        """q in [0, 100]; 0 with no samples.
+
+        A percentile is an order statistic: the result must lie inside
+        ``[self.min, self.max]`` — the observed extremes — no matter
+        which bucket wins.  Interpolation alone violates BOTH ends: a
+        bucket's upper edge can overshoot the true sample max (and the
+        open-ended top bucket has no finite edge at all), and the
+        winning bucket's lower edge can undershoot the true sample min
+        (every sample in bucket 0 sits below the synthetic
+        ``bounds[0]/2`` floor whenever the real samples are tiny).  So
+        every return path clamps to the observed extremes.
+        """
         if not self.total:
             return 0.0
         rank = q / 100.0 * self.total
@@ -72,9 +90,8 @@ class LatencyHistogram:
                 lo = self.bounds[i - 1] if i else self.bounds[0] / 2
                 hi = self.bounds[i]
                 frac = (rank - (seen - c)) / c
-                # geometric interp, clamped: a bucket's upper edge can
-                # overshoot the true sample max
-                return min(lo * (hi / lo) ** frac, self.max)
+                val = lo * (hi / lo) ** frac         # geometric interp
+                return min(max(val, self.min), self.max)
         return self.max
 
     def summary(self) -> Dict[str, float]:
@@ -118,7 +135,7 @@ class ServingMetrics:
                  "bad_steps", "rewinds", "quarantined_batches",
                  "nonfinite_outputs")
 
-    def __init__(self, name: str = "serving"):
+    def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
         self._lock = threading.Lock()
         self.counters = {k: 0 for k in self._COUNTERS}
@@ -127,6 +144,54 @@ class ServingMetrics:
         self.decode = LatencyHistogram()
         self.total = LatencyHistogram()
         self.ttft = LatencyHistogram()
+        if register:
+            self._register_collector()
+
+    def _register_collector(self):
+        """Publish this instance into the process-wide observability
+        registry (docs/observability.md): one ``collect()`` then covers
+        these counters/histograms under stable ``mxtpu_serving_*``
+        names with an ``engine=<name>`` label.  Held by WEAKREF — a
+        garbage-collected engine's metrics prune themselves from the
+        next scrape; a new instance under the same name replaces the
+        old registration (the rebuilt-engine case)."""
+        from ..observability.registry import default_registry
+        ref = weakref.ref(self)
+
+        def _samples():
+            m = ref()
+            if m is None:
+                raise ReferenceError("ServingMetrics collected")
+            return m.registry_samples()
+
+        default_registry().register_collector(f"serving:{self.name}",
+                                              _samples)
+
+    def registry_samples(self) -> List[dict]:
+        """Stable-name samples for :meth:`MetricsRegistry.collect`:
+        every counter as ``mxtpu_serving_<counter>_total{engine=}`` and
+        the five phase histograms as
+        ``mxtpu_serving_latency_seconds{engine=,phase=}`` /
+        ``mxtpu_serving_ttft_seconds{engine=}``.  One lock acquisition
+        — the scrape sees a consistent cut, same contract as
+        :meth:`stats`."""
+        from ..observability.registry import histogram_sample
+        eng = {"engine": self.name}
+        with self._lock:
+            samples = [
+                {"name": f"mxtpu_serving_{k}_total", "kind": "counter",
+                 "labels": dict(eng), "value": v, "help": ""}
+                for k, v in self.counters.items()]
+            for phase, h in (("queue", self.queue),
+                             ("prefill", self.prefill),
+                             ("decode", self.decode),
+                             ("total", self.total)):
+                samples.append(histogram_sample(
+                    "mxtpu_serving_latency_seconds", h,
+                    {"engine": self.name, "phase": phase}))
+            samples.append(histogram_sample(
+                "mxtpu_serving_ttft_seconds", self.ttft, eng))
+        return samples
 
     # ------------------------------------------------------------- counters
     def count(self, key: str, n: int = 1):
@@ -162,6 +227,10 @@ class ServingMetrics:
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> dict:
+        # ONE lock acquisition end to end: scrapers must never see a
+        # torn snapshot where e.g. the latency counts moved between the
+        # requests sub-dict and the ttft sub-dict (the derived dicts
+        # below only reshape the locked copies, so atomicity holds)
         with self._lock:
             c = dict(self.counters)
             lat = {"queue": self.queue.summary(),
@@ -172,6 +241,7 @@ class ServingMetrics:
         lookups = c["bucket_hits"] + c["compiles"]
         pref = c["prefix_hits"] + c["prefix_misses"]
         return {
+            "schema_version": STATS_SCHEMA_VERSION,
             "requests": {k: c[k] for k in
                          ("submitted", "admitted", "completed",
                           "rejected_queue_full", "rejected_invalid",

@@ -1,0 +1,523 @@
+"""mxnet_tpu.observability — unified metrics registry, request tracing,
+fleet exporters.
+
+Contracts under test: one ``collect()`` snapshot covers serving +
+resilience + guardrail + io metrics under stable names; the registry
+survives N writer threads racing concurrent readers; Prometheus text
+output round-trips through a parser; a served request's spans form ONE
+connected trace id across the submit/prefill/decode thread boundary;
+``LatencyHistogram.percentile`` never leaves ``[min, max]``; ``stats()``
+snapshots are schema-versioned and torn-read-free; the background
+exporter drains gracefully (engine ``stop()`` and context-manager
+paths) and never publishes a torn file.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu.observability import (BackgroundExporter, MetricsRegistry,
+                                     default_registry, flatten,
+                                     parse_prometheus, to_json_lines,
+                                     to_prometheus)
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import InferenceEngine, LatencyHistogram
+from mxnet_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    yield
+    obs.disable_tracing()
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 97, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    return InferenceEngine(net, **kw)
+
+
+# ------------------------------------------------------------- registry
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", help="h", site="a")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)                     # counters are monotonic
+    # get-or-create: same (name, labels) is the SAME metric
+    assert reg.counter("t_total", site="a") is c
+    assert reg.counter("t_total", site="b") is not c
+    g = reg.gauge("t_gauge")
+    g.set(3.5)
+    g.inc()
+    assert g.value == 4.5
+    h = reg.histogram("t_seconds")
+    h.observe(0.01)
+    with h.time():
+        pass
+    snap = reg.collect()
+    assert snap["schema_version"] == 1
+    by = {(s["name"], tuple(sorted(s["labels"].items())))
+          for s in snap["samples"]}
+    assert ("t_total", (("site", "a"),)) in by
+    assert ("t_gauge", ()) in by
+    hist = [s for s in snap["samples"] if s["name"] == "t_seconds"][0]
+    assert hist["count"] == 2
+    assert hist["buckets"][-1][0] == float("inf")
+    assert hist["buckets"][-1][1] == 2     # cumulative counts
+
+
+def test_registry_gauge_callback_failure_drops_sample_not_snapshot():
+    reg = MetricsRegistry()
+    reg.gauge("dead", fn=lambda: 1 / 0)
+    reg.counter("alive_total").inc()
+    snap = reg.collect()
+    names = [s["name"] for s in snap["samples"]]
+    assert "alive_total" in names and "dead" not in names
+
+
+def test_registry_collector_weakref_prunes():
+    reg = MetricsRegistry()
+
+    def dead():
+        raise ReferenceError("producer collected")
+
+    reg.register_collector("gone", dead)
+    reg.register_collector("live", lambda: [
+        {"name": "x_total", "kind": "counter", "labels": {}, "value": 1}])
+    snap = reg.collect()
+    assert [s["name"] for s in snap["samples"]] == ["x_total"]
+    # the dead collector was pruned, not just skipped
+    assert "gone" not in reg._collectors
+
+
+def test_registry_under_contention():
+    """N writer threads hammer counters + histograms while readers
+    collect() concurrently: no exception, no lost increment."""
+    reg = MetricsRegistry()
+    n_writers, n_inc = 8, 500
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        c = reg.counter("contended_total")
+        h = reg.histogram("contended_seconds", writer=str(i % 2))
+        g = reg.gauge("contended_gauge")
+        try:
+            for k in range(n_inc):
+                c.inc()
+                h.observe(1e-4 * (k + 1))
+                g.set(k)
+        except Exception as e:          # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                snap = reg.collect()
+                cs = [s for s in snap["samples"]
+                      if s["name"] == "contended_total"]
+                if cs:
+                    v = cs[0]["value"]
+                    assert 0 <= v <= n_writers * n_inc
+                to_prometheus(snap)      # render under fire too
+        except Exception as e:          # pragma: no cover
+            errors.append(e)
+
+    ws = [threading.Thread(target=writer, args=(i,))
+          for i in range(n_writers)]
+    rs = [threading.Thread(target=reader) for _ in range(3)]
+    for t in rs + ws:
+        t.start()
+    for t in ws:
+        t.join()
+    stop.set()
+    for t in rs:
+        t.join()
+    assert not errors
+    assert reg.counter("contended_total").value == n_writers * n_inc
+    snap = reg.collect()
+    hists = [s for s in snap["samples"]
+             if s["name"] == "contended_seconds"]
+    assert sum(h["count"] for h in hists) == n_writers * n_inc
+
+
+def test_serving_metrics_register_into_default_registry():
+    m = ServingMetrics("reg_unit")
+    m.count("submitted", 3)
+    m.observe_request(0.01, 0.02, 0.03)
+    flat = flatten(prefix="mxtpu_serving")
+    assert flat['mxtpu_serving_submitted_total{engine="reg_unit"}'] == 3
+    key = ('mxtpu_serving_latency_seconds'
+           '{engine="reg_unit",phase="total"}:count')
+    assert flat[key] == 1
+    # same name re-registers (rebuilt engine): new instance wins
+    m2 = ServingMetrics("reg_unit")
+    m2.count("submitted", 1)
+    flat = flatten(prefix="mxtpu_serving")
+    assert flat['mxtpu_serving_submitted_total{engine="reg_unit"}'] == 1
+
+
+def test_one_collect_covers_serving_resilience_guardrails_io(net):
+    """The tentpole acceptance: serving counters, resilience/guardrail
+    counters and the io quarantine counter all land in ONE default-
+    registry collect() under stable names."""
+    from mxnet_tpu.resilience import FaultPlan
+
+    # serving
+    eng = _engine(net, name="one_collect")
+    with eng:
+        eng.infer(_prompts((5,))[0], max_new_tokens=2)
+    # resilience + guardrails counters ride a ServingMetrics instance
+    m = ServingMetrics("resilience")
+    m.count("checkpoint_commits")
+    m.count("bad_steps", 2)
+    # io quarantine
+    X = onp.zeros((8, 3), "float32")
+    it = mx.io.NDArrayIter(X, onp.zeros(8, "int32"), batch_size=4,
+                           quarantine_nonfinite=True)
+    with FaultPlan().nonfinite_at("io.bad_batch", at=1):
+        batches = list(it)
+    assert it.quarantined == 1 and len(batches) == 1
+    snap = default_registry().collect()
+    names = {(s["name"],
+              tuple(sorted(s.get("labels", {}).items())))
+             for s in snap["samples"]}
+    assert ("mxtpu_serving_completed_total",
+            (("engine", "one_collect"),)) in names
+    assert ("mxtpu_serving_checkpoint_commits_total",
+            (("engine", "resilience"),)) in names
+    assert ("mxtpu_serving_bad_steps_total",
+            (("engine", "resilience"),)) in names
+    assert ("mxtpu_io_quarantined_batches_total", ()) in names
+    assert ("mxtpu_serving_compile_cache_entries",
+            (("engine", "one_collect"),)) in names
+
+
+# ------------------------------------------------------------- exporters
+
+def test_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("rt_total", site="x").inc(7)
+    reg.gauge("rt_gauge").set(2.25)
+    h = reg.histogram("rt_seconds")
+    for v in (0.001, 0.01, 5.0):
+        h.observe(v)
+    text = to_prometheus(reg.collect())
+    parsed = parse_prometheus(text)
+    assert parsed[("rt_total", (("site", "x"),))] == 7.0
+    assert parsed[("rt_gauge", ())] == 2.25
+    assert parsed[("rt_seconds_count", ())] == 3.0
+    assert abs(parsed[("rt_seconds_sum", ())] - 5.011) < 1e-9
+    # cumulative buckets: the +Inf bucket equals count
+    assert parsed[("rt_seconds_bucket", (("le", "+Inf"),))] == 3.0
+    # a truncated export must FAIL parsing, not half-succeed
+    with pytest.raises(ValueError):
+        parse_prometheus(text[:len(text) // 2] + "\ngarbage{")
+
+
+def test_json_lines_every_line_parses():
+    reg = MetricsRegistry()
+    reg.counter("jl_total").inc()
+    reg.histogram("jl_seconds").observe(0.5)
+    lines = to_json_lines(reg.collect()).splitlines()
+
+    def reject(tok):                  # strict RFC JSON: a non-Python
+        raise ValueError(tok)         # consumer would choke on Infinity
+
+    objs = [json.loads(ln, parse_constant=reject) for ln in lines]
+    assert objs[0]["schema_version"] == 1
+    assert {o.get("name") for o in objs[1:]} == {"jl_total", "jl_seconds"}
+    hist = [o for o in objs[1:] if o["name"] == "jl_seconds"][0]
+    assert hist["buckets"][-1][0] == "+Inf"      # Prometheus spelling
+
+
+def test_registry_dead_weakref_gauge_pruned():
+    reg = MetricsRegistry()
+
+    class Producer:
+        depth = 3
+
+    p = Producer()
+    import weakref
+    ref = weakref.ref(p)
+
+    def fn():
+        obj = ref()
+        if obj is None:
+            raise ReferenceError("producer collected")
+        return obj.depth
+
+    reg.gauge("prune_gauge", fn=fn)
+    assert [s["name"] for s in reg.collect()["samples"]] == ["prune_gauge"]
+    del p
+    import gc
+    gc.collect()
+    assert reg.collect()["samples"] == []
+    # pruned for good, not skipped per-scrape
+    assert reg._metrics == {}
+
+
+def test_background_exporter_atomic_file_and_drain(tmp_path):
+    reg = MetricsRegistry()
+    c = reg.counter("bg_total")
+    out = str(tmp_path / "m.prom")
+    exp = BackgroundExporter(path=out, interval=0.01, registry=reg)
+    with exp:
+        c.inc(5)
+        deadline = time.monotonic() + 5
+        while exp.exports == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert exp.exports >= 1
+    # context exit = stop(flush=True): joined + final state on disk
+    assert not exp.is_alive()
+    parsed = parse_prometheus(open(out).read())
+    assert parsed[("bg_total", ())] == 5.0
+    # stop is idempotent
+    exp.stop(flush=True)
+
+
+def test_engine_stop_drains_attached_exporter(net, tmp_path):
+    out = str(tmp_path / "engine.prom")
+    exp = BackgroundExporter(path=out, interval=0.02)
+    eng = _engine(net, name="drain_exp").attach_exporter(exp)
+    with eng:
+        eng.infer(_prompts((4,))[0], max_new_tokens=2)
+    assert not exp.is_alive()          # stop() joined it
+    parsed = parse_prometheus(open(out).read())
+    key = ("mxtpu_serving_completed_total", (("engine", "drain_exp"),))
+    assert parsed[key] >= 1.0          # final flush saw the terminal count
+
+
+# --------------------------------------------------------------- tracing
+
+def test_trace_ring_bounded_and_queryable():
+    tr = obs.enable_tracing(capacity=16)
+    tid = tr.new_trace_id()
+    with tr.span("outer", trace_id=tid, k=1):
+        tr.event("inner", trace_id=tid)
+    for _ in range(40):
+        tr.event("noise")
+    assert len(tr) == 16 and tr.dropped > 0
+    # ring eviction dropped the old spans; fresh ones still query
+    tid2 = tr.new_trace_id()
+    tr.record_span("late", 1.0, 2.0, trace_id=tid2)
+    tl = tr.timeline(tid2)
+    assert [d["name"] for d in tl] == ["late"]
+    assert tl[0]["duration_ms"] == 1000.0
+
+
+def test_request_spans_form_one_connected_trace(net):
+    """The propagation contract: every span of one request — recorded
+    from the caller thread (submit) AND the scheduler thread (queue,
+    prefill, decode, complete) — carries one trace id, including the
+    batched device calls it rode (trace_ids membership)."""
+    tr = obs.enable_tracing(capacity=8192)
+    eng = _engine(net, prefix_pool_rows=2, prefix_min_tokens=2,
+                  name="trace_prop")
+    prompts = _prompts((5, 9, 5, 7), seed=3)
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        for f in futs:
+            f.result(timeout=120)
+    tids = [f.trace_id for f in futs]
+    assert all(t is not None for t in tids)
+    assert len(set(tids)) == len(tids)          # one trace per request
+    for tid in tids:
+        names = {d["name"] for d in tr.timeline(tid)}
+        # the full lifecycle under ONE id, across the thread boundary
+        for expected in ("serving.submit", "serving.queue",
+                         "serving.prefill_phase", "serving.decode_phase",
+                         "serving.request", "serving.complete"):
+            assert expected in names, (tid, expected, names)
+        # and the shared batched steps the request rode
+        assert any(n.startswith("serving.prefill") for n in names)
+        assert "serving.decode_step" in names
+    # spans of different requests never leak across ids
+    only_first = [d for d in tr.timeline(tids[0])
+                  if d["trace_id"] is not None]
+    assert all(d["trace_id"] == tids[0] for d in only_first)
+
+
+def test_tracing_disabled_records_nothing(net):
+    tr = obs.enable_tracing()
+    obs.disable_tracing()
+    eng = _engine(net, name="trace_off")
+    with eng:
+        fut = eng.submit(_prompts((4,))[0], max_new_tokens=2)
+        fut.result(timeout=120)
+    assert fut.trace_id is None
+    assert len(tr) == 0
+    # a pre-tracing future's None id is NOT a wildcard: no whole-ring
+    # dump masquerading as this request's timeline
+    tr.event("unrelated")
+    assert tr.timeline(fut.trace_id) == []
+
+
+def test_trainer_and_loop_spans(tmp_path):
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import ResilientLoop
+
+    tr = obs.enable_tracing()
+    mesh = par.make_mesh()       # dp = all (virtual) devices
+    with par.use_mesh(mesh):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=4),
+                nn.Dense(2, in_units=8))
+        net.initialize()
+        trainer = par.ShardedTrainer(
+            net, "sgd", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer_params={"learning_rate": 0.01})
+
+        def make_iter():
+            rs = onp.random.RandomState(0)
+            return iter([(nd.array(rs.randn(8, 4).astype("float32")),
+                          nd.array((rs.randn(8) > 0).astype("int32")))
+                         for _ in range(3)])
+
+        loop = ResilientLoop(trainer, str(tmp_path / "ck"), save_every=2,
+                             seed=0)
+        report = loop.run(make_iter, 3)
+    assert report["completed_steps"] == 3
+    assert len(tr.spans(name="trainer.step")) == 3
+    assert len(tr.spans(name="loop.step")) == 3
+    commits = tr.spans(name="checkpoint.commit")
+    saves = tr.spans(name="checkpoint.save")
+    assert len(commits) == 2 and len(saves) == 2   # step 2 + final step 3
+    assert commits[0].attrs["step"] == 2
+
+
+# ---------------------------------------------- LatencyHistogram bounds
+
+def test_percentile_never_above_observed_max():
+    """Regression: geometric interpolation inside the winning bucket —
+    and the open-ended top bucket — must never report a percentile
+    above the largest observed sample."""
+    h = LatencyHistogram()
+    # all samples beyond the last finite bound -> open-ended tail
+    for v in (150.0, 200.0, 500.0):
+        h.observe(v)
+    for q in (50, 95, 99, 100):
+        assert h.percentile(q) <= h.max
+    # winning-bucket interpolation with the max mid-bucket
+    h2 = LatencyHistogram()
+    for _ in range(100):
+        h2.observe(0.010)
+    assert h2.percentile(99) <= h2.max
+    assert h2.percentile(99) <= 0.010
+
+
+def test_percentile_never_below_observed_min():
+    """The symmetric hole: every sample in bucket 0 sits below the
+    synthetic bounds[0]/2 floor when samples are tiny."""
+    h = LatencyHistogram()
+    for v in (1e-9, 2e-9, 3e-9):
+        h.observe(v)
+    for q in (1, 50, 99):
+        p = h.percentile(q)
+        assert h.min <= p <= h.max
+
+
+def test_percentile_fuzz_stays_in_observed_range():
+    rs = onp.random.RandomState(7)
+    for _ in range(50):
+        h = LatencyHistogram()
+        for v in 10.0 ** rs.uniform(-7, 3.5, size=rs.randint(1, 30)):
+            h.observe(float(v))
+        for q in (0, 1, 50, 90, 99, 100):
+            p = h.percentile(q)
+            assert h.min <= p <= h.max
+
+
+# ------------------------------------------------------- stats() contract
+
+def test_stats_schema_version_and_atomic_snapshot(net):
+    eng = _engine(net, name="stats_atomic")
+    assert eng.stats()["schema_version"] == 1
+    m = eng.metrics
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            # one observe_request updates queue+prefill+decode+total+ttft
+            # under ONE lock acquisition — a snapshot must see them move
+            # together
+            m.observe_request(0.001, 0.002, 0.003)
+
+    def reader():
+        try:
+            for _ in range(300):
+                s = m.stats()
+                lat = s["latency"]
+                assert lat["queue"]["count"] == lat["prefill"]["count"] \
+                    == lat["decode"]["count"] == lat["total"]["count"] \
+                    == s["ttft"]["count"], "torn stats() snapshot"
+        except Exception as e:          # pragma: no cover
+            errors.append(e)
+
+    w = threading.Thread(target=writer)
+    r = threading.Thread(target=reader)
+    w.start()
+    r.start()
+    r.join()
+    stop.set()
+    w.join()
+    assert not errors
+
+
+# --------------------------------------------------- obs-tier contracts
+
+@pytest.mark.obs
+@pytest.mark.slow
+def test_tracing_disabled_overhead_within_noise(net):
+    """The zero-cost contract, measured: engine decode throughput with
+    tracing DISABLED must match a run where tracing was never enabled,
+    within trial spread (same contract shape as serving_perf)."""
+    prompts = _prompts((5, 7, 9, 4), seed=5)
+
+    def run_once(name):
+        eng = _engine(net, name=name)
+        eng.warmup()
+        with eng:
+            t0 = time.perf_counter()
+            for _ in range(3):
+                futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+                for f in futs:
+                    f.result(timeout=120)
+            return time.perf_counter() - t0
+
+    run_once("warm")                       # pay residual compiles
+    base = min(run_once(f"base{i}") for i in range(3))
+    obs.enable_tracing()
+    obs.disable_tracing()                  # enabled-then-disabled
+    off = min(run_once(f"off{i}") for i in range(3))
+    # generous bound: CPU timing is noisy; the disabled path is one
+    # global load + None check per site, nowhere near 1.5x
+    assert off < base * 1.5, (base, off)

@@ -159,7 +159,72 @@ def serving_scenarios(net):
         ("sigterm_drain", lambda: _serving_scenario(
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
         ("prefix_storm", lambda: serving_prefix_storm(net)),
+        ("exporter_storm", lambda: serving_exporter_storm(net)),
     ]
+
+
+def serving_exporter_storm(net):
+    """Observability exporter chaos (docs/observability.md): an engine
+    with a tight-interval :class:`BackgroundExporter` attached CRASHES
+    (injected scheduler fault) while SIGTERM lands mid-export-loop.
+    Invariants: the exporter thread always joins, its output file is
+    never torn (a truncated write would FAIL ``parse_prometheus`` —
+    exports are temp-file + atomic rename), the final flush carries the
+    engine's counters, and no future is stranded."""
+    from mxnet_tpu.observability import BackgroundExporter, parse_prometheus
+    from mxnet_tpu.resilience import FaultPlan
+    from mxnet_tpu.serving import ServingError
+
+    workdir = tempfile.mkdtemp(prefix="obs_storm_")
+    out = os.path.join(workdir, "metrics.prom")
+    exp = BackgroundExporter(path=out, interval=0.002)
+    eng = _engine(net, name="exporter_storm")
+    eng.attach_exporter(exp)
+    plan = FaultPlan().raise_at("serving.scheduler", at=3)
+    futs = []
+    submitted = rejected = 0
+    try:
+        with plan:
+            eng.start()
+            eng.install_signal_handlers()
+            for p in _prompts(tuple(range(2, 8)), seed=11):
+                try:
+                    futs.append(eng.submit(p, max_new_tokens=3))
+                    submitted += 1
+                except ServingError:
+                    rejected += 1
+            os.kill(os.getpid(), signal.SIGTERM)   # mid-export: 2ms period
+            ok, typed, stranded = _resolve_all(futs, timeout=45)
+            try:
+                eng.stop(timeout=15)
+            except ServingError:
+                pass
+            eng.uninstall_signal_handlers()
+        _join_zombies()
+        exp.stop(flush=True)           # idempotent if stop() already drained
+        joined = not exp.is_alive()
+        torn, has_counters = False, False
+        try:
+            with open(out) as f:
+                parsed = parse_prometheus(f.read())
+            has_counters = any(name.startswith("mxtpu_serving")
+                               for name, _labels in parsed)
+        except Exception:
+            torn = True
+        passed = (joined and not torn and has_counters and stranded == 0
+                  and (ok + typed) == submitted and exp.exports >= 1)
+        return {
+            "name": "serving/exporter_storm",
+            "passed": bool(passed),
+            "detail": {"submitted": submitted, "ok": ok,
+                       "typed_errors": typed, "stranded": stranded,
+                       "exporter_joined": joined, "torn_output": torn,
+                       "exports": exp.exports, "export_errors": exp.errors,
+                       "has_serving_counters": has_counters,
+                       "faults_fired": plan.fired()},
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def serving_prefix_storm(net):

@@ -1,0 +1,146 @@
+"""obs_dump: pretty-print observability snapshots and trace timelines.
+
+Two modes:
+
+- **file mode** (default): parse a snapshot a
+  :class:`~mxnet_tpu.observability.BackgroundExporter` wrote (Prometheus
+  text or JSON lines — auto-detected) and print a sorted, aligned
+  metric table.  This is the operator's `kubectl exec … obs_dump
+  metrics.prom` loop.
+
+- **--live**: build a tiny GPT-2 engine in-process with tracing
+  enabled, serve a few requests, then dump the registry ``collect()``
+  AND each request's span timeline — the zero-to-telemetry demo
+  (docs/observability.md), and a smoke test that the whole plane is
+  wired: submit → queue → prefix lookup/copy → prefill → decode steps →
+  complete must all appear.
+
+Usage::
+
+    python tools/obs_dump.py metrics.prom
+    python tools/obs_dump.py metrics.jsonl --filter serving
+    python tools/obs_dump.py --live
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------- file mode
+
+def load_snapshot_file(path: str) -> dict:
+    """Return ``{name{labels}: value}`` from a Prometheus-text or
+    JSON-lines export (auto-detected by the first parseable line)."""
+    from mxnet_tpu.observability import parse_prometheus
+
+    with open(path) as f:
+        text = f.read()
+    first = next((ln for ln in text.splitlines() if ln.strip()), "")
+    if first.startswith("{"):            # JSON lines
+        out = {}
+        for ln in text.splitlines():
+            if not ln.strip():
+                continue
+            s = json.loads(ln)
+            if "name" not in s:          # the meta line
+                continue
+            labels = ",".join(f'{k}="{v}"'
+                              for k, v in sorted(s.get("labels",
+                                                       {}).items()))
+            key = s["name"] + (f"{{{labels}}}" if labels else "")
+            if s["kind"] == "histogram":
+                out[key + ":count"] = s["count"]
+                out[key + ":sum"] = round(s["sum"], 6)
+                out[key + ":p50_ms"] = round(1e3 * s["p50"], 3)
+                out[key + ":p99_ms"] = round(1e3 * s["p99"], 3)
+            else:
+                out[key] = s["value"]
+        return out
+    parsed = parse_prometheus(text)
+    return {name + ("{%s}" % ",".join(f'{k}="{v}"' for k, v in labels)
+                    if labels else ""): v
+            for (name, labels), v in parsed.items()}
+
+
+def print_table(flat: dict, filt: str = ""):
+    rows = sorted((k, v) for k, v in flat.items() if filt in k)
+    if not rows:
+        print("(no matching metrics)")
+        return
+    width = max(len(k) for k, _ in rows)
+    for k, v in rows:
+        sv = f"{v:g}" if isinstance(v, float) else str(v)
+        print(f"{k:<{width}}  {sv}")
+
+
+# ------------------------------------------------------------- live mode
+
+def live_demo(n_requests: int = 4, max_new: int = 4) -> int:
+    import numpy as onp
+
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.models import get_gpt2
+    from mxnet_tpu.serving import InferenceEngine
+
+    onp.random.seed(0)
+    net = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                   num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    tracer = obs.enable_tracing()
+    eng = InferenceEngine(net, num_slots=2, max_batch=2, seq_buckets=(8,),
+                          default_max_new_tokens=max_new,
+                          prefix_pool_rows=1, prefix_min_tokens=2,
+                          name="obs_dump")
+    rs = onp.random.RandomState(3)
+    shared = rs.randint(0, 61, (5,)).astype("int32")
+    with eng:
+        futs = [eng.submit(
+            onp.concatenate([shared,
+                             rs.randint(0, 61, (2,)).astype("int32")]),
+            max_new_tokens=max_new) for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=120)
+
+    print("== registry collect() ==")
+    print_table(obs.flatten(include_zero=False), filt="mxtpu_")
+    print()
+    for i, f in enumerate(futs):
+        print(f"== request {i} trace timeline (trace_id={f.trace_id}) ==")
+        for d in tracer.timeline(f.trace_id):
+            shared_tag = "*" if d["trace_ids"] else " "
+            print(f"  +{d['offset_ms']:9.3f}ms {shared_tag} "
+                  f"{d['name']:<28} {d['duration_ms']:9.3f}ms "
+                  f"{d['attrs'] or ''}")
+        print()
+    print("(* = batched device call shared with other requests)")
+    obs.disable_tracing()
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="exporter output file (prometheus text or "
+                         "JSON lines)")
+    ap.add_argument("--filter", default="",
+                    help="substring filter on metric names")
+    ap.add_argument("--live", action="store_true",
+                    help="run the in-process tiny-engine demo instead "
+                         "of reading a file")
+    args = ap.parse_args()
+
+    if args.live:
+        return live_demo()
+    if args.snapshot is None:
+        ap.error("pass a snapshot file or --live")
+    print_table(load_snapshot_file(args.snapshot), args.filter)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
