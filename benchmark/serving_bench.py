@@ -25,6 +25,19 @@ cache enabled; ``vs_baseline`` is the median-TTFT speedup, and the
 record carries the measured hit rate, tokens saved, and the TTFT
 reduction percentage).
 
+``--workload fleet`` runs the 1-vs-3-replica comparison (docs/fleet.md):
+G prompt families (distinct long system prompts, short unique tails)
+interleaved through a single engine, a 3-replica fleet with seeded
+RANDOM routing (the control: every replica ends up paying every
+family's prefill), and a 3-replica fleet with prefix-AFFINITY routing
+(each family rendezvous-hashes onto one replica).  It emits
+``serving_fleet_ttft_single`` (the baseline),
+``serving_fleet_ttft_random_r3`` and ``serving_fleet_ttft_affinity_r3``
+(``vs_baseline`` is the mean-TTFT speedup over the RANDOM fleet — the
+number affinity routing exists to win), with fleet/per-replica prefix
+hit rates and the fleet-aggregated ``mxtpu_fleet_*`` registry snapshot
+embedded in the affinity record.
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -211,12 +224,118 @@ def bench_prefix_cache(n_requests: int = 12, max_new: int = 2,
                    "prefix_tokens_saved": pc["prefix_tokens_saved"]})
 
 
+def bench_fleet(n_replicas: int = 3, groups: int = 3, per_group: int = 16,
+                max_new: int = 2, trials: int = 3):
+    """1-vs-3-replica repeated-system-prompt workload.  Requests run
+    serially (TTFT isolation); a fresh fleet per trial keeps trials
+    independent; warmup pays every replica's compiles before any timed
+    request.  The per-trial statistic is the request-weighted MEAN TTFT
+    across replicas — the mean (unlike the median) moves with every
+    extra prefix miss a bad placement causes."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.fleet import FleetRouter
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    net, shared_len, tail_len, seq_buckets = _build_prefix_net(on_tpu)
+    if not on_tpu:
+        # two lattice points suffice (suffix chunk + full prefill) and
+        # keep per-arm warmup short, so the three routing arms of one
+        # trial run close together in time — paired against the same
+        # slice of host noise
+        seq_buckets = (32, 128)
+    rs = onp.random.RandomState(7)
+    families = []
+    for _g in range(groups):
+        shared = rs.randint(0, net.vocab_size,
+                            (shared_len,)).astype("int32")
+        families.append([onp.concatenate(
+            [shared, rs.randint(0, net.vocab_size,
+                                (tail_len,)).astype("int32")])
+            for _ in range(per_group)])
+    # interleave the families: the worst case for any router that keys
+    # on arrival order instead of content
+    stream = [p for batch in zip(*families) for p in batch]
+
+    def factory_for(fleet_name):
+        def factory(name):
+            return InferenceEngine(
+                net, num_slots=1, max_batch=1, seq_buckets=seq_buckets,
+                default_max_new_tokens=max_new, prefix_pool_rows=groups + 1,
+                prefix_min_tokens=8, name=name)
+        return factory
+
+    def one_trial(n, routing, tag):
+        import gc
+
+        from mxnet_tpu.observability import flatten
+        fleet = FleetRouter(factory=factory_for(tag), num_replicas=n,
+                            routing=routing, name=tag, seed=0)
+        fleet.warmup()
+        # the timed window is short (serial TTFT isolation): a GC pause
+        # from the engines just built must not land inside it
+        gc.collect()
+        with fleet:
+            for p in stream:
+                fleet.infer(p, max_new_tokens=max_new)
+            s = fleet.stats()
+            # snapshot the fleet-aggregated registry series while this
+            # fleet is alive and healthy (it is a weakref-bound
+            # collector, and its replica-up gauges zero out at stop)
+            s["registry"] = flatten(prefix="mxtpu_fleet")
+        total = sum(rep["stats"]["ttft"]["count"]
+                    for rep in s["replicas"].values())
+        mean_ms = sum(rep["stats"]["ttft"]["mean_ms"] *
+                      rep["stats"]["ttft"]["count"]
+                      for rep in s["replicas"].values()) / total
+        return mean_ms, s
+
+    single_vals, random_vals, affinity_vals = [], [], []
+    last_aff = None
+    for t in range(max(1, trials)):
+        single_vals.append(one_trial(1, "affinity", f"fleet1_t{t}")[0])
+        random_vals.append(one_trial(n_replicas, "random",
+                                     f"fleetR_t{t}")[0])
+        mean_ms, last_aff = one_trial(n_replicas, "affinity",
+                                      f"fleetA_t{t}")
+        affinity_vals.append(mean_ms)
+
+    agg = last_aff["aggregate"]
+    per_replica_hits = {
+        name: rep["stats"]["prefix_cache"]["hit_rate"]
+        for name, rep in last_aff["replicas"].items()}
+    speed_vs_random = round(statistics.median(random_vals) /
+                            statistics.median(affinity_vals), 4)
+    speed_vs_single = round(statistics.median(single_vals) /
+                            statistics.median(affinity_vals), 4)
+    n_req = groups * per_group
+    base = {"n_replicas": n_replicas, "groups": groups,
+            "n_requests": n_req, "shared_prefix": shared_len,
+            "tail": tail_len}
+    yield _record("serving_fleet_ttft_single", single_vals, "ms", None,
+                  dict(base, n_replicas=1))
+    yield _record("serving_fleet_ttft_random_r3", random_vals, "ms",
+                  round(statistics.median(single_vals) /
+                        statistics.median(random_vals), 4), base)
+    yield _record(
+        "serving_fleet_ttft_affinity_r3", affinity_vals, "ms",
+        speed_vs_random,
+        dict(base, vs_single=speed_vs_single,
+             fleet_prefix_hit_rate=agg["prefix_hit_rate"],
+             per_replica_hit_rate=per_replica_hits,
+             prefix_tokens_saved=agg["prefix_tokens_saved"],
+             affinity_routed=last_aff["router"].get("affinity_routed", 0),
+             fleet_registry=last_aff["registry"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trials", type=int, default=3)
-    ap.add_argument("--workload", choices=("decode", "prefix"),
+    ap.add_argument("--workload", choices=("decode", "prefix", "fleet"),
                     default="decode")
     args = ap.parse_args()
 
@@ -228,6 +347,8 @@ def main():
 
     if args.workload == "prefix":
         recs = bench_prefix_cache(trials=args.trials)
+    elif args.workload == "fleet":
+        recs = bench_fleet(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -83,6 +83,7 @@ _LAZY = {
     "recordio": ".recordio",
     "runtime": ".runtime",
     "serving": ".serving",
+    "fleet": ".fleet",
     "resilience": ".resilience",
     "observability": ".observability",
     "test_utils": ".test_utils",

@@ -1,0 +1,201 @@
+"""One replica of the fleet: an :class:`InferenceEngine` plus the
+health/lifecycle state the router places traffic by.
+
+A replica is always in exactly one state:
+
+- ``HEALTHY`` — routable.  The monitor polls ``engine.health()``; the
+  first not-live probe (scheduler died, watchdog condemned, crashed)
+  moves it to ``DEAD``.
+- ``DEAD`` — not routable; sitting out a probation window.  The window
+  starts at ``probation`` seconds and doubles per consecutive death
+  (capped at ``probation_max``): a replica that crashes right back
+  after every rebuild backs off instead of flapping traffic onto a
+  poisoned host.  When the window elapses and the fleet has an engine
+  ``factory``, the monitor REBUILDS the replica — a condemned engine
+  can never be restarted (docs/resilience.md), re-admission is a fresh
+  engine under the same replica name — optionally re-running
+  ``warmup()`` so the newcomer never compiles on traffic.
+- ``DRAINING`` — not routable; ``engine.stop(drain=True)`` in progress.
+  Queued and in-flight requests on the replica finish; new traffic is
+  steered away.  This is the rolling-restart building block.
+- ``STOPPED`` — drained (or force-stopped); waiting for ``restart()``
+  or fleet shutdown.
+
+State transitions are guarded by the handle's lock; the engine
+reference itself is swapped atomically on rebuild, so routing threads
+reading ``handle.engine`` mid-readmission see either the corpse (whose
+``submit`` raises typed — the router just tries the next candidate) or
+the replacement, never a torn handle.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["ReplicaHandle", "HEALTHY", "DEAD", "DRAINING", "STOPPED"]
+
+HEALTHY = "healthy"
+DEAD = "dead"
+DRAINING = "draining"
+STOPPED = "stopped"
+
+
+class ReplicaHandle:
+    def __init__(self, name: str, engine, *,
+                 factory: Optional[Callable] = None,
+                 probation: float = 0.25,
+                 probation_backoff: float = 2.0,
+                 probation_max: float = 30.0,
+                 restart_warmup: bool = True):
+        self.name = name
+        self.engine = engine
+        self.factory = factory
+        self.probation = float(probation)
+        self.probation_backoff = float(probation_backoff)
+        self.probation_max = float(probation_max)
+        self.restart_warmup = bool(restart_warmup)
+        self.state = HEALTHY
+        self.deaths = 0              # consecutive (resets on healthy probe)
+        self.total_deaths = 0
+        self.restarts = 0
+        self.routed = 0              # requests placed here (router-counted)
+        self.probation_until: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- state
+    def routable(self) -> bool:
+        return self.state == HEALTHY
+
+    def load(self) -> int:
+        """Instantaneous placement load: admission-queue depth plus
+        leased KV slots — the same numbers the engine exports as the
+        ``mxtpu_serving_queue_depth`` / ``mxtpu_serving_active_slots``
+        registry gauges, read straight off the engine so routing never
+        pays a full registry collect()."""
+        eng = self.engine
+        try:
+            q = len(eng._batcher)
+            a = eng._alloc.active_count if eng._alloc is not None else 0
+            return q + a
+        except Exception:
+            return 1 << 30           # unreadable replica sorts last
+
+    def queue_depth(self) -> int:
+        try:
+            return len(self.engine._batcher)
+        except Exception:
+            return 1 << 30
+
+    def saturated(self, spill_depth: int) -> bool:
+        """The affinity-spill test: is this replica's queue deep enough
+        that waiting behind it costs more than a prefix miss elsewhere?
+        """
+        return self.queue_depth() >= spill_depth
+
+    # --------------------------------------------------------------- deaths
+    def mark_dead(self, reason: str, now: Optional[float] = None) -> bool:
+        """HEALTHY → DEAD with a fresh probation window; returns whether
+        this call made the transition (the monitor and a failing submit
+        path may race to report the same corpse)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state != HEALTHY:
+                return False
+            self.state = DEAD
+            self.deaths += 1
+            self.total_deaths += 1
+            self.last_error = reason
+            window = min(self.probation_max, self.probation *
+                         self.probation_backoff ** (self.deaths - 1))
+            self.probation_until = now + window
+            return True
+
+    def probe(self, now: Optional[float] = None) -> bool:
+        """One monitor tick: returns True iff this probe transitioned
+        the replica to DEAD.  A healthy probe resets the consecutive-
+        death streak (the backoff ladder restarts)."""
+        if self.state != HEALTHY:
+            return False
+        try:
+            h = self.engine.health()
+            live = bool(h["live"])
+            reason = h.get("crashed") or "scheduler not live"
+        except Exception as e:            # a broken probe IS a dead replica
+            live, reason = False, f"health() raised: {e!r}"
+        if live:
+            self.deaths = 0
+            return False
+        return self.mark_dead(str(reason), now)
+
+    def due_for_readmission(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return (self.state == DEAD and self.factory is not None
+                and self.probation_until is not None
+                and now >= self.probation_until)
+
+    def rebuild(self, abort: Optional[Callable[[], bool]] = None) -> bool:
+        """Probation elapsed: build a fresh engine under this replica's
+        name, start it (and re-warm unless ``restart_warmup=False`` —
+        a re-admitted replica should not pay compiles on live traffic),
+        and go HEALTHY.  A failed rebuild counts as another death and
+        extends the backoff window.
+
+        ``abort`` is polled around the (slow: warmup compiles) build:
+        when it turns true — the fleet started shutting down mid-
+        rebuild — the replacement engine is stopped instead of
+        committed, so a stopped fleet can never resurrect a running
+        replica."""
+        if self.factory is None:
+            return False
+        # retire the corpse FIRST: a condemned/stopped engine releases
+        # its claimed name, so the replacement reclaims the PLAIN name
+        # and this replica's metric series keep their labels across
+        # restarts instead of drifting to "<name>-2"
+        try:
+            self.engine.stop(drain=False, timeout=1.0)
+        except Exception:
+            pass
+        try:
+            eng = self.factory(self.name)
+            if eng._thread is None:
+                eng.start()
+            if self.restart_warmup:
+                eng.warmup()
+        except Exception as e:
+            with self._lock:
+                self.deaths += 1
+                self.total_deaths += 1
+                self.last_error = f"rebuild failed: {e!r}"
+                window = min(self.probation_max, self.probation *
+                             self.probation_backoff ** (self.deaths - 1))
+                self.probation_until = time.monotonic() + window
+            return False
+        if abort is not None and abort():
+            self._discard(eng)
+            return False
+        with self._lock:
+            self.engine = eng
+            self.state = HEALTHY
+            self.restarts += 1
+            self.probation_until = None
+        if abort is not None and abort():
+            # shutdown landed between the check above and the commit:
+            # undo — the fleet's stop sweep may already have passed this
+            # handle, so it must not stay HEALTHY with a live engine
+            with self._lock:
+                self.state = STOPPED
+            self._discard(eng)
+            return False
+        return True
+
+    def _discard(self, eng) -> None:
+        try:
+            eng.stop(drain=False, timeout=1.0)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return (f"ReplicaHandle({self.name!r}, state={self.state}, "
+                f"deaths={self.total_deaths}, restarts={self.restarts})")

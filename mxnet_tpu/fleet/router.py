@@ -1,0 +1,818 @@
+"""`FleetRouter` — the multi-replica front door.
+
+One :class:`~mxnet_tpu.serving.InferenceEngine` is not a fleet: heavy
+traffic needs N replicas, and the router is the tier that coordinates
+them while each replica keeps its single-engine semantics.  Callers
+swap one import — the router exposes the same ``infer`` / ``submit`` /
+``stats`` / ``stop`` surface as the engine — and get:
+
+- **Prefix-affinity placement** (:mod:`.policy`): requests sharing a
+  system prompt rendezvous-hash onto the replica whose prefix pool
+  already holds that prompt's K/V, multiplying the single-engine TTFT
+  win (docs/serving.md) across the fleet instead of paying one full
+  prefill per replica per prompt family.  A saturated affinity target
+  spills to the least-loaded healthy replica — a prefix hit is not
+  worth queueing behind a hot spot.
+- **Health-gated placement** (:mod:`.replica`): a monitor thread polls
+  every replica's ``health()``; a dead/condemned replica stops taking
+  traffic immediately and is re-admitted only after a probation window
+  with exponential backoff — rebuilt fresh via the engine ``factory``
+  (a condemned engine cannot be restarted) and re-warmed so it never
+  compiles on live traffic.
+- **Failover**: a request failed by a crashed or stopped replica is
+  resubmitted to a healthy one — within the request's ORIGINAL
+  deadline (the clock is never reset) and a bounded per-request
+  failover budget (never refreshed by a resubmission), so a poisoned
+  request cannot ping-pong around the fleet forever.  With
+  ``hedge_after`` set, a request stuck past that long on its primary
+  is duplicated onto a second replica and the first completion wins
+  (greedy decode is deterministic, so duplicates agree).
+- **Rolling drain/restart**: ``drain(name)`` quiesces one replica
+  through the engine's SIGTERM drain path while traffic steers away;
+  ``restart(name)`` rebuilds it; ``rolling_restart()`` chains both
+  across the fleet for zero-downtime upgrades.  ``stop()`` drains ALL
+  replicas concurrently under one deadline, and a replica that hangs
+  in drain is condemned (watchdog-killed) rather than wedging fleet
+  shutdown.
+
+Fault-injection sites (docs/resilience.md): ``fleet.route`` (before
+affinity-key computation — faults degrade to least-loaded placement),
+``fleet.failover`` (before a resubmission — faults abort that failover
+attempt), ``fleet.drain`` (per-replica shutdown worker — a delay here
+models a replica hanging in drain, which the stop deadline must
+condemn).
+
+Observability: every replica engine already exports per-engine labeled
+series (unique ``engine=`` names); the router adds a ``fleet:<name>``
+collector with routing/failover/lifecycle counters, per-replica
+up/routed series, and the fleet-aggregated prefix hit rate, all under
+``mxtpu_fleet_*`` names in the same process-wide ``collect()``.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import signal as _signal
+import threading
+import time
+import weakref
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+import numpy as onp
+
+from ..resilience.faults import inject as _inject
+from ..serving.errors import (EngineCrashedError, EngineStoppedError,
+                              InvalidRequestError, NoHealthyReplicaError,
+                              QueueFullError, RequestTimeoutError,
+                              ServingError)
+from .policy import RoutingPolicy
+from .replica import DEAD, DRAINING, HEALTHY, STOPPED, ReplicaHandle
+
+__all__ = ["FleetRouter", "FleetFuture"]
+
+
+class _FleetRequest:
+    """Replica-independent request record — everything needed to
+    resubmit the request to another replica on failover."""
+
+    __slots__ = ("payload", "kind", "max_new_tokens", "eos_id", "deadline",
+                 "failovers_left")
+
+    def __init__(self, payload, kind, max_new_tokens, eos_id, deadline,
+                 failovers):
+        self.payload = payload
+        self.kind = kind
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline          # absolute monotonic, never reset
+        self.failovers_left = failovers   # never refreshed
+
+    def remaining(self, now: Optional[float] = None) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return self.deadline - now
+
+
+class FleetFuture:
+    """The router-side future: resolves like an engine future, but a
+    replica-level failure (``EngineCrashedError`` / ``EngineStoppedError``)
+    triggers failover instead of surfacing — the caller only ever sees
+    a result, a request-level typed error, or a fleet-level typed error
+    once budget/deadline/replicas are exhausted.  ``trace_id`` follows
+    the CURRENT attempt (each engine submit allocates its own)."""
+
+    def __init__(self, router: "FleetRouter", req: _FleetRequest,
+                 handle: ReplicaHandle, inner):
+        self._router = router
+        self._req = req
+        self._lock = threading.Lock()
+        self._attempts: List[Tuple[ReplicaHandle, object]] = [(handle, inner)]
+        self._exc: Optional[BaseException] = None   # terminal failure
+        self._hedged = False
+        self._t_submit = time.monotonic()
+        self.trace_id = inner.trace_id
+
+    def done(self) -> bool:
+        """True once ANY attempt has resolved (a hint for pollers; a
+        done-with-replica-failure attempt still fails over inside
+        ``result()``) or the request failed terminally."""
+        with self._lock:
+            return self._exc is not None or \
+                any(f.done() for _h, f in self._attempts)
+
+    def result(self, timeout: Optional[float] = None):
+        client_deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._exc is not None:
+                    # terminal (failover exhausted / deadline blown):
+                    # repeat calls — or a second waiting thread — see
+                    # the same typed error, like an engine future
+                    raise self._exc
+                attempts = list(self._attempts)
+            primary_h, primary_f = attempts[0]
+            # resolve any DONE attempt first (a hedge may beat the
+            # primary); otherwise block a short chunk on the primary so
+            # the common single-attempt path costs no busy-wait
+            ready = [(h, f) for h, f in attempts if f.done()]
+            if not ready:
+                chunk = 0.05
+                if client_deadline is not None:
+                    chunk = min(chunk, max(0.0, client_deadline
+                                           - time.monotonic()))
+                try:
+                    val = primary_f.result(chunk)
+                except TimeoutError:
+                    val, ready = None, []
+                except (EngineCrashedError, EngineStoppedError) as e:
+                    self._drop_attempt(primary_h, primary_f, e)
+                    continue
+                else:
+                    self.trace_id = primary_f.trace_id
+                    return val
+            for h, f in ready:
+                try:
+                    val = f.result(0)
+                except TimeoutError:      # raced: no longer done — retry
+                    continue
+                except (EngineCrashedError, EngineStoppedError) as e:
+                    self._drop_attempt(h, f, e)
+                    break
+                else:
+                    self.trace_id = f.trace_id
+                    return val
+            if ready:
+                continue
+            now = time.monotonic()
+            if client_deadline is not None and now >= client_deadline:
+                raise TimeoutError(
+                    "result() wait timed out (the request may still "
+                    "complete fleet-side)")
+            self._maybe_hedge(now)
+
+    def _drop_attempt(self, handle, fut, exc):
+        """One attempt died with a REPLICA-level error: if other
+        (hedged) attempts are still in flight, just forget this one;
+        otherwise fail over — the router resubmits within the request's
+        budget and deadline, or re-raises."""
+        if isinstance(exc, EngineCrashedError):
+            if handle.mark_dead(str(exc)):
+                self._router._count("replica_deaths")
+        with self._lock:
+            try:
+                self._attempts.remove((handle, fut))
+            except ValueError:
+                pass
+            alive = bool(self._attempts)
+        if alive:
+            return
+        try:
+            nxt = self._router._failover(self._req, exc)
+        except BaseException as e:
+            with self._lock:
+                self._exc = e       # terminal: _attempts is empty now
+            raise
+        with self._lock:
+            self._attempts.append(nxt)
+
+    def _maybe_hedge(self, now: float):
+        r = self._router
+        if r.hedge_after is None or self._hedged:
+            return
+        if now - self._t_submit < r.hedge_after:
+            return
+        self._hedged = True
+        with self._lock:
+            exclude = {h.name for h, _f in self._attempts}
+        try:
+            nxt = r._submit_once(self._req, exclude=exclude)
+        except ServingError:
+            return                  # hedging is an optimization, never fatal
+        r._count("hedges")
+        with self._lock:
+            self._attempts.append(nxt)
+
+
+class FleetRouter:
+    """Front N engine replicas behind the single-engine surface.
+
+    Parameters
+    ----------
+    engines : existing engines to wrap, one replica each (their claimed
+        ``name`` becomes the replica name).  Dead replicas can only be
+        re-admitted when a ``factory`` is also given.
+    factory : ``factory(replica_name) -> InferenceEngine`` — builds a
+        replica.  With ``num_replicas`` (and no ``engines``) the router
+        builds the initial fleet ``<name>-r0 … <name>-r{N-1}`` itself;
+        it is also how a dead replica is rebuilt after probation and
+        how ``restart()`` works.  Pass ``name=replica_name`` through to
+        the engine so metrics labels follow the replica.
+    num_replicas : fleet size when building from ``factory``.
+    routing : ``'affinity'`` (default — prefix-affinity with
+        least-loaded spill), ``'least_loaded'``, or ``'random'``
+        (seeded; the control arm for the fleet benchmark).
+    affinity_min_tokens / affinity_window / tracker_entries : the
+        :class:`~.policy.RoutingPolicy` knobs.
+    spill_queue_depth : affinity target counts as SATURATED when its
+        admission queue is at least this deep (default: 2x the first
+        engine's ``num_slots``) — the spill trades a prefix hit for not
+        queueing behind a hot replica.
+    max_failovers : per-request budget of crash-failover resubmissions
+        (the fleet-level analogue of the engine's per-request step-retry
+        budget; never refreshed by a failover).
+    hedge_after : seconds after which a still-unresolved request is
+        duplicated onto a second healthy replica (None = no hedging).
+    health_interval : monitor poll period in seconds.
+    probation / probation_backoff / probation_max : re-admission window
+        after a replica death: ``probation * backoff**(deaths-1)``
+        seconds, capped.
+    restart_warmup : re-run ``warmup()`` on rebuilt/restarted replicas
+        so re-admission never compiles on live traffic.
+    drain_timeout : default deadline for ``stop()`` / the SIGTERM drain
+        (None = wait indefinitely; a hung replica still cannot wedge
+        shutdown forever — its engine watchdog or an explicit timeout
+        condemns it).
+    name : fleet name — the ``fleet=`` label on every ``mxtpu_fleet_*``
+        series and the default prefix for factory-built replica names.
+    """
+
+    def __init__(self, engines: Optional[Sequence] = None, *,
+                 factory: Optional[Callable] = None,
+                 num_replicas: Optional[int] = None,
+                 routing: str = "affinity",
+                 affinity_min_tokens: int = 4,
+                 affinity_window: int = 32,
+                 tracker_entries: int = 512,
+                 spill_queue_depth: Optional[int] = None,
+                 max_failovers: int = 2,
+                 hedge_after: Optional[float] = None,
+                 health_interval: float = 0.05,
+                 probation: float = 0.25,
+                 probation_backoff: float = 2.0,
+                 probation_max: float = 30.0,
+                 restart_warmup: bool = True,
+                 drain_timeout: Optional[float] = None,
+                 seed: int = 0,
+                 name: str = "fleet"):
+        if routing not in ("affinity", "least_loaded", "random"):
+            raise ValueError(f"routing must be 'affinity'|'least_loaded'|"
+                             f"'random', got {routing!r}")
+        self.name = str(name)
+        self.routing = routing
+        self.factory = factory
+        self.max_failovers = int(max_failovers)
+        self.hedge_after = hedge_after
+        self.health_interval = float(health_interval)
+        self.drain_timeout = drain_timeout
+        self._policy = RoutingPolicy(affinity_min_tokens, affinity_window,
+                                     tracker_entries)
+        self._rng = _pyrandom.Random(int(seed))
+        self._rng_lock = threading.Lock()
+
+        if engines is None:
+            if factory is None or not num_replicas:
+                raise ServingError(
+                    "FleetRouter needs engines=[...] or factory= + "
+                    "num_replicas=N")
+            engines = [factory(f"{self.name}-r{i}")
+                       for i in range(int(num_replicas))]
+            names = [f"{self.name}-r{i}" for i in range(int(num_replicas))]
+        else:
+            engines = list(engines)
+            if not engines:
+                raise ServingError("FleetRouter needs at least one replica")
+            names = [e.name for e in engines]
+        if len(set(names)) != len(names):
+            raise ServingError(f"replica names must be unique, got {names}")
+        mode = engines[0].mode
+        if any(e.mode != mode for e in engines):
+            raise ServingError("all replicas must share one mode "
+                               "(decode or forward)")
+        self.mode = mode
+        self._handles = [
+            ReplicaHandle(n, e, factory=factory, probation=probation,
+                          probation_backoff=probation_backoff,
+                          probation_max=probation_max,
+                          restart_warmup=restart_warmup)
+            for n, e in zip(names, engines)]
+        self._by_name = {h.name: h for h in self._handles}
+        self.spill_queue_depth = int(spill_queue_depth) \
+            if spill_queue_depth is not None \
+            else max(2, 2 * engines[0].num_slots)
+
+        self._counters = {}
+        self._counters_lock = threading.Lock()
+        self._mon_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._stop_lock = threading.Lock()
+        self._stopping = False
+        self._prev_handlers = None
+        self._register_collector()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetRouter":
+        if self._monitor is not None:
+            raise ServingError("router already started")
+        if self._stopping:
+            raise ServingError("router cannot be restarted once stopped "
+                               "— build a fresh FleetRouter")
+        for h in self._handles:
+            if h.engine._thread is None:
+                h.engine.start()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="mxnet_tpu-fleet-monitor",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def warmup(self, **kw) -> dict:
+        """Pre-compile every replica's lattice; returns
+        ``{replica_name: programs_compiled}``.  After this, each
+        replica's ``compiles`` counter must stay frozen on traffic —
+        the same contract as the single engine."""
+        return {h.name: h.engine.warmup(**kw) for h in self._handles}
+
+    def __enter__(self):
+        if self._monitor is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=not any(exc))
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the fleet: every replica drains CONCURRENTLY (a fleet
+        of N must not pay N serial drains) under one deadline
+        (``timeout``, default ``drain_timeout``).  A replica whose
+        drain outlives the deadline — hung scheduler, injected
+        ``fleet.drain`` delay — is CONDEMNED (its queued/in-flight
+        requests fail typed, exactly the watchdog contract) rather than
+        wedging shutdown.  Nothing is silently dropped: each engine's
+        own stop/sweep guarantees carry over per replica."""
+        with self._stop_lock:
+            self._stopping = True
+            self._mon_stop.set()
+            mon = self._monitor
+            if mon is not None and mon.is_alive() and \
+                    mon is not threading.current_thread():
+                mon.join(2.0)
+            timeout = self.drain_timeout if timeout is None else timeout
+            deadline = None if timeout is None else \
+                time.monotonic() + float(timeout)
+            workers = []
+            for h in self._handles:
+                with h._lock:
+                    if h.state in (HEALTHY, DRAINING):
+                        h.state = DRAINING
+                    elif h.state == STOPPED:
+                        continue
+                t = threading.Thread(
+                    target=self._shutdown_replica, args=(h, drain, deadline),
+                    name=f"mxnet_tpu-fleet-drain-{h.name}", daemon=True)
+                t.start()
+                workers.append((h, t))
+            for h, t in workers:
+                budget = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                t.join(budget)
+            for h, t in workers:
+                if t.is_alive():
+                    # the drain worker itself is stuck (e.g. a delay at
+                    # fleet.drain): condemn from here — the worker's own
+                    # engine.stop() then returns promptly on the crashed
+                    # path and the futures are already failed typed
+                    self._count("forced_stops")
+                    try:
+                        h.engine.condemn(
+                            "fleet stop deadline exceeded — replica drain "
+                            "did not complete in time")
+                    except Exception:
+                        pass
+                    with h._lock:
+                        h.state = STOPPED
+            self.uninstall_signal_handlers()
+
+    def _shutdown_replica(self, h: ReplicaHandle, drain: bool,
+                          deadline: Optional[float]):
+        try:
+            _inject("fleet.drain")
+        except BaseException:
+            # an injected drain fault: the graceful path is broken, go
+            # straight to the force-stop path rather than aborting the
+            # shutdown of this replica
+            self._count("drain_faults")
+            drain = False
+        budget = None if deadline is None else \
+            max(0.1, deadline - time.monotonic())
+        try:
+            h.engine.stop(drain=drain, timeout=budget)
+        except ServingError:
+            # still draining at its deadline: watchdog-kill the replica
+            # instead of wedging the fleet — condemnation fails every
+            # queued/in-flight request typed, then the engine's stop
+            # path returns promptly
+            self._count("forced_stops")
+            try:
+                h.engine.condemn("fleet drain deadline exceeded — "
+                                 "force-stopping replica")
+                h.engine.stop(drain=False, timeout=2.0)
+            except Exception:
+                pass
+        except Exception:
+            pass
+        with h._lock:
+            h.state = STOPPED
+
+    # ------------------------------------------------------ rolling drain
+    def drain(self, replica: str, timeout: Optional[float] = None):
+        """Quiesce ONE replica: new traffic steers away immediately,
+        queued and in-flight requests on it complete (the SIGTERM drain
+        path), then the engine stops.  The replica ends ``STOPPED`` —
+        ``restart()`` brings it back.  A drain that outlives ``timeout``
+        condemns the replica (see ``stop()``)."""
+        if self._stopping:
+            raise ServingError("fleet router is stopped")
+        h = self._require(replica)
+        with h._lock:
+            if h.state != HEALTHY:
+                raise ServingError(f"replica {replica!r} is {h.state}, "
+                                   "not drainable")
+            h.state = DRAINING
+        self._count("drains")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._shutdown_replica(h, True, deadline)
+
+    def restart(self, replica: str) -> bool:
+        """Rebuild a drained/dead replica via the factory (fresh engine
+        under the same replica name, re-warmed) and return it to
+        traffic."""
+        if self._stopping:
+            raise ServingError("fleet router is stopped")
+        h = self._require(replica)
+        if h.factory is None:
+            raise ServingError("restart() needs an engine factory — "
+                               "construct the FleetRouter with factory=")
+        if h.state == HEALTHY:
+            raise ServingError(f"replica {replica!r} is healthy — drain "
+                               "it first")
+        if not h.rebuild():
+            raise ServingError(f"replica {replica!r} rebuild failed: "
+                               f"{h.last_error}")
+        self._count("restarts")
+        return True
+
+    def rolling_restart(self, timeout: Optional[float] = None):
+        """Zero-downtime fleet restart: drain + rebuild each replica in
+        sequence while the rest keep serving."""
+        for h in list(self._handles):
+            self.drain(h.name, timeout=timeout)
+            self.restart(h.name)
+
+    def replace(self, replica: str, engine) -> None:
+        """Swap a fresh, caller-built engine into a non-healthy replica
+        slot (the no-factory escape hatch)."""
+        h = self._require(replica)
+        if h.state == HEALTHY:
+            raise ServingError(f"replica {replica!r} is healthy — drain "
+                               "it first")
+        if engine._thread is None:
+            engine.start()
+        with h._lock:
+            h.engine = engine
+            h.state = HEALTHY
+            h.restarts += 1
+            h.probation_until = None
+
+    def _require(self, replica: str) -> ReplicaHandle:
+        h = self._by_name.get(replica)
+        if h is None:
+            raise ServingError(f"unknown replica {replica!r} — have "
+                               f"{sorted(self._by_name)}")
+        return h
+
+    # --------------------------------------------------------- SIGTERM
+    def install_signal_handlers(self, signals=(_signal.SIGTERM,)):
+        """Route SIGTERM (the preemption notice) to a concurrent
+        fleet-wide ``stop(drain=True)`` on a helper thread, bounded by
+        ``drain_timeout``."""
+        prev = {}
+        for s in signals:
+            prev[s] = _signal.signal(s, self._on_term_signal)
+        self._prev_handlers = prev
+        return prev
+
+    def uninstall_signal_handlers(self):
+        if self._prev_handlers and \
+                threading.current_thread() is threading.main_thread():
+            for s, hd in self._prev_handlers.items():
+                try:
+                    _signal.signal(s, hd)
+                except (ValueError, TypeError):
+                    pass
+            self._prev_handlers = None
+
+    def _on_term_signal(self, signum, frame):
+        threading.Thread(target=self.stop, kwargs={"drain": True},
+                         name="mxnet_tpu-fleet-drain",
+                         daemon=True).start()
+
+    # ----------------------------------------------------------- monitor
+    def _monitor_loop(self):
+        while not self._mon_stop.wait(self.health_interval):
+            for h in self._handles:
+                try:
+                    if h.probe():
+                        self._count("replica_deaths")
+                    elif h.due_for_readmission() and not self._stopping:
+                        # abort= closes the stop-vs-rebuild race: a
+                        # rebuild still in flight when the fleet stops
+                        # discards its replacement engine instead of
+                        # resurrecting a replica on a stopped fleet
+                        if h.rebuild(abort=lambda: self._stopping):
+                            self._count("readmissions")
+                except Exception:
+                    continue       # the monitor must outlive any probe
+
+    # ------------------------------------------------------------ routing
+    def _healthy(self) -> List[ReplicaHandle]:
+        return [h for h in self._handles if h.routable()]
+
+    def _order_candidates(self, payload) -> List[ReplicaHandle]:
+        healthy = self._healthy()
+        if not healthy:
+            self._count("no_healthy")
+            raise NoHealthyReplicaError(
+                f"fleet {self.name!r}: no healthy replica "
+                f"({ {h.name: h.state for h in self._handles} })")
+        key, faulted = None, False
+        try:
+            _inject("fleet.route")
+            if self.routing == "affinity" and self.mode == "decode":
+                key = self._policy.affinity_key(payload)
+        except Exception:
+            # contained: the request just loses the routing shortcut
+            # and places least-loaded, it never fails
+            self._count("route_faults")
+            key, faulted = None, True
+        if self.routing == "random" and not faulted:
+            with self._rng_lock:
+                order = list(healthy)
+                self._rng.shuffle(order)
+            self._count("random_routed")
+            return order
+        by_load = sorted(healthy, key=lambda h: (h.load(), h.name))
+        if key is None:
+            self._count("least_loaded_routed")
+            return by_load
+        ranked = self._policy.rank(key, [h.name for h in healthy])
+        target = self._by_name[ranked[0]]
+        rest = [h for h in by_load if h is not target]
+        if target.saturated(self.spill_queue_depth):
+            self._count("affinity_spills")
+            return rest + [target]
+        self._count("affinity_routed")
+        return [target] + rest
+
+    def _submit_once(self, req: _FleetRequest,
+                     exclude: Optional[Set[str]] = None
+                     ) -> Tuple[ReplicaHandle, object]:
+        """Place ``req`` on the best available replica: walk the policy
+        order, skipping shedding replicas (their ``QueueFullError`` is
+        re-raised only if EVERY candidate shed) and marking replicas
+        whose submit fails replica-level as dead."""
+        now = time.monotonic()
+        remaining = req.remaining(now)
+        if remaining is not None and remaining <= 0:
+            raise RequestTimeoutError(
+                "request deadline elapsed before it could be placed "
+                "on a replica")
+        shed = None
+        for h in self._order_candidates(req.payload):
+            if exclude and h.name in exclude:
+                continue
+            try:
+                fut = h.engine.submit(req.payload, req.max_new_tokens,
+                                      timeout=req.remaining(),
+                                      eos_id=req.eos_id)
+            except QueueFullError as e:
+                self._count("sheds")
+                shed = e
+                continue
+            except (EngineCrashedError, EngineStoppedError) as e:
+                if isinstance(e, EngineCrashedError) and \
+                        h.mark_dead(str(e)):
+                    self._count("replica_deaths")
+                continue
+            except InvalidRequestError:
+                raise              # the request's own fault — no failover
+            h.routed += 1
+            self._count("routed")
+            return h, fut
+        if shed is not None:
+            raise shed             # healthy replicas exist, all saturated
+        self._count("no_healthy")
+        raise NoHealthyReplicaError(
+            f"fleet {self.name!r}: no healthy replica accepted the "
+            "request")
+
+    def _failover(self, req: _FleetRequest,
+                  cause: BaseException) -> Tuple[ReplicaHandle, object]:
+        """A replica failed the request mid-flight: resubmit elsewhere
+        — within the ORIGINAL deadline and the bounded failover budget
+        (neither is ever reset by a failover, so the fleet can never
+        double-count a request's time or retries)."""
+        if req.remaining() is not None and req.remaining() <= 0:
+            raise RequestTimeoutError(
+                "request deadline elapsed during replica failover") \
+                from cause
+        if req.failovers_left <= 0:
+            self._count("failover_exhausted")
+            raise cause
+        try:
+            _inject("fleet.failover")
+        except BaseException:
+            self._count("failover_faults")
+            raise cause
+        req.failovers_left -= 1
+        self._count("failovers")
+        try:
+            return self._submit_once(req)
+        except ServingError as e:
+            raise e from cause
+
+    # ------------------------------------------------------------- submit
+    def submit(self, x, max_new_tokens: Optional[int] = None,
+               timeout: Optional[float] = None,
+               eos_id: Optional[int] = None) -> FleetFuture:
+        """Enqueue one request on the fleet; same contract as
+        ``InferenceEngine.submit`` with replica placement on top.
+        ``timeout`` is the request's fleet-wide server deadline —
+        failover resubmissions inherit the REMAINING time, never a
+        fresh window."""
+        if self._stopping:
+            raise EngineStoppedError("fleet router is stopped")
+        if self.mode == "decode":
+            payload = onp.asarray(getattr(x, "asnumpy", lambda: x)(),
+                                  dtype="int32")
+            if payload.ndim == 2 and payload.shape[0] == 1:
+                payload = payload[0]
+        else:
+            payload = onp.asarray(getattr(x, "asnumpy", lambda: x)())
+        deadline = time.monotonic() + timeout if timeout else None
+        req = _FleetRequest(payload, self.mode, max_new_tokens, eos_id,
+                            deadline, self.max_failovers)
+        handle, inner = self._submit_once(req)
+        return FleetFuture(self, req, handle, inner)
+
+    def infer(self, x, max_new_tokens: Optional[int] = None,
+              timeout: Optional[float] = None,
+              eos_id: Optional[int] = None):
+        """Synchronous ``submit()`` + wait (unbounded client wait — the
+        fleet resolves every future with a result or a typed error,
+        same as the engine)."""
+        if self._monitor is None:
+            raise ServingError("router not started — call start() or use "
+                               "the context manager")
+        return self.submit(x, max_new_tokens, timeout, eos_id).result(None)
+
+    # -------------------------------------------------------------- stats
+    def _count(self, key: str, n: int = 1):
+        with self._counters_lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def health(self) -> dict:
+        reps = {}
+        for h in self._handles:
+            try:
+                eh = h.engine.health()
+            except Exception as e:
+                eh = {"live": False, "error": repr(e)}
+            reps[h.name] = {"state": h.state, "deaths": h.total_deaths,
+                            "restarts": h.restarts, "engine": eh}
+        healthy = len(self._healthy())
+        return {"name": self.name, "ready": healthy > 0
+                and not self._stopping,
+                "healthy": healthy, "replicas": reps}
+
+    def stats(self) -> dict:
+        """Fleet-wide snapshot: router counters, per-replica engine
+        stats (CURRENT engines — a rebuilt replica starts fresh), and
+        the aggregates a fleet dashboard fronts with (total throughput,
+        fleet prefix hit rate)."""
+        with self._counters_lock:
+            router = dict(self._counters)
+        replicas, agg = {}, {"submitted": 0, "completed": 0,
+                             "tokens_generated": 0, "prefix_hits": 0,
+                             "prefix_misses": 0, "prefix_tokens_saved": 0}
+        for h in self._handles:
+            try:
+                s = h.engine.stats()
+            except Exception as e:
+                replicas[h.name] = {"state": h.state, "error": repr(e)}
+                continue
+            replicas[h.name] = {"state": h.state, "deaths": h.total_deaths,
+                                "restarts": h.restarts, "routed": h.routed,
+                                "stats": s}
+            agg["submitted"] += s["requests"]["submitted"]
+            agg["completed"] += s["requests"]["completed"]
+            agg["tokens_generated"] += s["tokens"]["tokens_generated"]
+            for k in ("prefix_hits", "prefix_misses",
+                      "prefix_tokens_saved"):
+                agg[k] += s["prefix_cache"][k]
+        looked = agg["prefix_hits"] + agg["prefix_misses"]
+        agg["prefix_hit_rate"] = round(agg["prefix_hits"] / looked, 4) \
+            if looked else None
+        return {
+            "fleet": {"name": self.name, "routing": self.routing,
+                      "replicas": len(self._handles),
+                      "healthy": len(self._healthy()),
+                      "spill_queue_depth": self.spill_queue_depth,
+                      "max_failovers": self.max_failovers,
+                      "tracked_prefixes": len(self._policy)},
+            "router": router,
+            "aggregate": agg,
+            "replicas": replicas,
+        }
+
+    # ----------------------------------------------------------- registry
+    def _register_collector(self):
+        """Publish fleet-level series into the process-wide registry
+        (docs/observability.md) next to the replicas' own per-engine
+        series.  Weakref-bound: a collected router prunes itself from
+        the next scrape."""
+        from ..observability.registry import default_registry
+        ref = weakref.ref(self)
+
+        def _samples():
+            r = ref()
+            if r is None:
+                raise ReferenceError("FleetRouter collected")
+            return r.registry_samples()
+
+        default_registry().register_collector(f"fleet:{self.name}",
+                                              _samples)
+
+    def registry_samples(self) -> List[dict]:
+        lbl = {"fleet": self.name}
+        with self._counters_lock:
+            counters = dict(self._counters)
+        samples = [
+            {"name": f"mxtpu_fleet_{k}_total", "kind": "counter",
+             "labels": dict(lbl), "value": v, "help": ""}
+            for k, v in sorted(counters.items())]
+        healthy = 0
+        hits = misses = 0
+        for h in self._handles:
+            up = 1 if h.routable() else 0
+            healthy += up
+            rlbl = {"fleet": self.name, "replica": h.name}
+            samples.append({"name": "mxtpu_fleet_replica_up",
+                            "kind": "gauge", "labels": dict(rlbl),
+                            "value": up, "help": ""})
+            samples.append({"name": "mxtpu_fleet_replica_routed_total",
+                            "kind": "counter", "labels": dict(rlbl),
+                            "value": h.routed, "help": ""})
+            samples.append({"name": "mxtpu_fleet_replica_restarts_total",
+                            "kind": "counter", "labels": dict(rlbl),
+                            "value": h.restarts, "help": ""})
+            try:
+                c = h.engine.metrics.counters
+                hits += c["prefix_hits"]
+                misses += c["prefix_misses"]
+            except Exception:
+                pass
+        samples.append({"name": "mxtpu_fleet_replicas_healthy",
+                        "kind": "gauge", "labels": dict(lbl),
+                        "value": healthy, "help": ""})
+        looked = hits + misses
+        if looked:
+            samples.append({"name": "mxtpu_fleet_prefix_hit_rate",
+                            "kind": "gauge", "labels": dict(lbl),
+                            "value": round(hits / looked, 4), "help": ""})
+        return samples
+
+    def __repr__(self):
+        return (f"FleetRouter({self.name!r}, routing={self.routing}, "
+                f"replicas={len(self._handles)}, "
+                f"healthy={len(self._healthy())})")

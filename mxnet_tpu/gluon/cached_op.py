@@ -19,6 +19,7 @@ executor behind ``HybridBlock.hybridize()``.  TPU-first realization:
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Tuple
 
 import jax
@@ -32,6 +33,25 @@ from ..ndarray.ndarray import swap_values
 
 
 _WARNED_FOREIGN_TRACE = False
+
+#: Serializes every window in which a trace SWAPS tracer values into
+#: shared parameter payloads (``swap_values`` below) against every
+#: reader that snapshots those payloads (``param_snapshot``).  Needed
+#: the moment two engines share one block across threads — the fleet's
+#: rebuild-and-rewarm path traces a fresh engine's functions while
+#: sibling replicas keep serving, and without this lock a sibling's
+#: ``p._data`` read lands inside the swap window and captures a
+#: DynamicJaxprTracer (UnexpectedTracerError at its next dispatch).
+#: RLock: a trace that re-enters (nested pure fns) must not self-deadlock.
+_PARAM_SWAP_LOCK = threading.RLock()
+
+
+def param_snapshot(items):
+    """Read the live jax payloads of ``items`` (Parameter objects)
+    atomically w.r.t. any in-flight trace's parameter swap — the
+    reader-side half of ``_PARAM_SWAP_LOCK``."""
+    with _PARAM_SWAP_LOCK:
+        return tuple(p._data.jax for p in items)
 
 
 def collect_block_params(block):
@@ -63,14 +83,20 @@ def make_pure_fn(block, fn):
             "parameters — call block.initialize() first")
 
     def pure(param_vals, *args):
-        live = [p._data for p in items]
-        with swap_values(live, param_vals):
-            with _base.training_mode(False):
-                rec = _base.set_recording(False)
-                try:
-                    return fn(*args)
-                finally:
-                    _base.set_recording(rec)
+        # `pure` runs at TRACE time only (jit executes the compiled
+        # binary on cache hits), so holding the swap lock here costs one
+        # uncontended acquire per compile — and makes the tracer-valued
+        # payload swap invisible to every concurrent param_snapshot
+        # reader (two engines sharing one net, e.g. fleet replicas)
+        with _PARAM_SWAP_LOCK:
+            live = [p._data for p in items]
+            with swap_values(live, param_vals):
+                with _base.training_mode(False):
+                    rec = _base.set_recording(False)
+                    try:
+                        return fn(*args)
+                    finally:
+                        _base.set_recording(rec)
 
     return items, pure
 

@@ -20,10 +20,13 @@ fleet scraper needs ONE snapshot with stable names, so:
   weak reference where the producer supports it, so a test that builds
   fifty engines does not leak fifty collectors.
 - Re-registering the same ``(name, labels)`` **replaces** the previous
-  registration (last writer wins).  This is deliberate: engines in
-  tests reuse the default ``name="serving"``, and a process that
+  registration (last writer wins).  This is deliberate: a process that
   rebuilds an engine after a crash must not export the corpse's gauges.
-  Give engines unique names when you want them side by side.
+  LIVE engines never collide here — ``InferenceEngine`` uniquifies its
+  claimed name against every other live engine ("serving",
+  "serving-2", …), so fleet replicas scrape side by side in one
+  ``collect()`` and replacement only ever applies to a name whose
+  previous owner was garbage-collected.
 
 ``collect()`` returns a plain snapshot dict (``schema_version`` +
 ``samples``) that :mod:`.export` renders as Prometheus text or JSON

@@ -5,7 +5,12 @@ sites* on its hot paths (``"serving.decode_step"``, ``"serving.prefill"``,
 ``"serving.prefix_lookup"`` / ``"serving.prefix_copy"`` (the prefix
 cache's host radix-tree ops and device row copies — the engine degrades
 those to a cache miss and disables the cache on repeated faults),
-``"trainer.step"``, ``"checkpoint.save"``, ``"kvstore.push"``, …).  With
+``"trainer.step"``, ``"checkpoint.save"``, ``"kvstore.push"``, the
+fleet router's ``"fleet.route"`` / ``"fleet.failover"`` /
+``"fleet.drain"`` (:mod:`mxnet_tpu.fleet` — route faults degrade to
+least-loaded placement, failover faults abort that failover attempt,
+and a delay at ``fleet.drain`` models a replica hanging in drain, which
+fleet shutdown must condemn rather than wait out), …).  With
 no plan active that
 call is one module-global load plus a ``None`` check — provably in the
 noise of any step that launches an XLA program.  Inside a

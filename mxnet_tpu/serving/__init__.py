@@ -24,8 +24,8 @@ from .batcher import BucketLattice, DynamicBatcher
 from .engine import InferenceEngine, InferenceFuture, Request
 from .errors import (DeadlineExceededError, EngineCrashedError,
                      EngineStoppedError, InvalidRequestError,
-                     NonFiniteOutputError, QueueFullError,
-                     RequestTimeoutError, ServingError)
+                     NoHealthyReplicaError, NonFiniteOutputError,
+                     QueueFullError, RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixEntry
@@ -39,4 +39,5 @@ __all__ = [
     "ServingError", "QueueFullError", "RequestTimeoutError",
     "DeadlineExceededError", "EngineStoppedError", "EngineCrashedError",
     "InvalidRequestError", "NonFiniteOutputError",
+    "NoHealthyReplicaError",
 ]

@@ -80,6 +80,38 @@ from .prefix_cache import PrefixCache
 
 __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
 
+# Live engines by metrics name.  An engine's name is its IDENTITY in the
+# process-wide observability registry (the ``engine=`` label on every
+# mxtpu_serving_* series and the ``serving:<name>`` collector key), so
+# two LIVE engines must never share one — same-name re-registration
+# replaces, which is right for the rebuilt-after-crash case but silently
+# drops one replica's series in a fleet.  Weak values: a collected
+# engine releases its name, so sequential same-name engines (tests, the
+# rebuilt-engine case) keep the plain name.
+_LIVE_NAMES = weakref.WeakValueDictionary()
+_NAME_LOCK = threading.Lock()
+
+
+def _claim_engine_name(base: str, engine: "InferenceEngine") -> str:
+    with _NAME_LOCK:
+        name, i = base, 1
+        while _LIVE_NAMES.get(name) is not None:
+            i += 1
+            name = f"{base}-{i}"
+        _LIVE_NAMES[name] = engine
+        return name
+
+
+def _release_engine_name(engine: "InferenceEngine") -> None:
+    """A fully stopped or condemned engine is a corpse for naming
+    purposes: release its claim immediately (don't wait for GC) so a
+    replacement under the same base — the fleet's rebuild-after-crash
+    path — reclaims the PLAIN name and its metric series keep their
+    labels across restarts."""
+    with _NAME_LOCK:
+        if _LIVE_NAMES.get(engine.name) is engine:
+            del _LIVE_NAMES[engine.name]
+
 
 class InferenceFuture:
     """Write-once result holder; safe across threads.  ``trace_id`` is
@@ -207,6 +239,12 @@ class InferenceEngine:
         sync; forward: a host-side check of the already-fetched rows).
         The engine keeps serving — one poisoned request never condemns
         the batch or trips the watchdog.
+    name : base name for this engine's metrics identity.  The claimed
+        name (``self.name``) is uniquified against every other live
+        engine (``serving``, ``serving-2``, …) so fleet replicas export
+        distinct ``engine=`` label sets in one registry ``collect()``;
+        a garbage-collected engine releases its name, so the
+        rebuilt-after-crash case still reclaims the plain one.
     """
 
     def __init__(self, net, mode: Optional[str] = None, *,
@@ -244,7 +282,13 @@ class InferenceEngine:
         self.default_timeout = default_timeout
         self.eos_id = eos_id
         self.default_max_new_tokens = int(default_max_new_tokens)
-        self.metrics = ServingMetrics(name)
+        # `name` is a BASE: the claimed identity is uniquified against
+        # every other LIVE engine ("serving", "serving-2", …) so two
+        # replicas can never collide in the metrics registry — a fleet
+        # of engines scrapes as distinct engine= label sets in one
+        # collect().  A dead (collected) engine releases its name.
+        self.name = _claim_engine_name(str(name), self)
+        self.metrics = ServingMetrics(self.name)
 
         if mode == "decode":
             self.max_length = int(max_length or net.max_length)
@@ -468,7 +512,11 @@ class InferenceEngine:
             self._jit_forward = jax.jit(pure_forward)
 
     def _params(self):
-        return tuple(p._data.jax for p in self._items)
+        # atomic w.r.t. any OTHER engine tracing over the same shared
+        # net (fleet rebuild-and-rewarm): a mid-trace read here would
+        # capture that trace's swapped-in tracers as "parameters"
+        from ..gluon.cached_op import param_snapshot
+        return param_snapshot(self._items)
 
     def _counted(self, key, fn, *args):
         """Run a compiled entry, tracking engine-level bucket hits vs
@@ -604,6 +652,10 @@ class InferenceEngine:
                 exp.stop(flush=True)
             except Exception:
                 pass
+        # fully stopped: release the name claim so a successor under
+        # the same base reclaims it (the mid-drain timeout path raised
+        # above and keeps the claim — that engine is still live)
+        _release_engine_name(self)
 
     # ------------------------------------------------------------- watchdog
     def _watchdog_check(self) -> Optional[str]:
@@ -670,6 +722,20 @@ class InferenceEngine:
             self._fail(req, exc)
         for req in self._snapshot_inflight_requests():
             self._fail(req, exc)
+        # a condemned engine can never serve again: release its name so
+        # the rebuilt replacement reclaims the plain one
+        _release_engine_name(self)
+
+    def condemn(self, reason: str):
+        """Externally condemn the engine — the fleet router's force-stop
+        path for a replica whose drain blew its deadline.  Same effect
+        as a watchdog trip: every queued and in-flight request fails
+        with :class:`EngineCrashedError` (write-once futures, so a
+        still-running scheduler completing a request later is a no-op),
+        the engine is closed to new work and cannot be restarted.  Safe
+        from any thread; never blocks on the (possibly hung)
+        scheduler."""
+        self._watchdog_trip(f"condemned: {reason}")
 
     # ---------------------------------------------------------------- health
     def health(self) -> dict:
@@ -686,6 +752,7 @@ class InferenceEngine:
             round(time.monotonic() - self._heartbeat, 4)
         c = self.metrics.counters
         return {
+            "name": self.name,
             "live": live,
             "ready": live and not self._stopping
             and not self._batcher.closed,
@@ -891,6 +958,7 @@ class InferenceEngine:
     def stats(self) -> dict:
         s = self.metrics.stats()
         s["engine"] = {
+            "name": self.name,
             "mode": self.mode,
             "queued": len(self._batcher),
             "active_slots": self._alloc.active_count if self._alloc else 0,

@@ -17,7 +17,7 @@ from ..base import MXNetError
 __all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
            "DeadlineExceededError", "EngineStoppedError",
            "EngineCrashedError", "InvalidRequestError",
-           "NonFiniteOutputError"]
+           "NonFiniteOutputError", "NoHealthyReplicaError"]
 
 
 class ServingError(MXNetError):
@@ -56,6 +56,15 @@ class InvalidRequestError(ServingError):
     """The request can never be served by this engine configuration
     (e.g. prompt longer than the largest sequence bucket, or
     prompt + max_new_tokens exceeding the KV cache length)."""
+
+
+class NoHealthyReplicaError(ServingError):
+    """The fleet router (:mod:`mxnet_tpu.fleet`) has no replica it can
+    route to: every :class:`ReplicaHandle` is dead, draining, or sitting
+    out a probation window.  Distinct from :class:`QueueFullError` —
+    which the router raises when healthy replicas exist but ALL of them
+    shed the request — so callers can tell "scale up / wait out
+    probation" apart from "back off, the fleet is saturated"."""
 
 
 class NonFiniteOutputError(ServingError):

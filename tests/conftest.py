@@ -43,3 +43,7 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection / preemption chaos tests (deterministic "
         "and CPU-fast; select with -m chaos)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: multi-replica router performance contracts "
+        "(timing-sensitive, also marked slow; select with -m fleet)")

@@ -182,6 +182,33 @@ def test_serving_metrics_register_into_default_registry():
     assert flat['mxtpu_serving_submitted_total{engine="reg_unit"}'] == 1
 
 
+def test_two_live_engines_never_collide_in_one_collect(net):
+    """Fleet regression: two LIVE engines — even constructed from the
+    same base name — claim distinct identities, so neither's weakref
+    collector nor gauges overwrite the other's ``mxtpu_*`` series: one
+    ``collect()`` scrapes BOTH engines' full series side by side.
+    (Same-name replacement remains the behavior for sequential
+    engines: a collected corpse releases its name.)"""
+    a = _engine(net, name="replica_pair")
+    b = _engine(net, name="replica_pair")
+    assert a.name == "replica_pair" and b.name == "replica_pair-2"
+    a.metrics.count("submitted", 3)
+    b.metrics.count("submitted", 5)
+    snap = default_registry().collect()
+    by_engine = {}
+    for s in snap["samples"]:
+        if s["name"] == "mxtpu_serving_submitted_total" and \
+                s["labels"].get("engine", "").startswith("replica_pair"):
+            by_engine[s["labels"]["engine"]] = s["value"]
+    assert by_engine == {"replica_pair": 3, "replica_pair-2": 5}
+    gauge_owners = {s["labels"]["engine"]
+                    for s in snap["samples"]
+                    if s["name"] == "mxtpu_serving_queue_depth"
+                    and s["labels"].get("engine", "")
+                    .startswith("replica_pair")}
+    assert gauge_owners == {"replica_pair", "replica_pair-2"}
+
+
 def test_one_collect_covers_serving_resilience_guardrails_io(net):
     """The tentpole acceptance: serving counters, resilience/guardrail
     counters and the io quarantine counter all land in ONE default-

@@ -160,7 +160,150 @@ def serving_scenarios(net):
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
         ("prefix_storm", lambda: serving_prefix_storm(net)),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
+        ("replica_kill", lambda: fleet_replica_kill(net)),
+        ("rolling_restart", lambda: fleet_rolling_restart(net)),
     ]
+
+
+# --------------------------------------------------------- fleet scenarios
+
+def _fleet(net, n=3, **kw):
+    from mxnet_tpu.fleet import FleetRouter
+
+    def factory(name):
+        return _engine(net, name=name, prefix_pool_rows=2,
+                       prefix_min_tokens=2)
+
+    kw.setdefault("health_interval", 0.03)
+    kw.setdefault("probation", 0.3)
+    return FleetRouter(factory=factory, num_replicas=n, **kw)
+
+
+def fleet_replica_kill(net):
+    """Fleet chaos (docs/fleet.md): one of three replicas CRASHES
+    mid-traffic (injected scheduler fault).  Invariants: ZERO lost
+    requests — every in-flight/queued request on the corpse fails over
+    to a healthy replica within its budget and completes token-correct
+    — the death is probation-gated, the monitor re-admits a REBUILT
+    replica after the window, and a post-recovery wave of shared-prefix
+    traffic hits the prefix cache again (the hit rate recovers)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import FaultPlan
+
+    rs = onp.random.RandomState(3)
+    shared = rs.randint(0, 61, (10,)).astype("int32")
+    prompts = [onp.concatenate([shared,
+                                rs.randint(0, 61, (3,)).astype("int32")])
+               for _ in range(10)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    fleet = _fleet(net, n=3, name="chaos_kill")
+    fleet.warmup()
+    plan = FaultPlan().raise_at("serving.scheduler", at=5)
+    lost = mismatched = 0
+    recovered = False
+    hit_rate_after = None
+    with plan:
+        with fleet:
+            futs = [fleet.submit(p, max_new_tokens=3) for p in prompts]
+            for ref, f in zip(refs, futs):
+                try:
+                    out = f.result(timeout=60)
+                    if not onp.array_equal(out, ref):
+                        mismatched += 1
+                except Exception:
+                    lost += 1
+            deaths = fleet.stats()["router"].get("replica_deaths", 0)
+            # wait out probation: the monitor rebuilds the corpse
+            deadline = time.monotonic() + 20
+            while len(fleet._healthy()) < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            recovered = len(fleet._healthy()) == 3
+            # post-recovery wave: shared-prefix traffic must hit again
+            for ref, p in zip(refs, prompts):
+                try:
+                    out = fleet.infer(p, max_new_tokens=3)
+                    if not onp.array_equal(out, ref):
+                        mismatched += 1
+                except Exception:
+                    lost += 1
+            s = fleet.stats()
+            hit_rate_after = s["aggregate"]["prefix_hit_rate"]
+    _join_zombies()
+    passed = (lost == 0 and mismatched == 0 and deaths >= 1 and recovered
+              and (hit_rate_after or 0) > 0
+              and plan.fired("serving.scheduler") == 1)
+    return {
+        "name": "fleet/replica_kill",
+        "passed": bool(passed),
+        "detail": {"requests": 2 * len(prompts), "lost": lost,
+                   "mismatched": mismatched, "replica_deaths": deaths,
+                   "readmitted": recovered,
+                   "prefix_hit_rate_after": hit_rate_after,
+                   "router": fleet.stats()["router"],
+                   "faults_fired": plan.fired()},
+    }
+
+
+def fleet_rolling_restart(net):
+    """Fleet chaos: drain + rebuild every replica in sequence while a
+    background submitter keeps traffic flowing.  Invariants: NO request
+    errors (traffic steers around the draining replica; queued requests
+    on it finish before it stops), every output token-correct, and all
+    replicas end healthy having restarted exactly once."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    rs = onp.random.RandomState(4)
+    shared = rs.randint(0, 61, (10,)).astype("int32")
+    prompts = [onp.concatenate([shared,
+                                rs.randint(0, 61, (3,)).astype("int32")])
+               for _ in range(24)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    fleet = _fleet(net, n=3, name="chaos_roll")
+    fleet.warmup()
+    errors = mismatched = 0
+    done = threading.Event()
+    results = []
+
+    def submitter():
+        for ref, p in zip(refs, prompts):
+            try:
+                out = fleet.infer(p, max_new_tokens=3)
+                results.append(bool(onp.array_equal(out, ref)))
+            except Exception:
+                results.append(None)
+            time.sleep(0.02)
+        done.set()
+
+    with fleet:
+        t = threading.Thread(target=submitter, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        fleet.rolling_restart(timeout=60)
+        done.wait(timeout=120)
+        t.join(10)
+        s = fleet.stats()
+    _join_zombies()
+    errors = sum(1 for r in results if r is None)
+    mismatched = sum(1 for r in results if r is False)
+    restarts = {n_: rep["restarts"] for n_, rep in s["replicas"].items()}
+    passed = (errors == 0 and mismatched == 0
+              and len(results) == len(prompts)
+              and all(v == 1 for v in restarts.values())
+              and s["fleet"]["healthy"] == 3)
+    return {
+        "name": "fleet/rolling_restart",
+        "passed": bool(passed),
+        "detail": {"requests": len(results), "errors": errors,
+                   "mismatched": mismatched, "restarts": restarts,
+                   "healthy": s["fleet"]["healthy"],
+                   "router": s["router"]},
+    }
 
 
 def serving_exporter_storm(net):
