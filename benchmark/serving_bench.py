@@ -38,6 +38,22 @@ number affinity routing exists to win), with fleet/per-replica prefix
 hit rates and the fleet-aggregated ``mxtpu_fleet_*`` registry snapshot
 embedded in the affinity record.
 
+``--workload overload`` runs the mixed-priority sustained-overload
+comparison (docs/overload.md): the same ~3x-capacity storm of
+``interactive``/``batch``/``best_effort`` requests with per-class
+deadlines is pushed through a BLIND engine (no priorities, no deadline
+admission, no brownout, no preemption — the bounded queue sheds
+whatever arrives when full) and through the overload-controlled
+engine.  It emits ``serving_overload_interactive_hit_blind`` (the
+baseline) and ``serving_overload_interactive_hit_controlled``
+(``vs_baseline`` is the interactive deadline-hit-rate ratio — the
+number overload control exists to win; ``best_effort`` absorbing the
+damage is the design, not a regression), where goodput counts ONLY
+tokens of requests that completed within their deadline; each record
+carries per-class goodput and deadline-hit-rate, and the controlled
+record adds the shed breakdown by reason/class, preemption and
+brownout counts.
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -330,12 +346,191 @@ def bench_fleet(n_replicas: int = 3, groups: int = 3, per_group: int = 16,
              fleet_registry=last_aff["registry"]))
 
 
+def _build_overload_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        seq_buckets = (64, 128, 256)
+        prompt_lens = (64, 96, 128)
+    else:   # CPU sanity: the comparison is about SCHEDULING policy
+        # (which requests complete inside their deadline), not raw
+        # compute, so a small model keeps the storm short while the
+        # queue dynamics stay identical
+        cfg = dict(vocab_size=256, units=64, num_layers=2, num_heads=4,
+                   max_length=64, dropout=0.0)
+        seq_buckets = (8, 16)
+        prompt_lens = (5, 6, 7)
+    net = get_gpt2("gpt2_124m", **cfg)
+    net.initialize()
+    return net, prompt_lens, seq_buckets
+
+
+def bench_overload(n_waves: int = 20, trials: int = 3):
+    """Mixed-priority sustained overload, controlled vs blind shedding.
+
+    A calibration pass measures the engine's service rate T (req/s at
+    full concurrency), then each trial drives one fresh engine with
+    ``n_waves`` waves of three requests (one per class, tight/medium/
+    loose deadlines expressed in units of 1/T) arriving every 1/T
+    seconds — a sustained 3x-capacity storm, identical for both arms.
+    A request scores iff its future RESOLVED within its deadline — the
+    engine stamps ``InferenceFuture.t_done`` at resolution, so requests
+    that completed mid-storm are scored at their true completion
+    instant, not when the collection loop reaches them; goodput is
+    scored generated tokens / storm wall time."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    net, prompt_lens, seq_buckets = _build_overload_net(on_tpu)
+    rs = onp.random.RandomState(5)
+
+    def mk():
+        ln = prompt_lens[rs.randint(len(prompt_lens))]
+        return rs.randint(0, net.vocab_size, (ln,)).astype("int32")
+
+    def build(controlled, tag, queue_depth=6):
+        return InferenceEngine(
+            net, num_slots=2, max_batch=2, seq_buckets=seq_buckets,
+            queue_depth=queue_depth, default_max_new_tokens=6,
+            prefix_pool_rows=4 if controlled else 0, prefix_min_tokens=4,
+            preemption=controlled, deadline_admission=controlled,
+            brownout=controlled, name=tag)
+
+    # ---- calibration: service rate with every control off (deep queue
+    # so the whole calibration batch is admitted at once) ---------------
+    cal = build(False, "serving_overload_cal", queue_depth=32)
+    cal.warmup()
+    with cal:
+        futs = [cal.submit(mk(), max_new_tokens=6) for _ in range(12)]
+        t0 = time.perf_counter()
+        for f in futs:
+            f.result(timeout=600)
+        rate = 12 / (time.perf_counter() - t0)
+    period = 1.0 / rate                      # one wave per service slot
+    # (class, tokens, deadline in service periods): interactive must
+    # finish inside the backlog a blind FIFO accumulates by mid-storm
+    wave = (("best_effort", 6, 20.0), ("batch", 6, 10.0),
+            ("interactive", 2, 4.0))
+
+    def one_trial(controlled, tag):
+        eng = build(controlled, tag)
+        eng.warmup()
+        done = []                            # (cls, tokens, ok)
+        with eng:
+            for _ in range(8):               # pre-storm steady state:
+                eng.infer(mk(), max_new_tokens=6)   # latency history
+            t_start = time.monotonic()
+            pending = []
+            for w in range(n_waves):
+                for cls, toks, dl in wave:
+                    timeout = dl * period
+                    p = mk()
+                    t_sub = time.monotonic()
+                    try:
+                        f = eng.submit(
+                            p, max_new_tokens=toks, timeout=timeout,
+                            priority=cls if controlled else None)
+                        pending.append((cls, len(p), f, t_sub, timeout))
+                    except Exception:
+                        done.append((cls, 0, False))     # shed = miss
+                wait = t_start + (w + 1) * period - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
+            for cls, plen, f, t_sub, timeout in pending:
+                try:
+                    out = f.result(timeout=600)
+                    ok = f.t_done - t_sub <= timeout
+                    done.append((cls, max(0, len(out) - plen), ok))
+                except Exception:
+                    done.append((cls, 0, False))
+            wall = time.monotonic() - t_start
+            s = eng.stats()
+        per_class = {}
+        for cls, _toks, _dl in wave:
+            rows = [d for d in done if d[0] == cls]
+            served_tokens = sum(t for _c, t, ok in rows if ok)
+            per_class[cls] = {
+                "goodput_tokens_per_s": round(served_tokens / wall, 2),
+                "deadline_hit_rate": round(
+                    sum(1 for _c, _t, ok in rows if ok) / len(rows), 4)}
+        goodput = sum(t for _c, t, ok in done if ok) / wall
+        return goodput, per_class, s
+
+    def _sum_counts(acc, cur):
+        """Sum one trial's (possibly nested) overload counters into the
+        all-trials totals — the hit-rate medians upstream span every
+        trial, so the shed/served breakdown in the same record must
+        too, not describe whichever trial happened to run last."""
+        out = dict(acc or {})
+        for k, v in cur.items():
+            if k == "controller":
+                continue            # live state, not a counter
+            if isinstance(v, dict):
+                out[k] = _sum_counts(out.get(k), v)
+            else:
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def run_arm(controlled, tag):
+        goodputs, trials_pc, stats = [], [], None
+        for t in range(max(1, trials)):
+            g, pc, s = one_trial(controlled, f"{tag}_t{t}")
+            goodputs.append(g)
+            trials_pc.append(pc)
+            stats = dict(s, overload=_sum_counts(
+                (stats or {}).get("overload"), s["overload"]))
+        per_class = {
+            cls: {k: round(statistics.median(
+                pc[cls][k] for pc in trials_pc), 4)
+                for k in ("goodput_tokens_per_s", "deadline_hit_rate")}
+            for cls, _t, _d in wave}
+        ia_hits = [100.0 * pc["interactive"]["deadline_hit_rate"]
+                   for pc in trials_pc]
+        return ia_hits, per_class, goodputs, stats
+
+    blind_hits, blind_pc, blind_gp, _ = run_arm(
+        False, "serving_overload_blind")
+    ctrl_hits, ctrl_pc, ctrl_gp, ctrl_stats = run_arm(
+        True, "serving_overload_ctrl")
+
+    base = {"n_waves": n_waves, "overload_factor": 3,
+            "service_rate_req_per_s": round(rate, 2),
+            "deadlines_in_service_periods": {
+                cls: dl for cls, _t, dl in wave}}
+    blind_med = statistics.median(blind_hits)
+    ratio = round(statistics.median(ctrl_hits) / blind_med, 4) \
+        if blind_med else None      # blind served zero interactive
+    ov = ctrl_stats["overload"]
+    yield _record(
+        "serving_overload_interactive_hit_blind", blind_hits,
+        "% deadlines met", None,
+        dict(base, per_class=blind_pc,
+             goodput_total_tokens_per_s=round(
+                 statistics.median(blind_gp), 1)))
+    yield _record(
+        "serving_overload_interactive_hit_controlled", ctrl_hits,
+        "% deadlines met", ratio,
+        dict(base, per_class=ctrl_pc,
+             goodput_total_tokens_per_s=round(
+                 statistics.median(ctrl_gp), 1),
+             sheds=ov["sheds"], served=ov["served"],
+             rejected_infeasible=ov["rejected_infeasible"],
+             preemptions=ov["preemptions"],
+             preempt_resumes=ov["preempt_resumes"],
+             brownouts=ov["brownouts"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trials", type=int, default=3)
-    ap.add_argument("--workload", choices=("decode", "prefix", "fleet"),
+    ap.add_argument("--workload",
+                    choices=("decode", "prefix", "fleet", "overload"),
                     default="decode")
     args = ap.parse_args()
 
@@ -349,6 +544,8 @@ def main():
         recs = bench_prefix_cache(trials=args.trials)
     elif args.workload == "fleet":
         recs = bench_fleet(trials=args.trials)
+    elif args.workload == "overload":
+        recs = bench_overload(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

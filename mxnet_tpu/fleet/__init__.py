@@ -24,7 +24,8 @@ Quick start::
         outs = [f.result() for f in futs]
         print(fleet.stats()["aggregate"]["prefix_hit_rate"])
 """
-from ..serving.errors import NoHealthyReplicaError
+from ..serving.errors import FleetSaturatedError, NoHealthyReplicaError
+from ..serving.overload import CircuitBreaker, RetryBudget
 from .policy import RoutingPolicy, rendezvous_hash, rendezvous_rank
 from .replica import DEAD, DRAINING, HEALTHY, STOPPED, ReplicaHandle
 from .router import FleetFuture, FleetRouter
@@ -32,6 +33,7 @@ from .router import FleetFuture, FleetRouter
 __all__ = [
     "FleetRouter", "FleetFuture", "ReplicaHandle", "RoutingPolicy",
     "rendezvous_hash", "rendezvous_rank",
-    "NoHealthyReplicaError",
+    "NoHealthyReplicaError", "FleetSaturatedError",
+    "RetryBudget", "CircuitBreaker",
     "HEALTHY", "DEAD", "DRAINING", "STOPPED",
 ]

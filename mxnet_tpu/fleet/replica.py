@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..serving.overload import CircuitBreaker
+
 __all__ = ["ReplicaHandle", "HEALTHY", "DEAD", "DRAINING", "STOPPED"]
 
 HEALTHY = "healthy"
@@ -47,7 +49,8 @@ class ReplicaHandle:
                  probation: float = 0.25,
                  probation_backoff: float = 2.0,
                  probation_max: float = 30.0,
-                 restart_warmup: bool = True):
+                 restart_warmup: bool = True,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = name
         self.engine = engine
         self.factory = factory
@@ -55,6 +58,12 @@ class ReplicaHandle:
         self.probation_backoff = float(probation_backoff)
         self.probation_max = float(probation_max)
         self.restart_warmup = bool(restart_warmup)
+        # retry-storm protection (docs/overload.md): consecutive sheds
+        # / replica-level submit failures open the breaker and the
+        # router stops offering this replica traffic for a cooldown —
+        # the breaker OUTLIVES engine rebuilds (it gates the replica
+        # slot, not one engine incarnation)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.state = HEALTHY
         self.deaths = 0              # consecutive (resets on healthy probe)
         self.total_deaths = 0
@@ -180,6 +189,9 @@ class ReplicaHandle:
             self.state = HEALTHY
             self.restarts += 1
             self.probation_until = None
+        # a rebuilt replica starts with a CLOSED breaker: its fresh,
+        # empty queue owes nothing to the corpse's shed streak
+        self.breaker.record_success()
         if abort is not None and abort():
             # shutdown landed between the check above and the commit:
             # undo — the fleet's stop sweep may already have passed this

@@ -50,6 +50,7 @@ up/routed series, and the fleet-aggregated prefix hit rate, all under
 """
 from __future__ import annotations
 
+import collections
 import random as _pyrandom
 import signal as _signal
 import threading
@@ -60,10 +61,12 @@ from typing import Callable, List, Optional, Sequence, Set, Tuple
 import numpy as onp
 
 from ..resilience.faults import inject as _inject
-from ..serving.errors import (EngineCrashedError, EngineStoppedError,
+from ..serving.errors import (DeadlineInfeasibleError, EngineCrashedError,
+                              EngineStoppedError, FleetSaturatedError,
                               InvalidRequestError, NoHealthyReplicaError,
-                              QueueFullError, RequestTimeoutError,
-                              ServingError)
+                              QueueFullError, RequestCancelledError,
+                              RequestTimeoutError, ServingError)
+from ..serving.overload import CircuitBreaker, RetryBudget
 from .policy import RoutingPolicy
 from .replica import DEAD, DRAINING, HEALTHY, STOPPED, ReplicaHandle
 
@@ -75,16 +78,17 @@ class _FleetRequest:
     resubmit the request to another replica on failover."""
 
     __slots__ = ("payload", "kind", "max_new_tokens", "eos_id", "deadline",
-                 "failovers_left")
+                 "failovers_left", "priority")
 
     def __init__(self, payload, kind, max_new_tokens, eos_id, deadline,
-                 failovers):
+                 failovers, priority=None):
         self.payload = payload
         self.kind = kind
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.deadline = deadline          # absolute monotonic, never reset
         self.failovers_left = failovers   # never refreshed
+        self.priority = priority          # QoS class, carried on failover
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:
@@ -96,6 +100,8 @@ class _FleetRequest:
 class FleetFuture:
     """The router-side future: resolves like an engine future, but a
     replica-level failure (``EngineCrashedError`` / ``EngineStoppedError``)
+    — or a queued attempt priority-EVICTED by that replica
+    (``QueueFullError`` on the inner future, docs/overload.md) —
     triggers failover instead of surfacing — the caller only ever sees
     a result, a request-level typed error, or a fleet-level typed error
     once budget/deadline/replicas are exhausted.  ``trace_id`` follows
@@ -145,22 +151,39 @@ class FleetFuture:
                     val = primary_f.result(chunk)
                 except TimeoutError:
                     val, ready = None, []
-                except (EngineCrashedError, EngineStoppedError) as e:
+                except RequestCancelledError:
+                    # this attempt lost a hedge race and was reaped —
+                    # re-snapshot; the winner resolves next iteration
+                    continue
+                except (EngineCrashedError, EngineStoppedError,
+                        QueueFullError) as e:
+                    # QueueFullError on a QUEUED future = the attempt
+                    # was priority-EVICTED by a higher-class arrival on
+                    # that replica (docs/overload.md) — a replica-local
+                    # capacity decision, not the request's fault:
+                    # re-place it elsewhere within the same failover /
+                    # retry-budget / deadline bounds
                     self._drop_attempt(primary_h, primary_f, e)
                     continue
                 else:
                     self.trace_id = primary_f.trace_id
+                    self._reap_losers(primary_f)
                     return val
             for h, f in ready:
                 try:
                     val = f.result(0)
                 except TimeoutError:      # raced: no longer done — retry
                     continue
-                except (EngineCrashedError, EngineStoppedError) as e:
+                except RequestCancelledError:
+                    continue   # reaped hedge loser — the winner is
+                               # also in (or about to enter) ready
+                except (EngineCrashedError, EngineStoppedError,
+                        QueueFullError) as e:
                     self._drop_attempt(h, f, e)
                     break
                 else:
                     self.trace_id = f.trace_id
+                    self._reap_losers(f)
                     return val
             if ready:
                 continue
@@ -171,14 +194,40 @@ class FleetFuture:
                     "complete fleet-side)")
             self._maybe_hedge(now)
 
+    def _reap_losers(self, winner) -> None:
+        """Hedged-request cleanup (docs/overload.md): the first copy
+        to complete wins; every OTHER in-flight attempt is actively
+        cancelled — dequeued if still queued, its KV slot flagged
+        reclaimable if mid-decode — instead of running to completion
+        as pure waste.  Each attempt that was still live counts one
+        ``hedges_wasted``.  Losers leave ``_attempts`` BEFORE their
+        futures can resolve with ``RequestCancelledError``, so a repeat
+        ``result()`` call (or a concurrent waiter) only ever sees the
+        winner."""
+        with self._lock:
+            losers = [(h, f) for h, f in self._attempts if f is not winner]
+            self._attempts[:] = [(h, f) for h, f in self._attempts
+                                 if f is winner]
+        for h, f in losers:
+            try:
+                if h.engine.cancel(f):
+                    self._router._count("hedges_wasted")
+            except Exception:
+                pass               # cleanup is best-effort, never fatal
+
     def _drop_attempt(self, handle, fut, exc):
-        """One attempt died with a REPLICA-level error: if other
-        (hedged) attempts are still in flight, just forget this one;
-        otherwise fail over — the router resubmits within the request's
-        budget and deadline, or re-raises."""
+        """One attempt died with a REPLICA-level error (crash, stop,
+        or queue eviction): if other (hedged) attempts are still in
+        flight, just forget this one; otherwise fail over — the router
+        resubmits within the request's budget and deadline, or
+        re-raises."""
         if isinstance(exc, EngineCrashedError):
             if handle.mark_dead(str(exc)):
                 self._router._count("replica_deaths")
+        elif isinstance(exc, QueueFullError):
+            # the replica shed queued work under pressure — same
+            # breaker signal as a shed at submit
+            handle.breaker.record_failure()
         with self._lock:
             try:
                 self._attempts.remove((handle, fut))
@@ -187,6 +236,12 @@ class FleetFuture:
             alive = bool(self._attempts)
         if alive:
             return
+        if isinstance(exc, QueueFullError):
+            # counted only when the eviction actually triggers a
+            # failover attempt — a hedged sibling still in flight means
+            # the drop is just forgotten, and the counter must
+            # reconcile against `failovers` during incidents
+            self._router._count("eviction_failovers")
         try:
             nxt = self._router._failover(self._req, exc)
         except BaseException as e:
@@ -203,12 +258,22 @@ class FleetFuture:
         if now - self._t_submit < r.hedge_after:
             return
         self._hedged = True
+        # a hedge is fleet-added retry load: it must fit the retry
+        # budget or be skipped — hedging during an overload is exactly
+        # the thundering-herd amplifier the budget exists to cap
+        if not r._retry_budget.try_acquire(now=now):
+            r._count("retry_budget_exhausted")
+            return
         with self._lock:
             exclude = {h.name for h, _f in self._attempts}
         try:
             nxt = r._submit_once(self._req, exclude=exclude)
         except ServingError:
-            return                  # hedging is an optimization, never fatal
+            # hedging is an optimization, never fatal — and a hedge
+            # that placed NOTHING added no retry load, so its token
+            # goes back (shed probes are O(admission check), not work)
+            r._retry_budget.refund()
+            return
         r._count("hedges")
         with self._lock:
             self._attempts.append(nxt)
@@ -243,6 +308,24 @@ class FleetRouter:
         budget; never refreshed by a failover).
     hedge_after : seconds after which a still-unresolved request is
         duplicated onto a second healthy replica (None = no hedging).
+        The winning copy actively CANCELS the loser (dequeue, or slot
+        reclaim mid-decode) — counted as ``hedges_wasted``.
+    retry_budget_rate / retry_budget_burst : token bucket bounding
+        fleet-ADDED retry load (docs/overload.md): every failover
+        resubmission and every hedge spends a token; an empty bucket
+        surfaces the original failure typed (failover) or skips the
+        hedge, so a replica crash during saturation cannot amplify
+        into a thundering herd.
+    breaker_threshold / breaker_cooldown : per-replica circuit breaker
+        — that many consecutive sheds / replica-level submit failures
+        stop the router offering the replica traffic for the cooldown,
+        then half-open with a probe.
+    saturation_threshold / saturation_window / saturation_brownout :
+        coordinated brownout — that many all-replicas-shed submits
+        within the window force every replica's overload controller to
+        its brownout floor (``engine.force_brownout()``), and the
+        caller sees the typed :class:`FleetSaturatedError` (a
+        ``QueueFullError`` subclass) instead of an opaque shed.
     health_interval : monitor poll period in seconds.
     probation / probation_backoff / probation_max : re-admission window
         after a replica death: ``probation * backoff**(deaths-1)``
@@ -267,6 +350,13 @@ class FleetRouter:
                  spill_queue_depth: Optional[int] = None,
                  max_failovers: int = 2,
                  hedge_after: Optional[float] = None,
+                 retry_budget_rate: float = 2.0,
+                 retry_budget_burst: int = 8,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown: float = 0.5,
+                 saturation_threshold: int = 3,
+                 saturation_window: float = 1.0,
+                 saturation_brownout: bool = True,
                  health_interval: float = 0.05,
                  probation: float = 0.25,
                  probation_backoff: float = 2.0,
@@ -285,6 +375,19 @@ class FleetRouter:
         self.hedge_after = hedge_after
         self.health_interval = float(health_interval)
         self.drain_timeout = drain_timeout
+        # retry-storm protection (docs/overload.md)
+        self._retry_budget = RetryBudget(retry_budget_rate,
+                                         retry_budget_burst)
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown)
+        self.saturation_threshold = int(saturation_threshold)
+        self.saturation_window = float(saturation_window)
+        self.saturation_brownout = bool(saturation_brownout)
+        self._sat_lock = threading.Lock()
+        # last `saturation_threshold` all-replicas-shed event times
+        self._sat_times = collections.deque(
+            maxlen=max(1, self.saturation_threshold))
+        self._sat_brownout_at = -1e9
         self._policy = RoutingPolicy(affinity_min_tokens, affinity_window,
                                      tracker_entries)
         self._rng = _pyrandom.Random(int(seed))
@@ -314,7 +417,9 @@ class FleetRouter:
             ReplicaHandle(n, e, factory=factory, probation=probation,
                           probation_backoff=probation_backoff,
                           probation_max=probation_max,
-                          restart_warmup=restart_warmup)
+                          restart_warmup=restart_warmup,
+                          breaker=CircuitBreaker(self._breaker_threshold,
+                                                 self._breaker_cooldown))
             for n, e in zip(names, engines)]
         self._by_name = {h.name: h for h in self._handles}
         self.spill_queue_depth = int(spill_queue_depth) \
@@ -598,43 +703,114 @@ class FleetRouter:
                      exclude: Optional[Set[str]] = None
                      ) -> Tuple[ReplicaHandle, object]:
         """Place ``req`` on the best available replica: walk the policy
-        order, skipping shedding replicas (their ``QueueFullError`` is
-        re-raised only if EVERY candidate shed) and marking replicas
-        whose submit fails replica-level as dead."""
+        order, skipping replicas with an OPEN circuit breaker and
+        replicas that shed, and marking replicas whose submit fails
+        replica-level as dead.  When every candidate sheds (or sits
+        behind an open breaker) the fleet is saturated: coordinated
+        brownout is noted and the typed :class:`FleetSaturatedError`
+        surfaces.  A :class:`DeadlineInfeasibleError` from one replica
+        is retried on less-loaded candidates but — if nobody can make
+        the deadline — surfaces AS the deadline error, never laundered
+        into a queue-full shed."""
         now = time.monotonic()
         remaining = req.remaining(now)
         if remaining is not None and remaining <= 0:
             raise RequestTimeoutError(
                 "request deadline elapsed before it could be placed "
                 "on a replica")
-        shed = None
+        shed = infeasible = None
+        breaker_skips = 0
         for h in self._order_candidates(req.payload):
             if exclude and h.name in exclude:
+                continue
+            if not h.breaker.allow(now):
+                breaker_skips += 1
+                self._count("breaker_skips")
                 continue
             try:
                 fut = h.engine.submit(req.payload, req.max_new_tokens,
                                       timeout=req.remaining(),
-                                      eos_id=req.eos_id)
+                                      eos_id=req.eos_id,
+                                      priority=req.priority)
+            except DeadlineInfeasibleError as e:
+                # the deadline is the REQUEST's own constraint — a
+                # less-loaded candidate may still make it; the breaker
+                # is untouched (one impatient client must not open
+                # breakers on healthy replicas), but a consumed
+                # half-open probe slot is freed so the replica isn't
+                # unroutable for a forfeited cooldown
+                h.breaker.release_probe()
+                self._count("deadline_sheds")
+                infeasible = e
+                continue
             except QueueFullError as e:
                 self._count("sheds")
+                h.breaker.record_failure(now)
                 shed = e
                 continue
             except (EngineCrashedError, EngineStoppedError) as e:
+                h.breaker.record_failure(now)
                 if isinstance(e, EngineCrashedError) and \
                         h.mark_dead(str(e)):
                     self._count("replica_deaths")
                 continue
             except InvalidRequestError:
+                h.breaker.release_probe()
                 raise              # the request's own fault — no failover
+            h.breaker.record_success()
             h.routed += 1
             self._count("routed")
             return h, fut
-        if shed is not None:
-            raise shed             # healthy replicas exist, all saturated
+        if infeasible is not None:
+            raise infeasible       # original deadline semantics, always
+        if shed is not None or breaker_skips:
+            # healthy replicas exist but ALL are saturated (shedding
+            # now, or breaker-open from shedding moments ago).  Only a
+            # FULL walk is saturation evidence: a hedge/failover probe
+            # with replicas excluded never saw the whole fleet, and
+            # partial evidence must not force brownout on the healthy
+            # replicas it skipped.
+            browned = self._note_saturation(now) if not exclude else False
+            raise FleetSaturatedError(
+                f"fleet {self.name!r}: all healthy replicas saturated "
+                f"(breaker-open skips: {breaker_skips}) — back off or "
+                "scale up"
+                + ("; coordinated brownout engaged" if browned else ""))
         self._count("no_healthy")
         raise NoHealthyReplicaError(
             f"fleet {self.name!r}: no healthy replica accepted the "
             "request")
+
+    def _note_saturation(self, now: float) -> bool:
+        """Track all-replicas-shed submits; ``saturation_threshold``
+        of them inside ``saturation_window`` seconds force every
+        replica's overload controller to its brownout floor — the
+        fleet degrades service coherently instead of each replica
+        discovering the storm alone.  Returns True iff THIS call
+        triggered the coordinated brownout."""
+        if not self.saturation_brownout:
+            return False
+        with self._sat_lock:
+            # threshold events must land inside ONE window — a sliding
+            # check over the last N event times, not a gap-reset streak
+            # (a trickle of one saturated submit every window-minus-ε
+            # seconds must never read as a storm)
+            self._sat_times.append(now)
+            due = (len(self._sat_times) >= self.saturation_threshold
+                   and now - self._sat_times[0] <= self.saturation_window
+                   and now - self._sat_brownout_at
+                   >= self.saturation_window)
+            if due:
+                self._sat_brownout_at = now
+                self._sat_times.clear()
+        if due:
+            self._count("fleet_brownouts")
+            for h in self._healthy():
+                try:
+                    h.engine.force_brownout("fleet saturated")
+                except Exception:
+                    pass
+        return due
 
     def _failover(self, req: _FleetRequest,
                   cause: BaseException) -> Tuple[ReplicaHandle, object]:
@@ -649,10 +825,20 @@ class FleetRouter:
         if req.failovers_left <= 0:
             self._count("failover_exhausted")
             raise cause
+        # a faulted failover attempt aborts BEFORE the budget check —
+        # the containment contract (resilience/faults.py) is that a
+        # fleet.failover fault leaves budgets untouched
         try:
             _inject("fleet.failover")
         except BaseException:
             self._count("failover_faults")
+            raise cause
+        # the fleet-wide token bucket caps ADDED retry load across all
+        # requests: when it is dry the original failure surfaces typed
+        # — a replica crash during saturation must not fan out into a
+        # resubmission herd (docs/overload.md)
+        if not self._retry_budget.try_acquire():
+            self._count("retry_budget_exhausted")
             raise cause
         req.failovers_left -= 1
         self._count("failovers")
@@ -664,12 +850,14 @@ class FleetRouter:
     # ------------------------------------------------------------- submit
     def submit(self, x, max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
-               eos_id: Optional[int] = None) -> FleetFuture:
+               eos_id: Optional[int] = None,
+               priority: Optional[str] = None) -> FleetFuture:
         """Enqueue one request on the fleet; same contract as
         ``InferenceEngine.submit`` with replica placement on top.
         ``timeout`` is the request's fleet-wide server deadline —
         failover resubmissions inherit the REMAINING time, never a
-        fresh window."""
+        fresh window.  ``priority`` (docs/overload.md) rides every
+        attempt: a failed-over request keeps its class."""
         if self._stopping:
             raise EngineStoppedError("fleet router is stopped")
         if self.mode == "decode":
@@ -681,20 +869,23 @@ class FleetRouter:
             payload = onp.asarray(getattr(x, "asnumpy", lambda: x)())
         deadline = time.monotonic() + timeout if timeout else None
         req = _FleetRequest(payload, self.mode, max_new_tokens, eos_id,
-                            deadline, self.max_failovers)
+                            deadline, self.max_failovers,
+                            priority=priority)
         handle, inner = self._submit_once(req)
         return FleetFuture(self, req, handle, inner)
 
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
-              eos_id: Optional[int] = None):
+              eos_id: Optional[int] = None,
+              priority: Optional[str] = None):
         """Synchronous ``submit()`` + wait (unbounded client wait — the
         fleet resolves every future with a result or a typed error,
         same as the engine)."""
         if self._monitor is None:
             raise ServingError("router not started — call start() or use "
                                "the context manager")
-        return self.submit(x, max_new_tokens, timeout, eos_id).result(None)
+        return self.submit(x, max_new_tokens, timeout, eos_id,
+                           priority).result(None)
 
     # -------------------------------------------------------------- stats
     def _count(self, key: str, n: int = 1):
@@ -709,7 +900,8 @@ class FleetRouter:
             except Exception as e:
                 eh = {"live": False, "error": repr(e)}
             reps[h.name] = {"state": h.state, "deaths": h.total_deaths,
-                            "restarts": h.restarts, "engine": eh}
+                            "restarts": h.restarts,
+                            "breaker": h.breaker.state, "engine": eh}
         healthy = len(self._healthy())
         return {"name": self.name, "ready": healthy > 0
                 and not self._stopping,
@@ -749,7 +941,15 @@ class FleetRouter:
                       "healthy": len(self._healthy()),
                       "spill_queue_depth": self.spill_queue_depth,
                       "max_failovers": self.max_failovers,
-                      "tracked_prefixes": len(self._policy)},
+                      "tracked_prefixes": len(self._policy),
+                      "retry_budget": {
+                          "available": round(
+                              self._retry_budget.available, 2),
+                          "burst": self._retry_budget.burst,
+                          "rate": self._retry_budget.rate,
+                          "denied": self._retry_budget.denied},
+                      "breakers": {h.name: h.breaker.state
+                                   for h in self._handles}},
             "router": router,
             "aggregate": agg,
             "replicas": replicas,
@@ -796,6 +996,10 @@ class FleetRouter:
             samples.append({"name": "mxtpu_fleet_replica_restarts_total",
                             "kind": "counter", "labels": dict(rlbl),
                             "value": h.restarts, "help": ""})
+            samples.append({"name": "mxtpu_fleet_replica_breaker_open",
+                            "kind": "gauge", "labels": dict(rlbl),
+                            "value": 0 if h.breaker.state == "closed"
+                            else 1, "help": ""})
             try:
                 c = h.engine.metrics.counters
                 hits += c["prefix_hits"]
@@ -805,6 +1009,10 @@ class FleetRouter:
         samples.append({"name": "mxtpu_fleet_replicas_healthy",
                         "kind": "gauge", "labels": dict(lbl),
                         "value": healthy, "help": ""})
+        samples.append({"name": "mxtpu_fleet_retry_budget_available",
+                        "kind": "gauge", "labels": dict(lbl),
+                        "value": round(self._retry_budget.available, 2),
+                        "help": ""})
         looked = hits + misses
         if looked:
             samples.append({"name": "mxtpu_fleet_prefix_hit_rate",

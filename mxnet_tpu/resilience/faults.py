@@ -10,7 +10,13 @@ fleet router's ``"fleet.route"`` / ``"fleet.failover"`` /
 ``"fleet.drain"`` (:mod:`mxnet_tpu.fleet` — route faults degrade to
 least-loaded placement, failover faults abort that failover attempt,
 and a delay at ``fleet.drain`` models a replica hanging in drain, which
-fleet shutdown must condemn rather than wait out), …).  With
+fleet shutdown must condemn rather than wait out), and the overload
+controller's ``"overload.admission"`` / ``"overload.preempt"``
+(docs/overload.md — an admission fault degrades to ADMITTING the
+request, its deadline still enforced downstream; a preempt fault aborts
+that preemption attempt, the victim keeps decoding — overload control
+is an optimization layer and must never fail a request itself), …).
+With
 no plan active that
 call is one module-global load plus a ``None`` check — provably in the
 noise of any step that launches an XLA program.  Inside a

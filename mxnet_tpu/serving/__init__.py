@@ -22,12 +22,16 @@ Quick start::
 """
 from .batcher import BucketLattice, DynamicBatcher
 from .engine import InferenceEngine, InferenceFuture, Request
-from .errors import (DeadlineExceededError, EngineCrashedError,
-                     EngineStoppedError, InvalidRequestError,
+from .errors import (DeadlineExceededError, DeadlineInfeasibleError,
+                     EngineCrashedError, EngineStoppedError,
+                     FleetSaturatedError, InvalidRequestError,
                      NoHealthyReplicaError, NonFiniteOutputError,
-                     QueueFullError, RequestTimeoutError, ServingError)
+                     QueueFullError, RequestCancelledError,
+                     RequestTimeoutError, ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
+from .overload import (PRIORITIES, CircuitBreaker, OverloadController,
+                       RetryBudget, priority_name, priority_ordinal)
 from .prefix_cache import PrefixCache, PrefixEntry
 
 __all__ = [
@@ -36,8 +40,12 @@ __all__ = [
     "SlotAllocator", "SlotState",
     "PrefixCache", "PrefixEntry",
     "LatencyHistogram", "ServingMetrics",
+    "PRIORITIES", "OverloadController", "RetryBudget", "CircuitBreaker",
+    "priority_name", "priority_ordinal",
     "ServingError", "QueueFullError", "RequestTimeoutError",
-    "DeadlineExceededError", "EngineStoppedError", "EngineCrashedError",
+    "DeadlineExceededError", "DeadlineInfeasibleError",
+    "EngineStoppedError", "EngineCrashedError",
     "InvalidRequestError", "NonFiniteOutputError",
-    "NoHealthyReplicaError",
+    "NoHealthyReplicaError", "RequestCancelledError",
+    "FleetSaturatedError",
 ]

@@ -22,11 +22,29 @@ pays a compile.  The compiled step itself reuses CachedOp's
 functionalization (``make_pure_fn``): parameters are swapped in as
 traced arguments, inference mode, no tape.
 
-Backpressure: a bounded queue sheds at ``submit`` with
-:class:`QueueFullError`; each request can carry a deadline, enforced
-while queued AND mid-generation.  ``stats()`` exposes latency
-percentiles, token counters and the bucket-hit/compile counters;
-scheduler batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
+Backpressure & overload control (docs/overload.md): the bounded
+admission queue is PRIORITY-AWARE — requests carry a class
+(``interactive`` / ``batch`` / ``best_effort``), batches form highest
+class first, and at depth an arriving request evicts the youngest
+queued request of a strictly lower class before shedding itself
+(:class:`QueueFullError`, reason-labeled).  Each request can carry a
+deadline, enforced while queued AND mid-generation; with
+``deadline_admission`` the engine also rejects ON ARRIVAL any request
+whose deadline is already infeasible given the observed queue wait and
+prefill/decode latency estimates (:class:`DeadlineInfeasibleError`) so
+doomed work never burns a queue slot.  An AIMD
+:class:`~.overload.OverloadController` watches queue depth and
+deadline misses: under sustained pressure it enters BROWNOUT — caps
+``max_new_tokens`` for non-interactive classes and pauses prefix-pool
+inserts before shedding anything, hard-shedding only the lowest class
+at the floor — and recovers automatically.  A high-priority request
+arriving with every slot busy may PREEMPT a ``best_effort`` request
+mid-decode: the victim's generated-so-far prefix is parked in the
+prefix pool (one compiled slot→pool row copy) and the request requeues
+to resume by prefix hit, so preemption wastes almost no work.
+``stats()`` exposes latency percentiles, token counters, per-class
+shed/served counts and the bucket-hit/compile counters; scheduler
+batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
 
 Prefix reuse (docs/serving.md): with ``prefix_pool_rows > 0`` a
 host-side radix tree (:mod:`.prefix_cache`) maps admitted prompt
@@ -71,11 +89,16 @@ import numpy as onp
 from ..observability.trace import active as _trace_active
 from ..resilience.faults import RetryableFault, inject as _inject
 from .batcher import BucketLattice, DynamicBatcher
-from .errors import (EngineCrashedError, EngineStoppedError,
-                     InvalidRequestError, NonFiniteOutputError,
-                     QueueFullError, RequestTimeoutError, ServingError)
+from .errors import (DeadlineInfeasibleError, EngineCrashedError,
+                     EngineStoppedError, InvalidRequestError,
+                     NonFiniteOutputError, QueueFullError,
+                     RequestCancelledError, RequestTimeoutError,
+                     ServingError)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import ServingMetrics
+from .overload import (OverloadController, PRIORITY_BATCH,
+                       PRIORITY_BEST_EFFORT, PRIORITY_INTERACTIVE,
+                       priority_name, priority_ordinal)
 from .prefix_cache import PrefixCache
 
 __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
@@ -117,15 +140,20 @@ class InferenceFuture:
     """Write-once result holder; safe across threads.  ``trace_id`` is
     the request's observability trace id (None with tracing disabled) —
     the handle a caller passes to ``Tracer.timeline()`` to dump the
-    request's span timeline."""
+    request's span timeline.  ``t_done`` is the ``time.monotonic()``
+    instant the engine resolved the future (result or exception) — the
+    server-side completion stamp, so a caller that collects futures
+    after the fact can still score each request against its deadline
+    without per-request waiter threads."""
 
-    __slots__ = ("_ev", "_result", "_exc", "trace_id")
+    __slots__ = ("_ev", "_result", "_exc", "trace_id", "t_done")
 
     def __init__(self):
         self._ev = threading.Event()
         self._result = None
         self._exc = None
         self.trace_id = None
+        self.t_done: Optional[float] = None
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -133,11 +161,13 @@ class InferenceFuture:
     def set_result(self, value):
         if not self._ev.is_set():
             self._result = value
+            self.t_done = time.monotonic()
             self._ev.set()
 
     def set_exception(self, exc: BaseException):
         if not self._ev.is_set():
             self._exc = exc
+            self.t_done = time.monotonic()
             self._ev.set()
 
     def result(self, timeout: Optional[float] = None):
@@ -152,12 +182,13 @@ class InferenceFuture:
 class Request:
     __slots__ = ("id", "kind", "payload", "prompt_len", "max_new_tokens",
                  "eos_id", "deadline", "future", "t_submit", "t_enqueue",
-                 "t_schedule", "shape_key", "retries_left", "trace_id")
+                 "t_schedule", "shape_key", "retries_left", "trace_id",
+                 "priority", "preempted")
 
     _ids = itertools.count()
 
     def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
-                 deadline=None):
+                 deadline=None, priority=PRIORITY_BATCH):
         self.retries_left = 0     # engine grants the budget at submit
         # trace-id propagation crosses the scheduler thread boundary BY
         # VALUE on the request itself (no thread-locals to lose)
@@ -169,12 +200,18 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.deadline = deadline
+        self.priority = priority       # ordinal into overload.PRIORITIES
+        self.preempted = 0             # times preempted (slot reclaimed)
         self.future = InferenceFuture()
         self.t_submit = time.monotonic()
         self.t_enqueue = self.t_submit
         self.t_schedule = None
         self.shape_key = (tuple(payload.shape), str(payload.dtype)) \
             if kind == "forward" else None
+
+    @property
+    def priority_name(self) -> str:
+        return priority_name(self.priority)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -239,6 +276,26 @@ class InferenceEngine:
         sync; forward: a host-side check of the already-fetched rows).
         The engine keeps serving — one poisoned request never condemns
         the batch or trips the watchdog.
+    default_priority : class for requests whose ``submit`` omits one —
+        ``'interactive'`` | ``'batch'`` (default) | ``'best_effort'``
+        (docs/overload.md).
+    preemption : allow an ``interactive`` request arriving with every
+        slot busy to preempt a ``best_effort`` request mid-decode: the
+        victim's generated-so-far prefix parks in the prefix pool (when
+        usable) and the request requeues at the front of its class to
+        resume by prefix hit.
+    deadline_admission : reject-on-arrival requests whose deadline is
+        infeasible given observed queue wait + prefill/decode latency
+        estimates (:class:`DeadlineInfeasibleError`).  Engages only
+        once the phase histograms hold ``deadline_min_history``
+        completions; ``deadline_safety`` scales the estimate (>1 =
+        shed earlier).
+    brownout : run the AIMD :class:`~.overload.OverloadController` —
+        under sustained queue pressure / deadline misses the engine
+        caps non-interactive ``max_new_tokens``, pauses prefix-pool
+        inserts, and only at the floor sheds ``best_effort`` arrivals;
+        recovers automatically.  ``overload_controller`` swaps in a
+        pre-tuned controller instance.
     name : base name for this engine's metrics identity.  The claimed
         name (``self.name``) is uniquified against every other live
         engine (``serving``, ``serving-2``, …) so fleet replicas export
@@ -266,6 +323,13 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_min_tokens: int = 4,
                  prefix_fault_limit: int = 3,
+                 default_priority: str = "batch",
+                 preemption: bool = True,
+                 deadline_admission: bool = True,
+                 deadline_safety: float = 1.0,
+                 deadline_min_history: int = 8,
+                 brownout: bool = True,
+                 overload_controller: Optional[OverloadController] = None,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -342,6 +406,17 @@ class InferenceEngine:
         self._prefix_disabled = False
 
         self.guard_nonfinite = bool(guard_nonfinite)
+        # ---- overload control (docs/overload.md) ----
+        self.default_priority = priority_ordinal(default_priority)
+        self.preemption = bool(preemption)
+        self.deadline_admission = bool(deadline_admission)
+        self.deadline_safety = float(deadline_safety)
+        self.deadline_min_history = int(deadline_min_history)
+        self._overload = overload_controller if overload_controller \
+            is not None else OverloadController(queue_depth,
+                                                enabled=bool(brownout))
+        self._cancels: set = set()     # futures flagged for slot reclaim
+        self._timeouts_seen = 0        # controller's deadline-miss delta
         self.hang_timeout = hang_timeout
         self.watchdog_interval = float(watchdog_interval)
         self.max_request_retries = int(max_request_retries)
@@ -410,6 +485,15 @@ class InferenceEngine:
                   help="live prefix-cache radix-tree entries",
                   fn=bound(lambda e: len(e._prefix)
                            if e._prefix is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_overload_factor",
+                  help="brownout degradation factor (1.0 = normal; "
+                       "lower = non-interactive token budgets capped "
+                       "at this fraction)",
+                  fn=bound(lambda e: e._overload.factor), **lbl)
+        reg.gauge("mxtpu_serving_brownout",
+                  help="1 while the overload controller is in brownout",
+                  fn=bound(lambda e: 1 if e._overload.brownout else 0),
+                  **lbl)
 
     # ------------------------------------------------------------- exporter
     def attach_exporter(self, exporter) -> "InferenceEngine":
@@ -805,9 +889,100 @@ class InferenceEngine:
         self.stop(drain=not any(exc))
 
     # ------------------------------------------------------------------ submit
+    #: shed reason → legacy aggregate counter (the reason-labeled
+    #: breakdown rides mxtpu_serving_sheds_total{reason=,priority=})
+    _SHED_COUNTER = {"queue_full": "rejected_queue_full",
+                     "priority_shed": "rejected_queue_full",
+                     "brownout": "rejected_queue_full",
+                     "deadline_infeasible": "rejected_infeasible"}
+
+    def _reject(self, reason: str, exc: BaseException, *,
+                priority: Optional[str] = None, trace_id=None,
+                request_id=None):
+        """The ONE audited rejection path out of ``submit()``
+        (docs/overload.md): every rejection — crashed engine, invalid
+        request, queue-full / brownout shed, infeasible deadline —
+        stamps exactly one aggregate counter, one reason-labeled shed
+        sample (shed reasons only), and one trace event, in that
+        order, then raises ``exc``."""
+        counter = self._SHED_COUNTER.get(reason)
+        if counter is not None:
+            self.metrics.count(counter)
+            self.metrics.count_shed(reason, priority or "unknown")
+            self.metrics.mark("shed")
+            event = "serving.shed"
+        else:
+            self.metrics.count("rejected_invalid" if reason == "invalid"
+                               else "rejected_crashed")
+            event = "serving.reject"
+        tr = _trace_active()
+        if tr is not None:
+            tr.event(event, trace_id=trace_id, reason=reason,
+                     request=request_id)
+        raise exc
+
+    def _shed_queued(self, victim: Request, reason: str):
+        """Fail a QUEUED request shed in favor of arriving
+        higher-class work (priority eviction): the same
+        one-counter/one-event audit as :meth:`_reject`, but the typed
+        error lands on the victim's FUTURE — its own ``submit()``
+        already returned."""
+        self.metrics.count(self._SHED_COUNTER[reason])
+        self.metrics.count_shed(reason, victim.priority_name)
+        self.metrics.mark("shed")
+        tr = _trace_active()
+        if tr is not None:
+            tr.event("serving.shed", trace_id=victim.trace_id,
+                     reason=reason, request=victim.id)
+        victim.future.set_exception(QueueFullError(
+            f"request {victim.id} ({victim.priority_name}) evicted from "
+            f"the queue by higher-priority arrival ({reason})"))
+
+    def _brownout_shed_or_admit(self, pr: int, now: float):
+        """Brownout's hard edge (docs/overload.md): at the controller
+        floor, lowest-class arrivals shed on arrival; everything
+        milder is degradation, not refusal."""
+        if self._overload.shedding(pr, now):
+            self._reject("brownout", QueueFullError(
+                f"engine in brownout at floor — shedding "
+                f"{priority_name(pr)} arrivals"),
+                priority=priority_name(pr))
+
+    def _feasible_or_reject(self, pr: int, mnt: int, deadline: float,
+                            now: float):
+        """Deadline-aware admission (docs/overload.md): estimate
+        queue wait (behind same-or-higher-class work only) plus
+        prefill + per-token decode time from the phase histograms; a
+        deadline the estimate already overshoots is rejected ON
+        ARRIVAL with :class:`DeadlineInfeasibleError`.  Engages only
+        once ``deadline_min_history`` completions exist; a fault at
+        ``overload.admission`` degrades to admitting (the request can
+        still time out later — the gate is an optimization, never a
+        correctness dependency)."""
+        est = self.metrics.latency_estimates(self.deadline_min_history)
+        if est is None:
+            return
+        try:
+            _inject("overload.admission")
+        except Exception:
+            self.metrics.count("overload_faults")
+            return
+        prefill_p50, per_token, service_p50 = est
+        ahead = self._batcher.depth_at_or_above(pr)
+        waves = ahead / max(1, self.num_slots)
+        need = (waves * service_p50 + prefill_p50
+                + per_token * mnt) * self.deadline_safety
+        if now + need > deadline:
+            self._reject("deadline_infeasible", DeadlineInfeasibleError(
+                f"deadline infeasible on arrival: estimated "
+                f"{need * 1e3:.1f}ms (queue {ahead} ahead at class, "
+                f"{mnt} tokens) exceeds the {(deadline - now) * 1e3:.1f}"
+                f"ms remaining"), priority=priority_name(pr))
+
     def submit(self, x, max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
-               eos_id: Optional[int] = None) -> InferenceFuture:
+               eos_id: Optional[int] = None,
+               priority: Optional[str] = None) -> InferenceFuture:
         """Enqueue one request; returns its future.
 
         decode mode: ``x`` is a 1-D int prompt (list/np/NDArray); the
@@ -818,44 +993,81 @@ class InferenceEngine:
 
         ``timeout`` sets the request's SERVER-side deadline in seconds
         (``None``/``0`` = no deadline), enforced while queued and
-        mid-generation.
+        mid-generation — and, with ``deadline_admission``, already at
+        arrival (infeasible deadlines reject with
+        :class:`DeadlineInfeasibleError`).
+
+        ``priority`` is the request's QoS class (``'interactive'`` |
+        ``'batch'`` | ``'best_effort'``; default: the engine's
+        ``default_priority``).  Under overload, lower classes are shed
+        first, token-capped during brownout, and — lowest class only —
+        preemptible mid-decode; a queued lower-class request may be
+        EVICTED by a higher-class arrival (its future fails with
+        :class:`QueueFullError`).  See docs/overload.md.
         """
+        try:
+            pr = self.default_priority if priority is None \
+                else priority_ordinal(priority)
+        except ValueError as e:
+            # an unknown class is the REQUEST's own fault and must obey
+            # the typed-error contract like every other bad input — a
+            # raw ValueError would skip the rejection audit and escape
+            # the fleet router's exception taxonomy untyped
+            self._reject("invalid", InvalidRequestError(str(e)))
         if self._crashed is not None:
-            raise EngineCrashedError(str(self._crashed))
+            self._reject("crashed",
+                         EngineCrashedError(str(self._crashed)),
+                         priority=priority_name(pr))
         timeout = self.default_timeout if timeout is None else timeout
-        deadline = time.monotonic() + timeout if timeout else None
+        now = time.monotonic()
+        deadline = now + timeout if timeout else None
         if self.mode == "decode":
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)(),
                               dtype="int32")
             if arr.ndim == 2 and arr.shape[0] == 1:
                 arr = arr[0]        # generate-style (1, T) prompt
             if arr.ndim != 1:
-                self.metrics.count("rejected_invalid")
-                raise InvalidRequestError(
+                self._reject("invalid", InvalidRequestError(
                     f"a decode request is ONE prompt: expected shape (T,) "
                     f"or (1, T), got {arr.shape} — submit batch rows "
-                    "individually, batching is the engine's job")
+                    "individually, batching is the engine's job"),
+                    priority=priority_name(pr))
             mnt = int(self.default_max_new_tokens if max_new_tokens is None
                       else max_new_tokens)
             if arr.size < 1 or mnt < 1:
-                self.metrics.count("rejected_invalid")
-                raise InvalidRequestError(
+                self._reject("invalid", InvalidRequestError(
                     f"need a non-empty prompt and max_new_tokens >= 1 "
-                    f"(got len={arr.size}, max_new_tokens={mnt})")
+                    f"(got len={arr.size}, max_new_tokens={mnt})"),
+                    priority=priority_name(pr))
             # prompts longer than the largest seq bucket are fine now —
             # chunked prefill splits them — but prompt + generation must
             # fit the KV rows
             if arr.size + mnt > self.max_length:
-                self.metrics.count("rejected_invalid")
-                raise InvalidRequestError(
+                self._reject("invalid", InvalidRequestError(
                     f"prompt len {arr.size} + {mnt} new tokens does not "
-                    f"fit the KV length ({self.max_length})")
+                    f"fit the KV length ({self.max_length})"),
+                    priority=priority_name(pr))
+            # every VALID request counts submitted before the overload
+            # gates, so every shed reason (queue_full, priority_shed,
+            # brownout, deadline_infeasible) shares one denominator:
+            # shed_rate = sheds_total / submitted_total holds per
+            # reason (docs/overload.md)
+            self.metrics.count("submitted")
+            # brownout degrades before it refuses: at the controller
+            # floor the lowest class sheds on arrival; above it,
+            # non-interactive token budgets are capped instead
+            self._brownout_shed_or_admit(pr, now)
+            mnt = self._overload.cap_tokens(pr, mnt)
+            if deadline is not None and self.deadline_admission:
+                self._feasible_or_reject(pr, mnt, deadline, now)
             req = Request("decode", arr, mnt,
                           self.eos_id if eos_id is None else eos_id,
-                          deadline)
+                          deadline, priority=pr)
         else:
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)())
-            req = Request("forward", arr, deadline=deadline)
+            self.metrics.count("submitted")
+            self._brownout_shed_or_admit(pr, now)
+            req = Request("forward", arr, deadline=deadline, priority=pr)
         req.retries_left = self.max_request_retries
         tr = _trace_active()
         if tr is not None:
@@ -864,21 +1076,60 @@ class InferenceEngine:
             # thread — joins it through req.trace_id
             req.trace_id = req.future.trace_id = tr.new_trace_id()
             tr.event("serving.submit", trace_id=req.trace_id,
-                     request=req.id, kind=req.kind)
-        self.metrics.count("submitted")
+                     request=req.id, kind=req.kind,
+                     priority=req.priority_name)
         try:
-            self._batcher.put(req)
-        except QueueFullError:
-            self.metrics.count("rejected_queue_full")
-            self.metrics.mark("shed")
-            if tr is not None:
-                tr.event("serving.shed", trace_id=req.trace_id)
-            raise
+            victim = self._batcher.put(req)
+        except QueueFullError as e:
+            self._reject("queue_full", e, priority=priority_name(pr),
+                         trace_id=req.trace_id, request_id=req.id)
+        if victim is not None:
+            self._shed_queued(victim, "priority_shed")
         return req.future
+
+    def cancel(self, fut: InferenceFuture) -> bool:
+        """Actively cancel a submitted request (the fleet router's
+        hedged-loser cleanup — docs/overload.md): a QUEUED request is
+        dequeued and its future fails with
+        :class:`RequestCancelledError`; a mid-decode request's slot is
+        flagged reclaimable and the scheduler frees it at the next
+        cycle.  Returns True iff a live (unresolved) request was
+        found.  Safe from any thread.
+
+        Forward mode: only QUEUED requests are cancellable — a popped
+        forward batch resolves within the same scheduler cycle, so
+        there is no capacity to reclaim mid-flight and ``cancel``
+        reports False (the result is imminent anyway)."""
+        req = self._batcher.remove(fut)
+        if req is not None:
+            self._fail(req, RequestCancelledError(
+                f"request {req.id} cancelled while queued"))
+            return True
+        if fut.done() or self.mode == "forward":
+            return False
+        for r in self._snapshot_inflight_requests():
+            if r.future is fut:
+                with self._cond:
+                    self._cancels.add(fut)
+                    self._cond.notify_all()
+                return True
+        return False
+
+    def force_brownout(self, reason: str = "external") -> None:
+        """Slam the overload controller to its floor — the fleet
+        router's coordinated-brownout hook for an all-replicas-
+        saturated fleet.  Recovery is automatic (AIMD).  Safe from any
+        thread; a no-op when brownout is disabled."""
+        was = self._overload.brownout
+        self._overload.force()
+        if not was and self._overload.brownout:
+            self.metrics.count("brownouts")
+            self.metrics.mark("brownout", reason)
 
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
-              eos_id: Optional[int] = None):
+              eos_id: Optional[int] = None,
+              priority: Optional[str] = None):
         """Synchronous ``submit()`` + wait.  ``timeout`` is the SERVER
         deadline; the wait itself is unbounded — the scheduler resolves
         every future (result, typed timeout, or engine error), so a
@@ -891,7 +1142,7 @@ class InferenceEngine:
                                "the context manager (submit() alone may "
                                "queue pre-start, but a sync infer() would "
                                "block forever)")
-        fut = self.submit(x, max_new_tokens, timeout, eos_id)
+        fut = self.submit(x, max_new_tokens, timeout, eos_id, priority)
         return fut.result(None)
 
     # ------------------------------------------------------------------ warmup
@@ -973,7 +1224,13 @@ class InferenceEngine:
             "prefix_disabled": self._prefix_disabled,
             "running": self._thread is not None,
             "crashed": self._crashed is not None,
+            "default_priority": priority_name(self.default_priority),
+            "preemption": self.preemption,
+            "deadline_admission": self.deadline_admission,
         }
+        # overlay the live controller state on the metrics' per-class
+        # shed/served accounting (docs/overload.md)
+        s["overload"]["controller"] = self._overload.snapshot()
         return s
 
     # --------------------------------------------------------------- scheduler
@@ -990,6 +1247,9 @@ class InferenceEngine:
                 if self._batcher.empty() and idle:
                     if self._stopping:
                         return
+                    # the controller must keep ticking while idle or a
+                    # brownout could never LIFT once the storm passes
+                    self._overload_tick(time.monotonic())
                     self._cond.wait(0.05)
                     continue
             try:
@@ -1054,7 +1314,7 @@ class InferenceEngine:
         if isinstance(exc, RequestTimeoutError):
             self.metrics.count("timeouts")
             self.metrics.mark("timeout")
-        elif isinstance(exc, EngineStoppedError):
+        elif isinstance(exc, (EngineStoppedError, RequestCancelledError)):
             self.metrics.count("cancelled")
         tr = _trace_active()
         if tr is not None and req.trace_id is not None:
@@ -1087,7 +1347,9 @@ class InferenceEngine:
                                      t_first - req.t_schedule,
                                      now - t_first)
         self.metrics.count("completed")
+        self.metrics.count_served(req.priority_name)
         self.metrics.count("tokens_generated", len(st.generated))
+        self.metrics.count("decode_tokens_observed", len(st.generated))
         tr = _trace_active()
         if tr is not None and req.trace_id is not None:
             # phase spans are RETROSPECTIVE — rebuilt from the request
@@ -1125,6 +1387,7 @@ class InferenceEngine:
     def _decode_cycle(self):
         alloc = self._alloc
         now = time.monotonic()
+        self._sweep_cancelled()
         # mid-flight deadline enforcement
         for slot, st in alloc.items():
             if st.request.expired(now):
@@ -1132,6 +1395,10 @@ class InferenceEngine:
                 self._fail(st.request, RequestTimeoutError(
                     f"request {st.request.id} timed out after "
                     f"{len(st.generated)} tokens"))
+        self._overload_tick(now)
+        # priority preemption BEFORE admission: the slots it frees are
+        # leased to the waiting interactive requests this same cycle
+        self._preempt_cycle(now)
         # admission: lease free slots to queued requests (prefix-cache
         # lookup + copy happens at lease); only an IDLE engine waits out
         # the batching window — with requests in flight the arrivals
@@ -1145,6 +1412,140 @@ class InferenceEngine:
         self._prefill_cycle()
         if any(not st.prefilling for _s, st in alloc.items()):
             self._decode_step()
+
+    def _overload_tick(self, now: float):
+        """One AIMD controller tick (docs/overload.md): pressure =
+        queue depth vs capacity plus deadline misses since the last
+        cycle.  Purely host-side — it can never add a compile."""
+        t = self.metrics.counters["timeouts"]
+        entered = self._overload.update(len(self._batcher),
+                                        t - self._timeouts_seen, now)
+        self._timeouts_seen = t
+        if entered:
+            self.metrics.count("brownouts")
+            self.metrics.mark("brownout")
+
+    def _sweep_cancelled(self):
+        """Free the slots of requests cancelled mid-decode (the
+        hedged-loser path): their futures fail typed and the rows are
+        reclaimable this same cycle.  A cancelled request that was
+        PREEMPTED between the cancel() call and this sweep lives in
+        the queue as a continuation — dequeue it there; anything still
+        unmatched and unresolved carries over to the next sweep rather
+        than silently un-cancelling."""
+        if not self._cancels:
+            return
+        with self._cond:
+            cancels, self._cancels = self._cancels, set()
+        for slot, st in list(self._alloc.items()):
+            if st.request.future in cancels:
+                cancels.discard(st.request.future)
+                self._release(slot)
+                self._fail(st.request, RequestCancelledError(
+                    f"request {st.request.id} cancelled mid-decode "
+                    f"after {len(st.generated)} tokens"))
+        carry = set()
+        for fut in cancels:
+            if fut.done():
+                continue
+            req = self._batcher.remove(fut)
+            if req is not None:
+                self._fail(req, RequestCancelledError(
+                    f"request {req.id} cancelled while requeued"))
+            else:
+                carry.add(fut)     # mid-flight this cycle: retry next
+        if carry:
+            with self._cond:
+                self._cancels |= carry
+
+    # ----------------------------------------------------------- preemption
+    def _preempt_cycle(self, now: float):
+        """Slot preemption (docs/overload.md): an ``interactive``
+        request waiting with every slot busy may preempt a
+        ``best_effort`` request mid-decode.  The victim's
+        generated-so-far prefix parks in the prefix pool, so the
+        resume costs one row copy + a one-token prefill — almost no
+        wasted work, token-identical output (greedy decode is
+        deterministic)."""
+        if not self.preemption:
+            return
+        alloc = self._alloc
+        if alloc.free_count:
+            return
+        # count only NON-expired interactive arrivals: an expired one
+        # fails typed at its next admission anyway — evicting a healthy
+        # victim for it would be pure churn
+        waiting = self._batcher.waiting_at_or_above(
+            PRIORITY_INTERACTIVE, now)
+        if not waiting:
+            return
+        # a victim is only eligible when the "almost no wasted work"
+        # promise holds: its progress can PARK (the pool is usable) or
+        # it has populated fewer than prefix_min_tokens K/V rows (the
+        # resume re-prefill is trivially cheap).  With
+        # prefix_pool_rows=0 preemption therefore (almost) never fires
+        # rather than paying a full re-prefill of prompt + generated
+        # on every resume.
+        parkable = self._prefix_usable()
+        victims = [(slot, st) for slot, st in alloc.items()
+                   if not st.prefilling
+                   and st.request.priority == PRIORITY_BEST_EFFORT
+                   and (parkable or st.pos < self.prefix_min_tokens)]
+        if not victims:
+            return
+        # park the victims with the MOST remaining budget first: they
+        # free capacity longest, and their progress parks either way
+        victims.sort(key=lambda it: len(it[1].generated)
+                     - it[1].max_new_tokens)
+        for slot, st in victims[:waiting]:
+            try:
+                _inject("overload.preempt")
+            except Exception:
+                # contained: a faulted preemption attempt aborts — the
+                # victim keeps decoding, the interactive request waits
+                # for a natural slot
+                self.metrics.count("overload_faults")
+                continue
+            self._preempt(slot, st)
+
+    def _preempt(self, slot: int, st: SlotState):
+        req = st.request
+        seq = onp.concatenate([req.payload,
+                               onp.asarray(st.generated, "int32")])
+        # the slot's K/V rows are populated for [0, pos) — everything
+        # up to (not including) the last generated token, whose K/V the
+        # next decode step would have written
+        park = st.pos
+        if self._prefix_usable() and park >= self.prefix_min_tokens:
+            self._pool_insert(seq[:park], slot, park)
+        self._release(slot)
+        cont = Request("decode", seq,
+                       st.max_new_tokens - len(st.generated),
+                       req.eos_id, req.deadline, priority=req.priority)
+        # the continuation IS the original request: same future, same
+        # submit time (latency metrics span the whole request), same
+        # trace id, same remaining retry budget
+        cont.future = req.future
+        cont.t_submit = req.t_submit
+        cont.trace_id = req.trace_id
+        cont.retries_left = req.retries_left
+        cont.preempted = req.preempted + 1
+        try:
+            self._batcher.requeue(cont)
+        except EngineStoppedError as e:
+            self._fail(cont, e)
+            return
+        self.metrics.count("preemptions")
+        # the segment decoded before preemption is real served output;
+        # the continuation's completion only credits its OWN generated
+        # tokens, so count this run's here or they vanish from
+        # throughput
+        self.metrics.count("tokens_generated", len(st.generated))
+        self.metrics.mark("preempt")
+        tr = _trace_active()
+        if tr is not None and req.trace_id is not None:
+            tr.event("serving.preempt", trace_id=req.trace_id,
+                     request=req.id, generated=len(st.generated))
 
     # --------------------------------------------------------- prefix cache
     def _prefix_usable(self) -> bool:
@@ -1230,14 +1631,28 @@ class InferenceEngine:
         reserve a pool row (LRU-evicting zero-reader entries under
         pressure) and copy the slot's K/V [0, prompt_len) into it.  A
         failed copy removes the mapping — the tree must never point at
-        a row that does not hold what it promises."""
+        a row that does not hold what it promises.  During brownout
+        NEW inserts are paused (each costs a compiled row copy the
+        overloaded engine cannot spare); preemption parking bypasses
+        the pause via :meth:`_pool_insert` — parking is exactly the
+        under-pressure path."""
         if not self._prefix_usable() or \
                 st.prompt_len < self.prefix_min_tokens:
             return
+        if self._overload.pause_inserts:
+            self.metrics.count("prefix_inserts_paused")
+            return
+        self._pool_insert(st.tokens, slot, st.prompt_len)
+
+    def _pool_insert(self, tokens, slot: int, length: int):
+        """Shared slot→pool insert body: radix-tree insert + the
+        compiled row copy of K/V ``[0, length)`` from ``slot`` into
+        the reserved pool row, with the usual per-site fault
+        containment."""
         try:
             _inject("serving.prefix_lookup")
             ev0 = self._prefix.evictions
-            entry = self._prefix.insert(st.tokens)
+            entry = self._prefix.insert(tokens)
             self.metrics.count("prefix_evictions",
                                self._prefix.evictions - ev0)
         except Exception:           # incl. RetryableFault, as in lookup
@@ -1252,7 +1667,7 @@ class InferenceEngine:
                 "serving.prefix_copy", ("prefix_copy",), self._jit_copy,
                 (self._caches, jnp.asarray(slot, jnp.int32),
                  jnp.asarray(entry.row, jnp.int32),
-                 jnp.asarray(st.prompt_len, jnp.int32)), ())
+                 jnp.asarray(length, jnp.int32)), ())
         except Exception:
             self._prefix.remove(entry)
             self._prefix_fault("copy")
@@ -1273,6 +1688,11 @@ class InferenceEngine:
                            tokens=req.payload)
             slot = alloc.alloc(st)
             req.t_schedule = now
+            if req.preempted:
+                # a preemption victim re-admitted: its parked prefix
+                # should hit in _prefix_admit below (resume ≈ one row
+                # copy + a one-token prefill)
+                self.metrics.count("preempt_resumes")
             if tr is not None and req.trace_id is not None:
                 tr.record_span("serving.queue", req.t_submit, now,
                                trace_id=req.trace_id, slot=slot)
@@ -1495,6 +1915,7 @@ class InferenceEngine:
     def _forward_cycle(self):
         import jax.numpy as jnp
 
+        self._overload_tick(time.monotonic())
         reqs = self._batcher.get_batch(
             self.max_batch, self.max_wait_us,
             compatible=lambda r: r.shape_key, wait=False)
@@ -1555,6 +1976,7 @@ class InferenceEngine:
             self.metrics.observe_request(r.t_schedule - r.t_submit,
                                          done - r.t_schedule)
             self.metrics.count("completed")
+            self.metrics.count_served(r.priority_name)
             if tr is not None and r.trace_id is not None:
                 tr.record_span("serving.queue", r.t_submit, r.t_schedule,
                                trace_id=r.trace_id)

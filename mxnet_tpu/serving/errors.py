@@ -15,9 +15,11 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
-           "DeadlineExceededError", "EngineStoppedError",
-           "EngineCrashedError", "InvalidRequestError",
-           "NonFiniteOutputError", "NoHealthyReplicaError"]
+           "DeadlineExceededError", "DeadlineInfeasibleError",
+           "EngineStoppedError", "EngineCrashedError",
+           "InvalidRequestError", "NonFiniteOutputError",
+           "NoHealthyReplicaError", "RequestCancelledError",
+           "FleetSaturatedError"]
 
 
 class ServingError(MXNetError):
@@ -38,6 +40,23 @@ class RequestTimeoutError(ServingError):
 #: Canonical deadline-error name; ``RequestTimeoutError`` is the
 #: historical alias — they are the same class, so either catches both.
 DeadlineExceededError = RequestTimeoutError
+
+
+class DeadlineInfeasibleError(RequestTimeoutError):
+    """Deadline-aware admission (docs/overload.md): given the observed
+    queue wait plus prefill/decode latency estimates, this request's
+    deadline cannot be met — it is rejected ON ARRIVAL instead of
+    burning a queue slot (and the scheduler's time) on work that is
+    doomed to time out.  A subclass of :class:`RequestTimeoutError`:
+    clients that handle deadline errors handle this one."""
+
+
+class RequestCancelledError(ServingError):
+    """The request was actively cancelled before completing — e.g. the
+    fleet router reclaiming the losing copy of a hedged request once
+    the winner resolved (dequeued if still queued; its KV slot freed if
+    mid-decode).  Distinct from :class:`EngineStoppedError`: the engine
+    is fine, the caller just no longer wants the answer."""
 
 
 class EngineStoppedError(ServingError):
@@ -65,6 +84,15 @@ class NoHealthyReplicaError(ServingError):
     which the router raises when healthy replicas exist but ALL of them
     shed the request — so callers can tell "scale up / wait out
     probation" apart from "back off, the fleet is saturated"."""
+
+
+class FleetSaturatedError(QueueFullError):
+    """Every healthy replica in the fleet shed the request (queue at
+    depth or circuit breaker open) — the fleet as a whole is saturated.
+    A subclass of :class:`QueueFullError` (the back-off signal is the
+    same) that additionally tells the caller the condition is
+    fleet-wide: the router has triggered coordinated brownout on the
+    replicas and scale-up, not retry, is the fix (docs/overload.md)."""
 
 
 class NonFiniteOutputError(ServingError):

@@ -133,12 +133,31 @@ class ServingMetrics:
                  # rewinds, quarantined input batches, and per-request
                  # non-finite serving outputs
                  "bad_steps", "rewinds", "quarantined_batches",
-                 "nonfinite_outputs")
+                 "nonfinite_outputs",
+                 # overload control (docs/overload.md): rejections by
+                 # the deadline-feasibility admission gate, rejections
+                 # of requests arriving at a crashed engine, slot
+                 # preemptions (+ their resumes), brownout entries, and
+                 # contained faults at the overload.* injection sites
+                 "rejected_infeasible", "rejected_crashed",
+                 "preemptions", "preempt_resumes", "brownouts",
+                 "overload_faults", "prefix_inserts_paused",
+                 # estimator denominator: tokens whose decode time IS
+                 # in the decode histogram (completed runs only —
+                 # preempted segments count toward tokens_generated
+                 # throughput but their wall time never reaches the
+                 # histogram, so they must not dilute per-token cost)
+                 "decode_tokens_observed")
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
         self._lock = threading.Lock()
         self.counters = {k: 0 for k in self._COUNTERS}
+        # overload observability (docs/overload.md): sheds keyed by
+        # (reason, priority class) and completions keyed by class —
+        # the per-class accounting graceful degradation is judged by
+        self.sheds_by = {}           # (reason, priority) -> count
+        self.served_by = {}          # priority -> count
         self.queue = LatencyHistogram()
         self.prefill = LatencyHistogram()
         self.decode = LatencyHistogram()
@@ -182,6 +201,17 @@ class ServingMetrics:
                 {"name": f"mxtpu_serving_{k}_total", "kind": "counter",
                  "labels": dict(eng), "value": v, "help": ""}
                 for k, v in self.counters.items()]
+            samples.extend(
+                {"name": "mxtpu_serving_sheds_total", "kind": "counter",
+                 "labels": {"engine": self.name, "reason": reason,
+                            "priority": prio},
+                 "value": v, "help": ""}
+                for (reason, prio), v in sorted(self.sheds_by.items()))
+            samples.extend(
+                {"name": "mxtpu_serving_served_total", "kind": "counter",
+                 "labels": {"engine": self.name, "priority": prio},
+                 "value": v, "help": ""}
+                for prio, v in sorted(self.served_by.items()))
             for phase, h in (("queue", self.queue),
                              ("prefill", self.prefill),
                              ("decode", self.decode),
@@ -197,6 +227,38 @@ class ServingMetrics:
     def count(self, key: str, n: int = 1):
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
+
+    def count_shed(self, reason: str, priority: str, n: int = 1):
+        """One shed, labeled by reason (``queue_full`` /
+        ``deadline_infeasible`` / ``priority_shed`` / ``brownout``) and
+        the victim's priority class."""
+        with self._lock:
+            k = (reason, priority)
+            self.sheds_by[k] = self.sheds_by.get(k, 0) + n
+
+    def count_served(self, priority: str, n: int = 1):
+        with self._lock:
+            self.served_by[priority] = self.served_by.get(priority, 0) + n
+
+    # ---------------------------------------------------------- estimators
+    def latency_estimates(self, min_count: int = 8):
+        """Admission-time latency estimators for the deadline-
+        feasibility gate (docs/overload.md), or ``None`` until the
+        phase histograms hold at least ``min_count`` completions:
+        ``(prefill_p50_s, decode_s_per_token, service_p50_s)`` where
+        ``service_p50`` is one request's scheduled-to-done median (the
+        per-wave queue-drain estimate)."""
+        with self._lock:
+            if (self.prefill.total < min_count
+                    or self.decode.total < min_count):
+                return None
+            toks = self.counters["decode_tokens_observed"]
+            if toks <= 0:
+                return None
+            prefill_p50 = self.prefill.percentile(50)
+            per_token = self.decode.sum / toks
+            service_p50 = prefill_p50 + self.decode.percentile(50)
+            return prefill_p50, per_token, service_p50
 
     def observe_request(self, queue_s: float, prefill_s: float,
                         decode_s: Optional[float] = None):
@@ -233,11 +295,16 @@ class ServingMetrics:
         # below only reshape the locked copies, so atomicity holds)
         with self._lock:
             c = dict(self.counters)
+            sheds_by = dict(self.sheds_by)
+            served_by = dict(self.served_by)
             lat = {"queue": self.queue.summary(),
                    "prefill": self.prefill.summary(),
                    "decode": self.decode.summary(),
                    "total": self.total.summary()}
             ttft = self.ttft.summary()
+        sheds_nested: dict = {}
+        for (reason, prio), v in sorted(sheds_by.items()):
+            sheds_nested.setdefault(reason, {})[prio] = v
         lookups = c["bucket_hits"] + c["compiles"]
         pref = c["prefix_hits"] + c["prefix_misses"]
         return {
@@ -269,6 +336,19 @@ class ServingMetrics:
                 if pref else None,
             },
             "ttft": ttft,
+            # per-class accounting of graceful degradation
+            # (docs/overload.md); the engine overlays its controller
+            # snapshot under stats()["overload"]["controller"]
+            "overload": {
+                "sheds": sheds_nested,
+                "served": served_by,
+                "rejected_infeasible": c["rejected_infeasible"],
+                "rejected_crashed": c["rejected_crashed"],
+                "preemptions": c["preemptions"],
+                "preempt_resumes": c["preempt_resumes"],
+                "brownouts": c["brownouts"],
+                "overload_faults": c["overload_faults"],
+            },
             "resilience": {k: c[k] for k in
                            ("retries", "watchdog_trips",
                             "checkpoint_commits", "resumes",

@@ -401,6 +401,10 @@ def test_route_and_failover_fault_sites_contained(net):
                 with pytest.raises(ServingError, match="went away"):
                     fleet._failover(req, cause)
             assert req.failovers_left == 5     # budget untouched
+            # ... and so is the fleet-wide retry token bucket: a
+            # faulted attempt must not starve other requests' retries
+            assert fleet._retry_budget.available \
+                == fleet._retry_budget.burst
             assert fleet.stats()["router"]["failover_faults"] == 1
 
 

@@ -1,0 +1,766 @@
+"""mxnet_tpu.serving.overload — overload control & graceful degradation.
+
+Contracts under test (docs/overload.md): the admission queue sheds
+lowest class first and never an ``interactive`` request while lower
+work is queued; infeasible deadlines reject ON ARRIVAL typed; the AIMD
+brownout controller degrades (token caps, paused inserts) before it
+refuses, and recovers; slot preemption parks a ``best_effort`` decode
+in the prefix pool and resumes it token-identically; the fleet retry
+budget and per-replica circuit breakers cap retry-storm amplification;
+hedged losers are actively cancelled; every submit() rejection path
+stamps exactly one counter and one trace event.
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import (CircuitBreaker, DeadlineInfeasibleError,
+                               DynamicBatcher, EngineCrashedError,
+                               InferenceEngine, InvalidRequestError,
+                               OverloadController, QueueFullError,
+                               RequestCancelledError, RequestTimeoutError,
+                               RetryBudget, ServingError)
+from mxnet_tpu.serving.engine import Request
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 97, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    kw.setdefault("prefix_pool_rows", 4)
+    kw.setdefault("prefix_min_tokens", 2)
+    return InferenceEngine(net, **kw)
+
+
+def _ref(net, p, n):
+    return net.generate(mx.nd.array(p[None], dtype="int32"), n,
+                        temperature=0).asnumpy()[0]
+
+
+def _seed_history(eng, n=10, prefill_s=0.01, decode_s=0.08, tokens=8):
+    """Give the deadline-admission gate a latency history without
+    running traffic: n completions at fixed phase latencies."""
+    for _ in range(n):
+        eng.metrics.observe_request(0.0, prefill_s, decode_s)
+    eng.metrics.count("tokens_generated", n * tokens)
+    eng.metrics.count("decode_tokens_observed", n * tokens)
+
+
+# ------------------------------------------------------------ queue units
+
+def test_priority_queue_orders_and_evicts():
+    q = DynamicBatcher(max_depth=3)
+    be = [Request("decode", onp.ones(4, "int32"), 2, priority=2)
+          for _ in range(2)]
+    ba = Request("decode", onp.ones(4, "int32"), 2, priority=1)
+    for r in be:
+        assert q.put(r) is None
+    assert q.put(ba) is None
+    assert len(q) == 3 and q.depth_at_or_above(1) == 1
+    # at depth: an interactive arrival evicts the YOUNGEST best_effort
+    ia = Request("decode", onp.ones(4, "int32"), 2, priority=0)
+    victim = q.put(ia)
+    assert victim is be[1] and len(q) == 3
+    # at depth with nothing strictly below: the arrival itself sheds
+    with pytest.raises(QueueFullError):
+        q.put(Request("decode", onp.ones(4, "int32"), 2, priority=2))
+    # batches form highest class first, FIFO within class
+    batch = q.get_batch(3, 0.0, wait=False)
+    assert [r.priority for r in batch] == [0, 1, 2]
+    assert batch[0] is ia and batch[2] is be[0]
+    # requeue puts a preempted request at the FRONT of its class
+    q2 = DynamicBatcher(max_depth=2)
+    first = Request("decode", onp.ones(4, "int32"), 2, priority=2)
+    q2.put(first)
+    resumed = Request("decode", onp.ones(5, "int32"), 1, priority=2)
+    q2.requeue(resumed)
+    assert q2.get_batch(1, 0.0, wait=False)[0] is resumed
+
+
+def test_eviction_skips_preempted_continuations():
+    """A preempted continuation's progress is parked in the prefix
+    pool — the MOST expensive queued work — so at-depth eviction skips
+    it and takes the youngest non-preempted request of the lowest
+    class instead; when only continuations are queued below, the
+    arrival sheds itself."""
+    q = DynamicBatcher(max_depth=3)
+    cont = Request("decode", onp.ones(6, "int32"), 2, priority=2)
+    cont.preempted = 1
+    q.requeue(cont)
+    fresh = Request("decode", onp.ones(4, "int32"), 2, priority=2)
+    q.put(fresh)
+    q.put(Request("decode", onp.ones(4, "int32"), 2, priority=1))
+    # at depth: the fresh best_effort is evicted, NOT the younger-
+    # positioned... rather, not the continuation (which sits in front)
+    victim = q.put(Request("decode", onp.ones(4, "int32"), 2, priority=0))
+    assert victim is fresh
+    assert cont in q.get_batch(4, 0.0, wait=False)
+    # queue full with ONLY continuations below the arrival: no victim
+    q3 = DynamicBatcher(max_depth=2)
+    for _ in range(2):
+        c = Request("decode", onp.ones(6, "int32"), 2, priority=2)
+        c.preempted = 1
+        q3.requeue(c)
+    with pytest.raises(QueueFullError):
+        q3.put(Request("decode", onp.ones(4, "int32"), 2, priority=0))
+
+
+def test_overload_controller_aimd():
+    c = OverloadController(capacity=8, interval=0.0, hold=0.05)
+    t = 100.0
+    assert c.factor == 1.0 and not c.brownout
+    # pressure: multiplicative decrease down to the floor
+    assert c.update(8, 0, now=t) is True          # 1.0 -> 0.5, entered
+    assert c.factor == 0.5 and c.brownout
+    c.update(8, 0, now=t + 0.01)
+    assert c.factor == 0.25                       # floor
+    c.update(8, 0, now=t + 0.02)
+    assert c.factor == 0.25                       # clamped
+    # hard shedding: lowest class only, at the floor, pressure recent
+    assert c.shedding(2, now=t + 0.03)
+    assert not c.shedding(1, now=t + 0.03)
+    assert not c.shedding(0, now=t + 0.03)
+    # token caps: interactive exempt, others scaled, never below 1
+    assert c.cap_tokens(0, 16) == 16
+    assert c.cap_tokens(1, 16) == 4
+    assert c.cap_tokens(2, 1) == 1
+    assert c.pause_inserts
+    # recovery: additive, only after hold elapses without pressure
+    c.update(0, 0, now=t + 0.04)                  # inside hold: no change
+    assert c.factor == 0.25
+    c.update(0, 0, now=t + 0.2)
+    assert c.factor == 0.5
+    for i in range(3):
+        c.update(0, 0, now=t + 0.3 + 0.1 * i)
+    assert c.factor == 1.0 and not c.brownout
+    assert not c.shedding(2, now=t + 1.0)
+    assert c.brownouts == 1
+    # a deadline miss alone is pressure, even with a shallow queue
+    assert c.update(0, 2, now=t + 2.0) is True
+    # force() slams to the floor (the fleet's coordinated brownout)
+    c2 = OverloadController(capacity=8)
+    c2.force(now=t)
+    assert c2.factor == c2.floor and c2.brownouts == 1
+    # disabled controller never moves
+    c3 = OverloadController(capacity=8, enabled=False)
+    c3.update(8, 5, now=t)
+    c3.force()
+    assert c3.factor == 1.0 and not c3.shedding(2)
+
+
+def test_retry_budget_token_bucket():
+    b = RetryBudget(rate=10.0, burst=2)
+    t = 50.0
+    assert b.try_acquire(now=t) and b.try_acquire(now=t)
+    assert not b.try_acquire(now=t)               # dry
+    assert b.denied == 1
+    assert b.try_acquire(now=t + 0.1)             # refilled 1 token
+    assert not b.try_acquire(now=t + 0.1)
+    # refill caps at burst
+    assert b.try_acquire(now=t + 100.0) and b.try_acquire(now=t + 100.0)
+    assert not b.try_acquire(now=t + 100.0)
+
+
+def test_circuit_breaker_open_halfopen_close():
+    br = CircuitBreaker(threshold=2, cooldown=0.5)
+    t = 10.0
+    assert br.allow(now=t) and br.state == "closed"
+    br.record_failure(now=t)
+    assert br.allow(now=t)                        # below threshold
+    br.record_failure(now=t)
+    assert not br.allow(now=t + 0.1) and br.opens == 1
+    assert br.allow(now=t + 0.6)                  # half-open probe
+    br.record_failure(now=t + 0.6)                # probe failed: re-open
+    assert not br.allow(now=t + 0.7)
+    br.record_success()
+    assert br.allow(now=t + 0.7) and br.state == "closed"
+    # half-open admits exactly ONE probe: concurrent callers are denied
+    # until the probe's outcome lands (or its caller vanishes for a
+    # full cooldown, forfeiting the slot)
+    br.record_failure(now=t + 1.0)
+    br.record_failure(now=t + 1.0)                # re-open
+    assert br.allow(now=t + 1.6)                  # the probe
+    assert not br.allow(now=t + 1.6)              # racing caller: denied
+    assert not br.allow(now=t + 1.7)
+    assert br.allow(now=t + 2.2)                  # probe vanished: forfeit
+    br.record_success()
+    assert br.allow(now=t + 2.2) and br.state == "closed"
+
+
+# -------------------------------------------------------- engine admission
+
+def test_priority_shed_lowest_first(net):
+    """Queue at depth: an interactive arrival evicts a queued
+    best_effort request (whose FUTURE fails typed) instead of being
+    shed itself; with only same-class work queued the arrival sheds."""
+    eng = _engine(net, queue_depth=3)            # not started: queue fills
+    p = _prompts((4,), seed=5)[0]
+    be_futs = [eng.submit(p, priority="best_effort") for _ in range(3)]
+    ia_fut = eng.submit(p, priority="interactive")
+    with pytest.raises(QueueFullError):
+        be_futs[-1].result(timeout=5)            # youngest victim evicted
+    assert not ia_fut.done()                     # the arrival is queued
+    assert not be_futs[0].done() and not be_futs[1].done()
+    # a same-class arrival has nothing strictly below it to evict in
+    # its own tier once the queue holds only be/ia — the best_effort
+    # arrival sheds ITSELF
+    with pytest.raises(QueueFullError):
+        eng.submit(p, priority="best_effort")
+    s = eng.stats()["overload"]
+    assert s["sheds"]["priority_shed"]["best_effort"] == 1
+    assert s["sheds"]["queue_full"]["best_effort"] == 1
+    with pytest.raises(InvalidRequestError):
+        eng.submit(p, priority="no_such_class")
+    eng.stop(drain=False)
+
+
+def test_deadline_infeasible_rejected_on_arrival(net):
+    """With latency history and a deep queue, a deadline the estimate
+    already overshoots rejects typed at submit — no queue slot burned;
+    a generous deadline still admits."""
+    eng = _engine(net, queue_depth=16)           # not started
+    _seed_history(eng, n=10, prefill_s=0.01, decode_s=0.08, tokens=8)
+    p = _prompts((4,), seed=6)[0]
+    for _ in range(6):                           # queue wait >> 10ms
+        eng.submit(p, priority="batch")
+    with pytest.raises(DeadlineInfeasibleError):
+        eng.submit(p, timeout=0.01, priority="batch")
+    assert eng.stats()["overload"]["rejected_infeasible"] == 1
+    assert eng.stats()["overload"]["sheds"][
+        "deadline_infeasible"]["batch"] == 1
+    # DeadlineInfeasibleError IS a deadline error to callers
+    assert issubclass(DeadlineInfeasibleError, RequestTimeoutError)
+    fut = eng.submit(p, timeout=60.0, priority="batch")
+    assert not fut.done()
+    # an interactive request waits only behind its own class: the same
+    # tight deadline stays feasible despite the batch backlog
+    fut2 = eng.submit(p, timeout=0.9, priority="interactive")
+    assert not fut2.done()
+    eng.stop(drain=False)
+
+
+def test_brownout_floor_sheds_and_caps(net):
+    """At the brownout floor, best_effort arrivals shed typed while
+    interactive admits; during brownout non-interactive token budgets
+    are capped at the factor."""
+    eng = _engine(net, queue_depth=8,            # not started
+                  overload_controller=OverloadController(8, hold=5.0))
+    p = _prompts((4,), seed=7)[0]
+    eng.force_brownout("test")
+    with pytest.raises(QueueFullError):
+        eng.submit(p, priority="best_effort")
+    s = eng.stats()["overload"]
+    assert s["sheds"]["brownout"]["best_effort"] == 1
+    assert s["controller"]["brownout"] and s["brownouts"] == 1
+    # capped: factor 0.25 of 8 = 2 tokens; interactive exempt
+    fut_b = eng.submit(p, max_new_tokens=8, priority="batch")
+    fut_i = eng.submit(p, max_new_tokens=8, priority="interactive")
+    eng.start()
+    assert len(fut_b.result(timeout=60)) == len(p) + 2
+    assert len(fut_i.result(timeout=60)) == len(p) + 8
+    eng.stop(timeout=60)
+
+
+def test_brownout_recovers_on_started_engine(net):
+    """The AIMD controller recovers to factor 1.0 on its own once the
+    queue drains (the scheduler ticks it every cycle)."""
+    eng = _engine(net).start()
+    eng.force_brownout("test")
+    assert eng.stats()["overload"]["controller"]["brownout"]
+    deadline = time.monotonic() + 10
+    while eng._overload.factor < 1.0:
+        assert time.monotonic() < deadline, eng.stats()["overload"]
+        time.sleep(0.02)
+    assert not eng.stats()["overload"]["controller"]["brownout"]
+    eng.stop(timeout=30)
+
+
+def test_brownout_pauses_prefix_inserts(net):
+    """During brownout the engine stops paying the insert row copy for
+    new prompts (counted), and resumes inserting after recovery."""
+    eng = _engine(net)
+    eng.warmup()
+    eng._overload.force()
+    p = _prompts((6,), seed=8)[0]
+    with eng:
+        eng.infer(p, max_new_tokens=2)
+        assert eng.metrics.counters["prefix_inserts_paused"] == 1
+        assert eng.metrics.counters["prefix_inserts"] == 0
+        # recovery re-enables inserts
+        deadline = time.monotonic() + 10
+        while eng._overload.factor < 1.0:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        eng.infer(p, max_new_tokens=2)
+        assert eng.metrics.counters["prefix_inserts"] == 1
+
+
+# ------------------------------------------------------------- preemption
+
+def test_preemption_parks_and_resumes_token_identical(net):
+    """An interactive arrival with every slot busy preempts a
+    best_effort decode: the victim's progress parks in the prefix
+    pool, it requeues, resumes via prefix hit, and every output —
+    preempted or not — is token-identical to net.generate."""
+    be_prompts = _prompts((6, 7), seed=9)
+    ia_prompt = _prompts((5,), seed=10)[0]
+    be_refs = [_ref(net, p, 16) for p in be_prompts]
+    ia_ref = _ref(net, ia_prompt, 2)
+    eng = _engine(net, num_slots=2, max_batch=2)
+    eng.warmup()
+    n_compiles = eng.metrics.counters["compiles"]
+    with eng:
+        be_futs = [eng.submit(p, max_new_tokens=16,
+                              priority="best_effort")
+                   for p in be_prompts]
+        # wait until both victims are decoding (past prefill)
+        deadline = time.monotonic() + 30
+        while eng.metrics.counters["decode_steps"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        ia_fut = eng.submit(ia_prompt, max_new_tokens=2,
+                            priority="interactive")
+        onp.testing.assert_array_equal(ia_ref, ia_fut.result(timeout=60))
+        for ref, f in zip(be_refs, be_futs):
+            onp.testing.assert_array_equal(ref, f.result(timeout=60))
+    s = eng.stats()
+    assert s["overload"]["preemptions"] >= 1
+    assert s["overload"]["preempt_resumes"] >= 1
+    # the resume came back through the prefix cache, not a full prefill
+    assert s["prefix_cache"]["prefix_hits"] >= 1
+    # and the whole storm compiled NOTHING new after warmup
+    assert s["compile_cache"]["compiles"] == n_compiles
+
+
+def test_preemption_disabled_leaves_victims_alone(net):
+    eng = _engine(net, num_slots=1, max_batch=1, preemption=False)
+    eng.warmup()
+    p_be, p_ia = _prompts((6, 5), seed=11)
+    with eng:
+        be = eng.submit(p_be, max_new_tokens=12, priority="best_effort")
+        deadline = time.monotonic() + 30
+        while eng.metrics.counters["decode_steps"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        ia = eng.submit(p_ia, max_new_tokens=2, priority="interactive")
+        be.result(timeout=60)
+        ia.result(timeout=60)
+    assert eng.stats()["overload"]["preemptions"] == 0
+
+
+# ----------------------------------------------------------- cancellation
+
+def test_cancel_queued_and_mid_decode(net):
+    # queued: dequeued and failed typed
+    eng = _engine(net, queue_depth=4)            # not started
+    p = _prompts((4,), seed=12)[0]
+    fut = eng.submit(p)
+    assert eng.cancel(fut) is True
+    with pytest.raises(RequestCancelledError):
+        fut.result(timeout=5)
+    assert len(eng._batcher) == 0
+    assert eng.metrics.counters["cancelled"] == 1
+    assert eng.cancel(fut) is False              # already resolved
+    eng.stop(drain=False)
+    # mid-decode: slot flagged reclaimable, freed by the scheduler
+    eng2 = _engine(net, num_slots=1, max_batch=1)
+    eng2.warmup()
+    with eng2:
+        f2 = eng2.submit(_prompts((6,), seed=13)[0], max_new_tokens=24)
+        deadline = time.monotonic() + 30
+        while eng2.metrics.counters["decode_steps"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        assert eng2.cancel(f2) is True
+        with pytest.raises(RequestCancelledError):
+            f2.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while eng2._alloc.active_count:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+
+
+def test_cancel_forward_mode_queued_only():
+    """Forward mode: a QUEUED request is cancellable; anything past
+    the queue is not — a popped forward batch resolves within the same
+    scheduler cycle, so cancel() reports False and must leak nothing
+    into the engine's cancel set (which no forward path ever sweeps)."""
+    from mxnet_tpu.gluon import nn
+    dense = nn.Dense(4, in_units=8)
+    dense.initialize()
+    eng = InferenceEngine(dense, max_batch=2, name="fwd_cancel")
+    assert eng.mode == "forward"
+    x = onp.zeros(8, "float32")
+    fut = eng.submit(x)                    # engine not started: queued
+    assert eng.cancel(fut) is True
+    with pytest.raises(RequestCancelledError):
+        fut.result(timeout=5)
+    eng.warmup(example_shape=(8,))
+    with eng:
+        f2 = eng.submit(x)
+        f2.result(timeout=60)
+        assert eng.cancel(f2) is False     # resolved — nothing to cancel
+        assert not eng._cancels            # and nothing leaked
+
+
+# ------------------------------------------------------- submit-path audit
+
+def test_every_rejection_stamps_one_counter_one_trace_event(net):
+    """Satellite contract: every submit() rejection — crashed, invalid,
+    queue-full shed, brownout shed, infeasible deadline — stamps
+    exactly ONE aggregate counter and ONE trace event, atomically from
+    the caller's perspective (no torn crashed-path, no double-counted
+    shed)."""
+    from mxnet_tpu.observability import trace as tr
+
+    tracer = tr.enable(capacity=512)
+    try:
+        p = _prompts((4,), seed=14)[0]
+
+        def audit(eng, fn, exc_type, counter, event, reason):
+            c0 = eng.metrics.counters[counter]
+            e0 = len([s for s in tracer.spans(name=event)
+                      if s.attrs.get("reason") == reason])
+            sub0 = eng.metrics.counters["submitted"]
+            with pytest.raises(exc_type):
+                fn()
+            assert eng.metrics.counters[counter] == c0 + 1, reason
+            e1 = len([s for s in tracer.spans(name=event)
+                      if s.attrs.get("reason") == reason])
+            assert e1 == e0 + 1, reason
+            return eng.metrics.counters["submitted"] - sub0
+
+        # crashed: counter + event now stamped BEFORE the raise
+        eng = _engine(net)
+        eng._crashed = EngineCrashedError("test corpse")
+        assert audit(eng, lambda: eng.submit(p), EngineCrashedError,
+                     "rejected_crashed", "serving.reject", "crashed") == 0
+        eng._crashed = None
+
+        # invalid (one representative path)
+        assert audit(eng, lambda: eng.submit(onp.zeros((2, 4), "int32")),
+                     InvalidRequestError, "rejected_invalid",
+                     "serving.reject", "invalid") == 0
+
+        # invalid priority: typed like every other bad input (a raw
+        # ValueError would escape the fleet's exception taxonomy)
+        assert audit(eng, lambda: eng.submit(p, priority="interactve"),
+                     InvalidRequestError, "rejected_invalid",
+                     "serving.reject", "invalid") == 0
+
+        # queue-full shed (counts submitted: it reached admission)
+        small = _engine(net, queue_depth=1)
+        small.submit(p)
+        assert audit(small, lambda: small.submit(p), QueueFullError,
+                     "rejected_queue_full", "serving.shed",
+                     "queue_full") == 1
+        small.stop(drain=False)
+
+        # brownout shed (valid request => counts submitted, so every
+        # shed reason shares the submitted denominator)
+        eng.force_brownout("test")
+        assert audit(eng, lambda: eng.submit(p, priority="best_effort"),
+                     QueueFullError, "rejected_queue_full",
+                     "serving.shed", "brownout") == 1
+        eng._overload.factor = 1.0
+
+        # infeasible deadline (also a valid request => submitted)
+        _seed_history(eng, n=10, prefill_s=0.01, decode_s=0.08)
+        for _ in range(6):
+            eng.submit(p)
+        assert audit(eng, lambda: eng.submit(p, timeout=0.01),
+                     DeadlineInfeasibleError, "rejected_infeasible",
+                     "serving.shed", "deadline_infeasible") == 1
+        eng.stop(drain=False)
+
+        # priority eviction: the VICTIM's shed is also exactly-once
+        ev = _engine(net, queue_depth=1)
+        victim = ev.submit(p, priority="best_effort")
+        e0 = len([s for s in tracer.spans(name="serving.shed")
+                  if s.attrs.get("reason") == "priority_shed"])
+        ev.submit(p, priority="interactive")
+        with pytest.raises(QueueFullError):
+            victim.result(timeout=5)
+        assert ev.metrics.counters["rejected_queue_full"] == 1
+        e1 = len([s for s in tracer.spans(name="serving.shed")
+                  if s.attrs.get("reason") == "priority_shed"])
+        assert e1 == e0 + 1
+        ev.stop(drain=False)
+    finally:
+        tr.disable()
+
+
+# ------------------------------------------------------------ fleet layer
+
+def _factory(net, **kw):
+    def factory(name):
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("seq_buckets", (8,))
+        kw.setdefault("default_max_new_tokens", 4)
+        kw.setdefault("prefix_pool_rows", 2)
+        kw.setdefault("prefix_min_tokens", 2)
+        kw.setdefault("watchdog_interval", 0.05)
+        return InferenceEngine(net, name=name, **kw)
+    return factory
+
+
+def test_retry_budget_caps_failover_amplification(net):
+    """A dry retry budget surfaces the ORIGINAL failure instead of
+    resubmitting — and the failover budget is spent exactly once per
+    actual resubmission, never double-counted."""
+    from mxnet_tpu.fleet import FleetRouter
+    from mxnet_tpu.fleet.router import _FleetRequest
+
+    fleet = FleetRouter(factory=_factory(net), num_replicas=2,
+                        name="budget_fleet", retry_budget_rate=0.0,
+                        retry_budget_burst=1, health_interval=10.0)
+    try:
+        p = _prompts((5,), seed=21)[0]
+        cause = EngineCrashedError("original crash")
+        req = _FleetRequest(p, "decode", 2, None, None, 5)
+        fleet._failover(req, cause)              # spends the only token
+        assert req.failovers_left == 4
+        req2 = _FleetRequest(p, "decode", 2, None, None, 5)
+        with pytest.raises(EngineCrashedError, match="original crash"):
+            fleet._failover(req2, cause)
+        assert req2.failovers_left == 5          # no budget spent
+        r = fleet.stats()["router"]
+        assert r["failovers"] == 1
+        assert r["retry_budget_exhausted"] == 1
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_failover_into_saturated_replica_keeps_deadline_semantics(net):
+    """Satellite contract: a request that fails over into a saturated
+    replica under a deadline surfaces its ORIGINAL deadline error
+    semantics (DeadlineInfeasibleError IS a RequestTimeoutError) —
+    never a silent re-queue past the deadline, never a laundered
+    queue-full, and the failover budget is charged exactly once."""
+    from mxnet_tpu.fleet import FleetRouter
+    from mxnet_tpu.fleet.router import _FleetRequest
+
+    fleet = FleetRouter(factory=_factory(net), num_replicas=2,
+                        name="sat_fleet", health_interval=10.0)
+    try:
+        p = _prompts((5,), seed=22)[0]
+        # replica A is a corpse; replica B saturated with history that
+        # makes a short deadline infeasible on arrival
+        a, b = fleet._handles
+        a.engine.condemn("test-induced crash")
+        _seed_history(b.engine, n=10, prefill_s=0.01, decode_s=0.08)
+        for _ in range(6):
+            b.engine.submit(p, priority="batch")
+        req = _FleetRequest(p, "decode", 4, None,
+                            time.monotonic() + 0.02, 2)
+        with pytest.raises(RequestTimeoutError):
+            fleet._failover(req, EngineCrashedError("mid-flight crash"))
+        assert req.failovers_left == 1           # charged exactly once
+        r = fleet.stats()["router"]
+        assert r["deadline_sheds"] >= 1
+        assert r.get("sheds", 0) == 0            # not laundered to shed
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_fleet_saturation_trips_coordinated_brownout(net):
+    """All replicas shedding repeatedly => FleetSaturatedError (a
+    QueueFullError subclass, so existing back-off handling holds) and
+    every replica's controller is forced to its brownout floor."""
+    from mxnet_tpu.fleet import FleetRouter, FleetSaturatedError
+
+    fleet = FleetRouter(factory=_factory(net, queue_depth=1),
+                        num_replicas=2, name="brown_fleet",
+                        saturation_threshold=2, breaker_threshold=50,
+                        health_interval=10.0)
+    try:
+        p = _prompts((5,), seed=23)[0]
+        for _ in range(2):                       # fill both queues
+            fleet.submit(p, max_new_tokens=2)
+        with pytest.raises(FleetSaturatedError):
+            fleet.submit(p, max_new_tokens=2)
+        assert not fleet._handles[0].engine._overload.brownout
+        with pytest.raises(QueueFullError):      # 2nd all-shed: trips
+            fleet.submit(p, max_new_tokens=2)
+        assert fleet.stats()["router"]["fleet_brownouts"] == 1
+        for h in fleet._handles:
+            assert h.engine._overload.factor == h.engine._overload.floor
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_saturation_requires_events_within_window(net):
+    """Coordinated brownout needs ``saturation_threshold`` all-shed
+    events inside ONE ``saturation_window`` — a trickle of one event
+    every window-minus-ε seconds must never read as a storm."""
+    from mxnet_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(factory=_factory(net), num_replicas=1,
+                        name="sat_window_fleet", saturation_threshold=3,
+                        saturation_window=1.0, health_interval=10.0)
+    try:
+        t = 100.0
+        assert not fleet._note_saturation(t)
+        assert not fleet._note_saturation(t + 0.9)
+        assert not fleet._note_saturation(t + 1.8)   # spans 1.8 s: no
+        assert fleet.stats()["router"].get("fleet_brownouts", 0) == 0
+        assert not fleet._note_saturation(t + 10.0)
+        assert not fleet._note_saturation(t + 10.1)
+        assert fleet._note_saturation(t + 10.2)      # 3 in 0.2 s: storm
+        assert fleet.stats()["router"]["fleet_brownouts"] == 1
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_circuit_breaker_skips_shedding_replica(net):
+    """Consecutive sheds open a replica's breaker: the router stops
+    submitting to it (breaker_skips counted) until the cooldown."""
+    from mxnet_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(factory=_factory(net, queue_depth=1),
+                        num_replicas=2, name="breaker_fleet",
+                        breaker_threshold=2, breaker_cooldown=30.0,
+                        routing="least_loaded", health_interval=10.0)
+    try:
+        p = _prompts((5,), seed=24)[0]
+        for _ in range(2):
+            fleet.submit(p, max_new_tokens=2)
+        for _ in range(2):                       # open both breakers
+            with pytest.raises(QueueFullError):
+                fleet.submit(p, max_new_tokens=2)
+        r = fleet.stats()["router"]
+        assert r["sheds"] >= 2
+        with pytest.raises(QueueFullError):
+            fleet.submit(p, max_new_tokens=2)
+        assert fleet.stats()["router"]["breaker_skips"] >= 1
+        assert all(h.breaker.state == "open" for h in fleet._handles)
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_priority_evicted_attempt_fails_over(net):
+    """A fleet request whose QUEUED attempt is priority-evicted on its
+    replica (QueueFullError lands on the inner future asynchronously)
+    must fail over to another replica within the normal budgets — not
+    surface the raw eviction to the caller while siblings have room."""
+    from mxnet_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(factory=_factory(net, queue_depth=2),
+                        num_replicas=2, name="evict_fleet",
+                        routing="least_loaded", health_interval=10.0)
+    try:
+        p = _prompts((5,), seed=25)[0]
+        fut = fleet.submit(p, max_new_tokens=2, priority="best_effort")
+        victim_h, victim_f = fut._attempts[0]
+        # land interactive arrivals on the victim's replica until the
+        # queued best_effort attempt is evicted (engines are not
+        # running, so the queue never drains underneath us)
+        for _ in range(4):
+            try:
+                victim_h.engine.submit(p, max_new_tokens=2,
+                                       priority="interactive")
+            except QueueFullError:
+                break
+        assert victim_f.done()            # evicted, exception pending
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=0.3)       # re-placed attempt can't
+            # finish (replicas aren't running) — but it must NOT raise
+            # the eviction's QueueFullError
+        r = fleet.stats()["router"]
+        assert r["eviction_failovers"] == 1
+        assert r["failovers"] == 1
+        assert r.get("sheds", 0) == 0     # not laundered into a shed
+        (h2, f2), = fut._attempts         # now waiting on the sibling
+        assert h2 is not victim_h and not f2.done()
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+def test_hedged_loser_actively_cancelled(net):
+    """Satellite contract: when the first copy of a hedged request
+    completes, the loser is CANCELLED — dequeued (or its slot
+    reclaimed) — and counted as hedges_wasted, instead of running to
+    completion."""
+    from mxnet_tpu.fleet import FleetRouter
+
+    fleet = FleetRouter(factory=_factory(net), num_replicas=2,
+                        name="hedge_fleet", hedge_after=0.0,
+                        health_interval=10.0)
+    try:
+        p = _prompts((6,), seed=25)[0]
+        ref = _ref(net, p, 3)
+        fut = fleet.submit(p, max_new_tokens=3)   # engines NOT started
+        primary = fut._attempts[0][0]
+        fut._maybe_hedge(time.monotonic())        # duplicates onto peer
+        assert len(fut._attempts) == 2
+        loser_h, loser_f = [(h, f) for h, f in fut._attempts
+                            if h is primary][0]
+        winner_h = [h for h, _f in fut._attempts if h is not primary][0]
+        winner_h.engine.warmup()                  # only the hedge runs
+        winner_h.engine.start()
+        onp.testing.assert_array_equal(ref, fut.result(timeout=60))
+        r = fleet.stats()["router"]
+        assert r["hedges"] == 1
+        assert r["hedges_wasted"] == 1
+        # the loser's queued copy is GONE and resolved typed
+        assert len(loser_h.engine._batcher) == 0
+        with pytest.raises(RequestCancelledError):
+            loser_f.result(timeout=5)
+        # the reaped loser also left the attempt list, so a REPEAT
+        # result() call sees the winner's value — never the loser's
+        # RequestCancelledError
+        assert len(fut._attempts) == 1
+        onp.testing.assert_array_equal(ref, fut.result(timeout=5))
+    finally:
+        for h in fleet._handles:
+            h.engine.stop(drain=False)
+
+
+# ------------------------------------------------------------- observability
+
+def test_overload_metrics_exported_with_labels(net):
+    from mxnet_tpu.observability import flatten
+
+    eng = _engine(net, queue_depth=1, name="ovl_metrics")
+    p = _prompts((4,), seed=26)[0]
+    eng.submit(p)
+    with pytest.raises(QueueFullError):
+        eng.submit(p, priority="best_effort")
+    flat = flatten(prefix="mxtpu_serving")
+    key = ('mxtpu_serving_sheds_total{engine="ovl_metrics",'
+           'priority="best_effort",reason="queue_full"}')
+    assert flat[key] == 1
+    assert flat['mxtpu_serving_overload_factor{engine="ovl_metrics"}'] \
+        == 1.0
+    # zero-valued samples are dropped from flatten(): no brownout
+    assert flat.get('mxtpu_serving_brownout{engine="ovl_metrics"}',
+                    0) == 0
+    eng.stop(drain=False)
+    s = eng.stats()
+    assert s["overload"]["controller"]["enabled"]
+    assert s["engine"]["default_priority"] == "batch"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import shutil
 import signal
@@ -162,6 +163,8 @@ def serving_scenarios(net):
         ("exporter_storm", lambda: serving_exporter_storm(net)),
         ("replica_kill", lambda: fleet_replica_kill(net)),
         ("rolling_restart", lambda: fleet_rolling_restart(net)),
+        ("overload_storm", lambda: serving_overload_storm(net)),
+        ("retry_storm", lambda: fleet_retry_storm(net)),
     ]
 
 
@@ -424,6 +427,240 @@ def serving_prefix_storm(net):
                    "prefix": s["prefix_cache"],
                    "faults_fired": plan.fired(),
                    "prefix_disabled": s["engine"]["prefix_disabled"]},
+    }
+
+
+def serving_overload_storm(net):
+    """Overload chaos (docs/overload.md): 3x sustained overload at
+    mixed priority classes through one engine.  Invariants: ZERO
+    ``interactive``-class sheds (eviction always finds lower-class
+    victims) and every interactive request completes; 100% of SERVED
+    requests meet their deadlines (zero timeouts — infeasible work is
+    rejected on arrival, admitted work finishes in time); at least one
+    ``best_effort`` request is PREEMPTED mid-decode and resumes via
+    prefix hit with token-identical output (every completed output is
+    an exact prefix of its fault-free ``net.generate`` reference —
+    brownout may cap budgets, never corrupt tokens); the controller
+    enters brownout under the storm and LIFTS it after (factor back to
+    1.0); a post-storm shared-prefix wave sees the hit rate recover
+    with zero sheds; and the storm compiles NOTHING after warmup."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    rs = onp.random.RandomState(7)
+    eng = _engine(net, queue_depth=6, prefix_pool_rows=4,
+                  prefix_min_tokens=4, default_max_new_tokens=4)
+    n_warm = eng.warmup()
+    # distinct prompts (no accidental prefix sharing at >= 4 tokens)
+    def mk(l):
+        return rs.randint(0, 61, (l,)).astype("int32")
+    ref_of = {}
+
+    def ref(p, n):
+        key = (tuple(int(t) for t in p), n)
+        if key not in ref_of:
+            ref_of[key] = net.generate(mx.nd.array(p[None], dtype="int32"),
+                                       n, temperature=0).asnumpy()[0]
+        return ref_of[key]
+
+    outcomes = {"ok": 0, "shed": 0, "timeout": 0, "infeasible": 0,
+                "mismatch": 0, "other": 0}
+    ia_bad = 0
+    with eng:
+        # phase 1 — steady state: builds the latency history the
+        # deadline-admission gate estimates from
+        for i in range(8):
+            p = mk(5 + (i % 3))
+            out = eng.infer(p, max_new_tokens=4, priority="batch")
+            if not onp.array_equal(out, ref(p, 4)):
+                outcomes["mismatch"] += 1
+        # phase 2 — the storm: first occupy both slots with long
+        # best_effort decodes (the preemption victims) ...
+        storm = []
+        d0 = eng.metrics.counters["decode_steps"]
+        for _i in range(2):
+            p = mk(6)
+            storm.append(("best_effort", p, 8,
+                          eng.submit(p, max_new_tokens=8, timeout=30.0,
+                                     priority="best_effort")))
+        deadline = time.monotonic() + 30
+        # ... and wait until they are actually DECODING in slots (the
+        # counter moved past its phase-1 baseline), so the storm finds
+        # them preemptible instead of evicting them while still queued
+        while eng.metrics.counters["decode_steps"] <= d0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.002)
+        # ... then 3x capacity of interleaved mixed-class arrivals.
+        # SUSTAINED overload, not one burst: interactive arrivals are
+        # paced below service capacity (at most 4 outstanding — less
+        # than the queue depth), which is exactly the regime where
+        # "zero interactive sheds" must hold — the queue can never go
+        # all-interactive, so an arriving interactive always finds
+        # space or a lower-class victim.
+        classes = ("best_effort", "batch", "interactive") * 8
+        ia_open = []
+        for i, cls in enumerate(classes):
+            p = mk(5 + (i % 4))
+            n = 2 if cls == "interactive" else 6
+            if cls == "interactive":
+                ia_open = [f for f in ia_open if not f.done()]
+                while len(ia_open) >= 4 and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                    ia_open = [f for f in ia_open if not f.done()]
+            try:
+                f = eng.submit(p, max_new_tokens=n, timeout=30.0,
+                               priority=cls)
+                storm.append((cls, p, n, f))
+                if cls == "interactive":
+                    ia_open.append(f)
+            except Exception as e:
+                from mxnet_tpu.serving import (DeadlineInfeasibleError,
+                                               QueueFullError)
+                if isinstance(e, DeadlineInfeasibleError):
+                    outcomes["infeasible"] += 1
+                elif isinstance(e, QueueFullError):
+                    outcomes["shed"] += 1
+                else:
+                    outcomes["other"] += 1
+                if cls == "interactive":
+                    ia_bad += 1
+        for cls, p, n, f in storm:
+            from mxnet_tpu.serving import (QueueFullError,
+                                           RequestTimeoutError)
+            try:
+                out = f.result(timeout=60)
+            except RequestTimeoutError:
+                outcomes["timeout"] += 1
+                if cls == "interactive":
+                    ia_bad += 1
+                continue
+            except QueueFullError:
+                outcomes["shed"] += 1       # evicted by a higher class
+                if cls == "interactive":
+                    ia_bad += 1
+                continue
+            except Exception:
+                outcomes["other"] += 1
+                if cls == "interactive":
+                    ia_bad += 1
+                continue
+            r = ref(p, n)
+            # brownout may CAP a budget (shorter output) but must never
+            # corrupt tokens: every completed output is an exact prefix
+            if len(out) > len(r) or \
+                    not onp.array_equal(out, r[:len(out)]) or \
+                    len(out) <= len(p):
+                outcomes["mismatch"] += 1
+            else:
+                outcomes["ok"] += 1
+        mid = eng.stats()
+        # phase 3 — recovery: the brownout must LIFT unaided ...
+        deadline = time.monotonic() + 20
+        while eng._overload.factor < 1.0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        recovered = eng._overload.factor == 1.0
+        # ... and a shared-prefix wave sees the cache working again
+        shared = mk(10)
+        hits0 = eng.metrics.counters["prefix_hits"]
+        wave_bad = 0
+        for _i in range(6):
+            p = onp.concatenate([shared, mk(3)])
+            try:
+                out = eng.infer(p, max_new_tokens=3, priority="batch")
+                if not onp.array_equal(out, ref(p, 3)):
+                    wave_bad += 1
+            except Exception:
+                wave_bad += 1
+        hit_recovered = eng.metrics.counters["prefix_hits"] > hits0
+        s = eng.stats()
+        eng.stop(timeout=30)
+    _join_zombies()
+    ia_sheds = sum(v.get("interactive", 0)
+                   for v in s["overload"]["sheds"].values())
+    passed = (ia_bad == 0 and ia_sheds == 0
+              and outcomes["timeout"] == 0     # served => deadline met
+              and outcomes["mismatch"] == 0 and outcomes["other"] == 0
+              and s["overload"]["preemptions"] >= 1
+              and s["overload"]["preempt_resumes"] >= 1
+              and s["prefix_cache"]["prefix_hits"] >= 1
+              and mid["overload"]["brownouts"] >= 1
+              and recovered and hit_recovered and wave_bad == 0
+              and s["compile_cache"]["compiles"] == n_warm)
+    return {
+        "name": "serving/overload_storm",
+        "passed": bool(passed),
+        "detail": {"outcomes": outcomes,
+                   "interactive_failures": ia_bad,
+                   "interactive_sheds": ia_sheds,
+                   "overload": s["overload"],
+                   "brownout_lifted": recovered,
+                   "hit_rate_recovered": hit_recovered,
+                   "wave_failures": wave_bad,
+                   "compiles_after_warmup":
+                       s["compile_cache"]["compiles"] - n_warm},
+    }
+
+
+def fleet_retry_storm(net):
+    """Retry-storm chaos (docs/overload.md): a replica CRASHES while
+    the whole fleet is saturated.  Invariants: the token-bucket retry
+    budget CAPS failover amplification (failovers never exceed
+    burst + refill; at least one resubmission is DENIED and surfaces
+    the original typed error) — no thundering herd — and every
+    submitted request still resolves (result or typed error, zero
+    stranded)."""
+    import numpy as onp
+
+    from mxnet_tpu.resilience import FaultPlan
+
+    rs = onp.random.RandomState(11)
+    prompts = [rs.randint(0, 61, (5 + (i % 3),)).astype("int32")
+               for i in range(18)]
+    fleet = _fleet(net, n=3, name="chaos_retry", routing="least_loaded",
+                   retry_budget_rate=0.5, retry_budget_burst=2,
+                   max_failovers=3, probation=20.0)
+    fleet.warmup()
+    plan = FaultPlan().raise_at("serving.scheduler", at=10)
+    accepted = rejected = 0
+    futs = []
+    t0 = time.monotonic()
+    with plan:
+        with fleet:
+            for p in prompts:
+                try:
+                    futs.append(fleet.submit(p, max_new_tokens=3,
+                                             timeout=20.0))
+                    accepted += 1
+                except Exception:
+                    rejected += 1       # typed shed at submit: fine
+            ok, typed, stranded = _resolve_all(futs, timeout=60)
+            r = fleet.stats()["router"]
+    storm_s = time.monotonic() - t0
+    _join_zombies()
+    failovers = r.get("failovers", 0)
+    denied = r.get("retry_budget_exhausted", 0)
+    deaths = r.get("replica_deaths", 0)
+    # budget bound: burst (2) + whatever refilled (rate 0.5/s) over the
+    # MEASURED storm window — wall-clock-aware so a slow host can't
+    # fail a correct run, yet the cap is still the token bucket's
+    max_failovers_allowed = 2 + math.ceil(0.5 * storm_s)
+    passed = (stranded == 0 and (ok + typed) == accepted
+              and deaths >= 1 and failovers <= max_failovers_allowed
+              and denied >= 1
+              and plan.fired("serving.scheduler") == 1)
+    return {
+        "name": "fleet/retry_storm",
+        "passed": bool(passed),
+        "detail": {"requests": len(prompts), "accepted": accepted,
+                   "rejected_at_submit": rejected, "ok": ok,
+                   "typed_errors": typed, "stranded": stranded,
+                   "replica_deaths": deaths, "failovers": failovers,
+                   "failover_bound": max_failovers_allowed,
+                   "storm_window_s": round(storm_s, 2),
+                   "retry_budget_denied": denied,
+                   "router": r,
+                   "faults_fired": plan.fired()},
     }
 
 
