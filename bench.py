@@ -265,6 +265,99 @@ def bench_guardrails(on_tpu: bool, batch_override=None) -> dict:
     return rec
 
 
+# ------------------------------------------------------ checkpoint integrity
+
+def bench_checkpoint(on_tpu: bool, batch_override=None) -> dict:
+    """Verified-checkpoint overhead (docs/integrity.md).
+
+    Every ``AtomicCheckpointer.save`` now digests the written files into
+    ``MANIFEST.json`` before the commit rename, every ``restore``
+    re-hashes before deserializing, and ``_gc`` verifies-or-skips before
+    collecting.  This record times a RETENTION-SHAPED cycle — three
+    saves under ``max_to_keep=2`` (so GC actually fires and pays its
+    newest-step re-verification, the way a ResilientLoop run does) plus
+    one restore — against a manifest-less floor that performs the
+    IDENTICAL atomic mechanics (tmp dir + state + meta + rename commit +
+    blind retention GC + load) minus every digest — i.e. the
+    pre-integrity checkpointer; ``value`` is the verification overhead
+    in percent of the floor, expected within trial noise (compare with
+    ``spread_pct``): digests are chunk-parallel BLAKE2b, so the
+    serialize/IO cost dominates.
+    """
+    import json as _json
+    import os
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import AtomicCheckpointer
+    from mxnet_tpu.utils import serialization as ser
+
+    # a state dict shaped like what a ResilientLoop commit actually
+    # moves: dozens of tensors sized to the tier's model (the CPU
+    # fallback benches the REDUCED models, same convention as every
+    # other workload here — the code path, not the scale)
+    n_arrays, rows = (48, 1 << 16) if on_tpu else (24, 1 << 11)
+    rs = onp.random.RandomState(0)
+    tree = {f"param:block{i}.w": mx.nd.array(
+        rs.randn(rows, 16).astype("float32")) for i in range(n_arrays)}
+
+    def floor_roundtrip(d):
+        """The pre-integrity atomic path: same writes, same rename
+        commits, same blind retention GC, same reads — no manifest, no
+        verification."""
+        kept = []
+        for s in (1, 2, 3):
+            tmp = os.path.join(d, f".tmp-{s}")
+            os.makedirs(tmp)
+            ser.save(os.path.join(tmp, "state.mxtpu"), tree)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                _json.dump({"step": s}, f)
+            final = os.path.join(d, f"step-{s:08d}")
+            os.rename(tmp, final)
+            kept.append(final)
+            while len(kept) > 2:       # the old blind _gc
+                shutil.rmtree(kept.pop(0), ignore_errors=True)
+        out = ser.load(os.path.join(kept[-1], "state.mxtpu"))
+        with open(os.path.join(kept[-1], "meta.json")) as f:
+            _json.load(f)
+        return out
+
+    trials_v, trials_f = [], []
+    workdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        for t in range(5):
+            ck = AtomicCheckpointer(os.path.join(workdir, f"v{t}"),
+                                    max_to_keep=2)
+            t0 = time.perf_counter()
+            for s in (1, 2, 3):
+                ck.save(s, tree)
+            ck.restore()
+            trials_v.append(time.perf_counter() - t0)
+            fd = os.path.join(workdir, f"f{t}")
+            os.makedirs(fd)
+            t0 = time.perf_counter()
+            floor_roundtrip(fd)
+            trials_f.append(time.perf_counter() - t0)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    # drop the first (cold-cache) trial of each side, then medians
+    v = _median(sorted(trials_v[1:]))
+    f = _median(sorted(trials_f[1:]))
+    overhead_pct = 100.0 * (v - f) / f if f else 0.0
+    rec = _record("checkpoint_verified_overhead", overhead_pct, "%", 0.0)
+    rec["vs_baseline"] = None            # a ratio, not an MFU claim
+    rec["value"] = round(overhead_pct, 2)
+    rec["verified_cycle_ms"] = round(v * 1e3, 2)       # 3 saves + gc + restore
+    rec["floor_cycle_ms"] = round(f * 1e3, 2)
+    rec["verified_trials_ms"] = [round(x * 1e3, 2) for x in trials_v]
+    rec["floor_trials_ms"] = [round(x * 1e3, 2) for x in trials_f]
+    rec["spread_pct"] = round(max(
+        100.0 * (max(xs[1:]) - min(xs[1:])) / _median(sorted(xs[1:]))
+        for xs in (trials_v, trials_f)), 2)
+    return rec
+
+
 # --------------------------------------------------------------- ResNet-50
 
 def bench_resnet50(on_tpu: bool, batch_override=None) -> dict:
@@ -509,7 +602,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="gpt2",
                     choices=["gpt2", "gpt2_long", "resnet50", "resnet50_io",
-                             "bert", "nmt", "guardrails", "all"])
+                             "bert", "nmt", "guardrails", "checkpoint",
+                             "all"])
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of each workload "
                          "into DIR (for the on-chip where-does-time-go "
@@ -523,12 +617,13 @@ def main():
         amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
 
     names = (["resnet50", "resnet50_io", "bert", "nmt", "guardrails",
-              "gpt2_long", "gpt2"]
+              "checkpoint", "gpt2_long", "gpt2"]
              if args.workload == "all" else [args.workload])
     table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
              "resnet50": bench_resnet50, "resnet50_io": bench_resnet50_io,
              "bert": bench_bert, "nmt": bench_nmt,
-             "guardrails": bench_guardrails}
+             "guardrails": bench_guardrails,
+             "checkpoint": bench_checkpoint}
     import contextlib
     import os
     for name in names:

@@ -8,9 +8,11 @@ placement (rendezvous-hash the shared prompt prefix so a prompt
 family's requests land on the replica that already caches it —
 multiplying the single-engine prefix-cache TTFT win across the fleet),
 health-gated load balancing with probation/backoff re-admission,
-bounded failover of crash-failed requests within the original deadline,
-and rolling drain/restart for zero-downtime upgrades.  See
-docs/fleet.md.
+gray-failure ejection (a replica that answers ``health()`` but serves
+far slower than its peers' median goes ``SUSPECT`` — unroutable but
+alive, re-admitted without a rebuild; docs/integrity.md), bounded
+failover of crash-failed requests within the original deadline, and
+rolling drain/restart for zero-downtime upgrades.  See docs/fleet.md.
 
 Quick start::
 
@@ -27,7 +29,8 @@ Quick start::
 from ..serving.errors import FleetSaturatedError, NoHealthyReplicaError
 from ..serving.overload import CircuitBreaker, RetryBudget
 from .policy import RoutingPolicy, rendezvous_hash, rendezvous_rank
-from .replica import DEAD, DRAINING, HEALTHY, STOPPED, ReplicaHandle
+from .replica import (DEAD, DRAINING, HEALTHY, STOPPED, SUSPECT,
+                      ReplicaHandle)
 from .router import FleetFuture, FleetRouter
 
 __all__ = [
@@ -35,5 +38,5 @@ __all__ = [
     "rendezvous_hash", "rendezvous_rank",
     "NoHealthyReplicaError", "FleetSaturatedError",
     "RetryBudget", "CircuitBreaker",
-    "HEALTHY", "DEAD", "DRAINING", "STOPPED",
+    "HEALTHY", "DEAD", "DRAINING", "STOPPED", "SUSPECT",
 ]

@@ -6,6 +6,19 @@ A replica is always in exactly one state:
 - ``HEALTHY`` — routable.  The monitor polls ``engine.health()``; the
   first not-live probe (scheduler died, watchdog condemned, crashed)
   moves it to ``DEAD``.
+- ``SUSPECT`` — not routable, but alive: the gray-failure state
+  (docs/integrity.md).  The router feeds every completion's latency
+  into the handle's :class:`~mxnet_tpu.resilience.integrity.LatencyTracker`;
+  a replica whose EWMA *and* windowed p99 sit a configurable multiple
+  above its peers' median — slow enough to hurt, healthy enough to keep
+  passing ``health()`` — is ejected here.  Unlike ``DEAD`` the engine
+  keeps running and FINISHES its in-flight work; new placement skips it
+  exactly like a dead replica (so its HRW keyspace remaps ~1/N onto
+  the healthy rest).  Re-admission rides the same probation/backoff
+  ladder as deaths but WITHOUT a rebuild: when the window elapses the
+  latency window is reset and the replica returns to ``HEALTHY`` — its
+  warm caches intact, so re-admission costs zero compiles.  A SUSPECT
+  that then fails ``health()`` goes ``DEAD`` normally.
 - ``DEAD`` — not routable; sitting out a probation window.  The window
   starts at ``probation`` seconds and doubles per consecutive death
   (capped at ``probation_max``): a replica that crashes right back
@@ -33,14 +46,17 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..resilience.integrity import LatencyTracker
 from ..serving.overload import CircuitBreaker
 
-__all__ = ["ReplicaHandle", "HEALTHY", "DEAD", "DRAINING", "STOPPED"]
+__all__ = ["ReplicaHandle", "HEALTHY", "DEAD", "DRAINING", "STOPPED",
+           "SUSPECT"]
 
 HEALTHY = "healthy"
 DEAD = "dead"
 DRAINING = "draining"
 STOPPED = "stopped"
+SUSPECT = "suspect"
 
 
 class ReplicaHandle:
@@ -50,7 +66,9 @@ class ReplicaHandle:
                  probation_backoff: float = 2.0,
                  probation_max: float = 30.0,
                  restart_warmup: bool = True,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 latency_window: int = 64,
+                 latency_alpha: float = 0.25):
         self.name = name
         self.engine = engine
         self.factory = factory
@@ -71,6 +89,14 @@ class ReplicaHandle:
         self.routed = 0              # requests placed here (router-counted)
         self.probation_until: Optional[float] = None
         self.last_error: Optional[str] = None
+        # gray-failure defense (docs/integrity.md): the router feeds
+        # per-completion latencies here; the monitor compares this
+        # window against its peers' median and SUSPECT-ejects outliers
+        self.latency = LatencyTracker(window=latency_window,
+                                      alpha=latency_alpha)
+        self.suspects = 0            # consecutive gray ejections (ladder)
+        self.total_suspects = 0
+        self.suspect_until: Optional[float] = None
         self._lock = threading.Lock()
 
     # ---------------------------------------------------------------- state
@@ -105,17 +131,20 @@ class ReplicaHandle:
 
     # --------------------------------------------------------------- deaths
     def mark_dead(self, reason: str, now: Optional[float] = None) -> bool:
-        """HEALTHY → DEAD with a fresh probation window; returns whether
-        this call made the transition (the monitor and a failing submit
-        path may race to report the same corpse)."""
+        """HEALTHY/SUSPECT → DEAD with a fresh probation window; returns
+        whether this call made the transition (the monitor and a failing
+        submit path may race to report the same corpse).  A SUSPECT that
+        actually dies goes DEAD normally — gray ejection never shields a
+        real corpse from the rebuild path."""
         now = time.monotonic() if now is None else now
         with self._lock:
-            if self.state != HEALTHY:
+            if self.state not in (HEALTHY, SUSPECT):
                 return False
             self.state = DEAD
             self.deaths += 1
             self.total_deaths += 1
             self.last_error = reason
+            self.suspect_until = None
             window = min(self.probation_max, self.probation *
                          self.probation_backoff ** (self.deaths - 1))
             self.probation_until = now + window
@@ -124,8 +153,9 @@ class ReplicaHandle:
     def probe(self, now: Optional[float] = None) -> bool:
         """One monitor tick: returns True iff this probe transitioned
         the replica to DEAD.  A healthy probe resets the consecutive-
-        death streak (the backoff ladder restarts)."""
-        if self.state != HEALTHY:
+        death streak (the backoff ladder restarts).  SUSPECT replicas
+        are probed too — slow is survivable, dead is not."""
+        if self.state not in (HEALTHY, SUSPECT):
             return False
         try:
             h = self.engine.health()
@@ -134,9 +164,54 @@ class ReplicaHandle:
         except Exception as e:            # a broken probe IS a dead replica
             live, reason = False, f"health() raised: {e!r}"
         if live:
-            self.deaths = 0
+            if self.state == HEALTHY:
+                self.deaths = 0
             return False
         return self.mark_dead(str(reason), now)
+
+    # ----------------------------------------------------- gray failure
+    def observe_latency(self, seconds: float) -> None:
+        """One completed request's latency (router completion path)."""
+        self.latency.observe(seconds)
+
+    def mark_suspect(self, reason: str,
+                     now: Optional[float] = None) -> bool:
+        """HEALTHY → SUSPECT: stop offering this replica traffic but let
+        it finish what it holds.  The suspension window rides the same
+        probation/backoff ladder as deaths, keyed on CONSECUTIVE gray
+        ejections, so a replica that is still slow on every re-admission
+        backs off instead of flapping its keyspace."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state != HEALTHY:
+                return False
+            self.state = SUSPECT
+            self.suspects += 1
+            self.total_suspects += 1
+            self.last_error = reason
+            window = min(self.probation_max, self.probation *
+                         self.probation_backoff ** (self.suspects - 1))
+            self.suspect_until = now + window
+            return True
+
+    def due_for_unsuspect(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return (self.state == SUSPECT and self.suspect_until is not None
+                and now >= self.suspect_until)
+
+    def unsuspect(self) -> bool:
+        """Suspension elapsed: return to HEALTHY with a RESET latency
+        window — the replica is judged on fresh samples, not the storm
+        that ejected it.  No rebuild, no re-warm: the engine never
+        stopped, so its compiled programs and prefix cache are still
+        warm and re-admission costs zero compiles on traffic."""
+        with self._lock:
+            if self.state != SUSPECT:
+                return False
+            self.state = HEALTHY
+            self.suspect_until = None
+        self.latency.reset()
+        return True
 
     def due_for_readmission(self, now: Optional[float] = None) -> bool:
         now = time.monotonic() if now is None else now
@@ -189,6 +264,8 @@ class ReplicaHandle:
             self.state = HEALTHY
             self.restarts += 1
             self.probation_until = None
+            self.suspect_until = None
+        self.latency.reset()       # fresh engine, fresh evidence
         # a rebuilt replica starts with a CLOSED breaker: its fresh,
         # empty queue owes nothing to the corpse's shed streak
         self.breaker.record_success()
@@ -210,4 +287,6 @@ class ReplicaHandle:
 
     def __repr__(self):
         return (f"ReplicaHandle({self.name!r}, state={self.state}, "
-                f"deaths={self.total_deaths}, restarts={self.restarts})")
+                f"deaths={self.total_deaths}, "
+                f"suspects={self.total_suspects}, "
+                f"restarts={self.restarts})")

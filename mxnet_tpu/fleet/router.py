@@ -19,6 +19,19 @@ swap one import — the router exposes the same ``infer`` / ``submit`` /
   with exponential backoff — rebuilt fresh via the engine ``factory``
   (a condemned engine cannot be restarted) and re-warmed so it never
   compiles on live traffic.
+- **Gray-failure ejection** (docs/integrity.md): binary liveness misses
+  the replica that answers ``health()`` but serves 10x slow.  The
+  router feeds each completion's latency into the owning replica's
+  :class:`~mxnet_tpu.resilience.integrity.LatencyTracker`; the monitor
+  compares EWMA + windowed p99 against the median of its PEERS
+  (self-excluded, so an outlier cannot inflate its own bar) and moves
+  outliers (``gray_multiplier`` above that median, with
+  ``gray_min_samples`` evidence) to ``SUSPECT`` — HRW-skipped like a
+  dead replica but still finishing its in-flight work, re-admitted
+  through the probation/backoff ladder WITHOUT a rebuild once its
+  window clears (warm caches: zero compiles on re-admission).  SUSPECT
+  is never saturation evidence: a gray replica can slow the fleet, it
+  must not talk it into a coordinated brownout.
 - **Failover**: a request failed by a crashed or stopped replica is
   resubmitted to a healthy one — within the request's ORIGINAL
   deadline (the clock is never reset) and a bounded per-request
@@ -53,6 +66,7 @@ from __future__ import annotations
 import collections
 import random as _pyrandom
 import signal as _signal
+import statistics as _statistics
 import threading
 import time
 import weakref
@@ -68,7 +82,8 @@ from ..serving.errors import (DeadlineInfeasibleError, EngineCrashedError,
                               RequestTimeoutError, ServingError)
 from ..serving.overload import CircuitBreaker, RetryBudget
 from .policy import RoutingPolicy
-from .replica import DEAD, DRAINING, HEALTHY, STOPPED, ReplicaHandle
+from .replica import (DEAD, DRAINING, HEALTHY, STOPPED, SUSPECT,
+                      ReplicaHandle)
 
 __all__ = ["FleetRouter", "FleetFuture"]
 
@@ -116,7 +131,28 @@ class FleetFuture:
         self._exc: Optional[BaseException] = None   # terminal failure
         self._hedged = False
         self._t_submit = time.monotonic()
+        # per-ATTEMPT submit stamps: the completion latency fed to the
+        # gray-failure tracker (docs/integrity.md) must charge each
+        # replica only for ITS attempt, not the whole failover chain
+        self._attempt_t = {inner: self._t_submit}
+        self._observed = False
         self.trace_id = inner.trace_id
+
+    def _observe(self, handle: ReplicaHandle, fut) -> None:
+        """Feed the winning attempt's server-side latency (submit →
+        ``t_done``) to its replica's tracker, exactly once per request —
+        repeat ``result()`` calls and concurrent waiters must not
+        multiply one completion into several samples."""
+        with self._lock:
+            if self._observed:
+                return
+            self._observed = True
+            t0 = self._attempt_t.get(fut)
+        if t0 is None:
+            return
+        t1 = fut.t_done if getattr(fut, "t_done", None) is not None \
+            else time.monotonic()
+        self._router._observe_completion(handle, max(0.0, t1 - t0))
 
     def done(self) -> bool:
         """True once ANY attempt has resolved (a hint for pollers; a
@@ -155,6 +191,15 @@ class FleetFuture:
                     # this attempt lost a hedge race and was reaped —
                     # re-snapshot; the winner resolves next iteration
                     continue
+                except DeadlineInfeasibleError:
+                    raise      # admission-time reject: not latency evidence
+                except RequestTimeoutError:
+                    # a request the replica held past its deadline IS
+                    # latency evidence — without this, a replica slow
+                    # enough that everything times out would feed the
+                    # gray detector nothing and keep its keyspace
+                    self._observe(primary_h, primary_f)
+                    raise
                 except (EngineCrashedError, EngineStoppedError,
                         QueueFullError) as e:
                     # QueueFullError on a QUEUED future = the attempt
@@ -167,6 +212,7 @@ class FleetFuture:
                     continue
                 else:
                     self.trace_id = primary_f.trace_id
+                    self._observe(primary_h, primary_f)
                     self._reap_losers(primary_f)
                     return val
             for h, f in ready:
@@ -177,12 +223,18 @@ class FleetFuture:
                 except RequestCancelledError:
                     continue   # reaped hedge loser — the winner is
                                # also in (or about to enter) ready
+                except DeadlineInfeasibleError:
+                    raise      # admission-time reject: not latency evidence
+                except RequestTimeoutError:
+                    self._observe(h, f)   # held past deadline = evidence
+                    raise
                 except (EngineCrashedError, EngineStoppedError,
                         QueueFullError) as e:
                     self._drop_attempt(h, f, e)
                     break
                 else:
                     self.trace_id = f.trace_id
+                    self._observe(h, f)
                     self._reap_losers(f)
                     return val
             if ready:
@@ -250,6 +302,7 @@ class FleetFuture:
             raise
         with self._lock:
             self._attempts.append(nxt)
+            self._attempt_t[nxt[1]] = time.monotonic()
 
     def _maybe_hedge(self, now: float):
         r = self._router
@@ -277,6 +330,7 @@ class FleetFuture:
         r._count("hedges")
         with self._lock:
             self._attempts.append(nxt)
+            self._attempt_t[nxt[1]] = time.monotonic()
 
 
 class FleetRouter:
@@ -327,9 +381,22 @@ class FleetRouter:
         caller sees the typed :class:`FleetSaturatedError` (a
         ``QueueFullError`` subclass) instead of an opaque shed.
     health_interval : monitor poll period in seconds.
+    gray_multiplier / gray_min_samples / gray_window : gray-failure
+        ejection (docs/integrity.md): a HEALTHY replica whose
+        completion-latency EWMA *and* windowed p99 are at least
+        ``gray_multiplier`` times its peer median (median of the OTHER
+        eligible replicas' EWMAs — self-excluded so an outlier cannot
+        inflate its own bar; at least two replicas with
+        ``gray_min_samples`` completions in their
+        ``gray_window``-sample windows) goes
+        ``SUSPECT`` — unroutable but alive, re-admitted without rebuild
+        after the probation ladder's window.  ``gray_ejection=False``
+        disables the detector (the trackers still feed, for the
+        per-replica latency gauges).
     probation / probation_backoff / probation_max : re-admission window
         after a replica death: ``probation * backoff**(deaths-1)``
-        seconds, capped.
+        seconds, capped.  Gray suspensions ride the same ladder, keyed
+        on consecutive suspect ejections.
     restart_warmup : re-run ``warmup()`` on rebuilt/restarted replicas
         so re-admission never compiles on live traffic.
     drain_timeout : default deadline for ``stop()`` / the SIGTERM drain
@@ -357,6 +424,10 @@ class FleetRouter:
                  saturation_threshold: int = 3,
                  saturation_window: float = 1.0,
                  saturation_brownout: bool = True,
+                 gray_ejection: bool = True,
+                 gray_multiplier: float = 4.0,
+                 gray_min_samples: int = 12,
+                 gray_window: int = 64,
                  health_interval: float = 0.05,
                  probation: float = 0.25,
                  probation_backoff: float = 2.0,
@@ -383,6 +454,11 @@ class FleetRouter:
         self.saturation_threshold = int(saturation_threshold)
         self.saturation_window = float(saturation_window)
         self.saturation_brownout = bool(saturation_brownout)
+        # gray-failure defense (docs/integrity.md)
+        self.gray_ejection = bool(gray_ejection)
+        self.gray_multiplier = float(gray_multiplier)
+        self.gray_min_samples = int(gray_min_samples)
+        self.gray_window = int(gray_window)
         self._sat_lock = threading.Lock()
         # last `saturation_threshold` all-replicas-shed event times
         self._sat_times = collections.deque(
@@ -419,7 +495,8 @@ class FleetRouter:
                           probation_max=probation_max,
                           restart_warmup=restart_warmup,
                           breaker=CircuitBreaker(self._breaker_threshold,
-                                                 self._breaker_cooldown))
+                                                 self._breaker_cooldown),
+                          latency_window=self.gray_window)
             for n, e in zip(names, engines)]
         self._by_name = {h.name: h for h in self._handles}
         self.spill_queue_depth = int(spill_queue_depth) \
@@ -488,7 +565,9 @@ class FleetRouter:
             workers = []
             for h in self._handles:
                 with h._lock:
-                    if h.state in (HEALTHY, DRAINING):
+                    if h.state in (HEALTHY, DRAINING, SUSPECT):
+                        # SUSPECT replicas drain too: slow, not dead —
+                        # their in-flight work still deserves the drain
                         h.state = DRAINING
                     elif h.state == STOPPED:
                         continue
@@ -560,7 +639,7 @@ class FleetRouter:
             raise ServingError("fleet router is stopped")
         h = self._require(replica)
         with h._lock:
-            if h.state != HEALTHY:
+            if h.state not in (HEALTHY, SUSPECT):
                 raise ServingError(f"replica {replica!r} is {h.state}, "
                                    "not drainable")
             h.state = DRAINING
@@ -608,6 +687,8 @@ class FleetRouter:
             h.state = HEALTHY
             h.restarts += 1
             h.probation_until = None
+            h.suspect_until = None
+        h.latency.reset()
 
     def _require(self, replica: str) -> ReplicaHandle:
         h = self._by_name.get(replica)
@@ -656,8 +737,62 @@ class FleetRouter:
                         # resurrecting a replica on a stopped fleet
                         if h.rebuild(abort=lambda: self._stopping):
                             self._count("readmissions")
+                    elif h.due_for_unsuspect() and not self._stopping:
+                        # suspension elapsed: back to traffic with a
+                        # fresh latency window — no rebuild, the engine
+                        # never stopped (docs/integrity.md)
+                        if h.unsuspect():
+                            self._count("gray_readmissions")
                 except Exception:
                     continue       # the monitor must outlive any probe
+            try:
+                self._gray_check()
+            except Exception:
+                pass               # ...and outlive the detector too
+
+    def _gray_check(self, now: Optional[float] = None) -> None:
+        """Gray-failure detector (docs/integrity.md): compare each
+        HEALTHY replica's completion-latency EWMA + windowed p99 against
+        the median of its PEERS' EWMAs — the candidate is excluded from
+        its own median, else its own outlier latency inflates the very
+        bar it is judged by (with two replicas the inclusive median
+        makes ejection mathematically impossible for any multiplier
+        >= 2).  An outlier ``gray_multiplier`` above its peer median
+        (with ``gray_min_samples`` of evidence, and at least two
+        replicas eligible so a peer exists to disagree with) is
+        SUSPECT-ejected.  A replica comfortably under the bar resets
+        its consecutive-suspect ladder, mirroring how a healthy probe
+        resets the death ladder."""
+        if not self.gray_ejection:
+            return
+        snaps = [(h, h.latency.snapshot()) for h in self._handles
+                 if h.state == HEALTHY]
+        eligible = [(h, s) for h, s in snaps
+                    if s["count"] >= self.gray_min_samples]
+        if len(eligible) < 2:
+            return
+        ewmas = [s["ewma"] for _h, s in eligible]
+        for i, (h, s) in enumerate(eligible):
+            med = _statistics.median(ewmas[:i] + ewmas[i + 1:])
+            if med <= 0.0:
+                continue
+            bar = self.gray_multiplier * med
+            if s["ewma"] >= bar and s["p99"] >= bar:
+                if h.mark_suspect(
+                        f"gray failure: ewma {s['ewma'] * 1e3:.1f}ms / "
+                        f"p99 {s['p99'] * 1e3:.1f}ms >= "
+                        f"{self.gray_multiplier:g}x peer median "
+                        f"{med * 1e3:.1f}ms over {s['count']} samples",
+                        now):
+                    self._count("gray_ejections")
+            else:
+                h.suspects = 0
+
+    def _observe_completion(self, handle: ReplicaHandle,
+                            seconds: float) -> None:
+        """Completion path → gray-failure evidence: one served request's
+        attempt latency lands in its replica's tracker."""
+        handle.observe_latency(seconds)
 
     # ------------------------------------------------------------ routing
     def _healthy(self) -> List[ReplicaHandle]:
@@ -900,6 +1035,7 @@ class FleetRouter:
             except Exception as e:
                 eh = {"live": False, "error": repr(e)}
             reps[h.name] = {"state": h.state, "deaths": h.total_deaths,
+                            "suspects": h.total_suspects,
                             "restarts": h.restarts,
                             "breaker": h.breaker.state, "engine": eh}
         healthy = len(self._healthy())
@@ -924,7 +1060,9 @@ class FleetRouter:
                 replicas[h.name] = {"state": h.state, "error": repr(e)}
                 continue
             replicas[h.name] = {"state": h.state, "deaths": h.total_deaths,
+                                "suspects": h.total_suspects,
                                 "restarts": h.restarts, "routed": h.routed,
+                                "latency": h.latency.snapshot(),
                                 "stats": s}
             agg["submitted"] += s["requests"]["submitted"]
             agg["completed"] += s["requests"]["completed"]
@@ -942,6 +1080,10 @@ class FleetRouter:
                       "spill_queue_depth": self.spill_queue_depth,
                       "max_failovers": self.max_failovers,
                       "tracked_prefixes": len(self._policy),
+                      "gray": {"ejection": self.gray_ejection,
+                               "multiplier": self.gray_multiplier,
+                               "min_samples": self.gray_min_samples,
+                               "window": self.gray_window},
                       "retry_budget": {
                           "available": round(
                               self._retry_budget.available, 2),
@@ -1000,6 +1142,22 @@ class FleetRouter:
                             "kind": "gauge", "labels": dict(rlbl),
                             "value": 0 if h.breaker.state == "closed"
                             else 1, "help": ""})
+            # gray-failure visibility (docs/integrity.md): the same
+            # per-replica latency signal the detector judges by, plus
+            # the SUSPECT flag itself
+            lat = h.latency.snapshot()
+            samples.append({"name":
+                            "mxtpu_fleet_replica_latency_ewma_seconds",
+                            "kind": "gauge", "labels": dict(rlbl),
+                            "value": round(lat["ewma"], 6), "help": ""})
+            samples.append({"name":
+                            "mxtpu_fleet_replica_latency_p99_seconds",
+                            "kind": "gauge", "labels": dict(rlbl),
+                            "value": round(lat["p99"], 6), "help": ""})
+            samples.append({"name": "mxtpu_fleet_replica_suspect",
+                            "kind": "gauge", "labels": dict(rlbl),
+                            "value": 1 if h.state == SUSPECT else 0,
+                            "help": ""})
             try:
                 c = h.engine.metrics.counters
                 hits += c["prefix_hits"]

@@ -14,6 +14,12 @@ Three coupled layers (docs/resilience.md has the cookbook):
 3. :class:`Watchdog` — the generic dead/hung-thread detector the serving
    engine uses to fail stranded requests with ``EngineCrashedError``
    instead of hanging callers.
+4. :mod:`~mxnet_tpu.resilience.integrity` — end-to-end state integrity
+   (docs/integrity.md): per-file BLAKE2b checkpoint manifests with
+   verify → quarantine → fallback-chain restore
+   (:class:`CheckpointCorruptError` when nothing intact remains), and
+   the :class:`LatencyTracker` behind the fleet's gray-failure
+   (SUSPECT) ejection.
 
 The faults layer is imported eagerly (hot paths need ``inject`` at
 module import); the heavier layers load lazily.
@@ -25,7 +31,8 @@ __all__ = [
     "FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
     "SimulatedPreemption", "active_plan", "inject", "poison",
     "AtomicCheckpointer", "ResilientLoop", "NonFiniteStepError",
-    "Watchdog",
+    "Watchdog", "CheckpointCorruptError", "LatencyTracker",
+    "verify_step_dir", "write_manifest",
 ]
 
 _LAZY = {
@@ -33,6 +40,10 @@ _LAZY = {
     "ResilientLoop": ".loop",
     "NonFiniteStepError": ".loop",
     "Watchdog": ".watchdog",
+    "CheckpointCorruptError": ".integrity",
+    "LatencyTracker": ".integrity",
+    "verify_step_dir": ".integrity",
+    "write_manifest": ".integrity",
 }
 
 

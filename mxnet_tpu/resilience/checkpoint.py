@@ -20,6 +20,21 @@ either the previous committed checkpoint or the new one, never a torn
 There is deliberately NO separate "latest" marker file: the set of
 committed directories IS the source of truth, so no ordering bug between
 "write data" and "write marker" can exist.
+
+Atomicity alone is trust-on-read: the rename proves a save COMPLETED,
+not that the bytes on disk today are the bytes committed then.  So every
+save also writes a ``MANIFEST.json`` (per-file BLAKE2b digest + size,
+:mod:`.integrity`) inside the tmp dir *before* the commit rename — the
+manifest is atomic with the data — and ``restore`` verifies digests
+before deserializing.  A corrupt/torn/missing step is QUARANTINED
+(renamed ``corrupt-<step>``, never deleted) and restore falls back down
+the chain to the newest intact step, raising the typed
+:class:`~.integrity.CheckpointCorruptError` only when no intact step
+exists.  ``_gc`` verifies-or-skips: it never deletes the newest intact
+step (or the last step a restore verified), so a commit whose bytes rot
+immediately after the rename — the ``"checkpoint.corrupt"`` fault site
+simulates exactly this — can no longer take every restorable fallback
+with it.  See docs/integrity.md.
 """
 from __future__ import annotations
 
@@ -30,12 +45,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..base import MXNetError
 from ..observability.trace import active as _trace_active
-from .faults import inject
+from .faults import inject, poison
+from .integrity import (CheckpointCorruptError, TreeHasher,
+                        _count_registry, _warn_legacy_once, flip_bytes,
+                        verify_step_dir, write_manifest,
+                        MANIFEST_SCHEMA_VERSION)
 
-__all__ = ["AtomicCheckpointer"]
+__all__ = ["AtomicCheckpointer", "CheckpointCorruptError"]
 
 _STEP_PREFIX = "step-"
 _TMP_PREFIX = ".tmp-"
+_CORRUPT_PREFIX = "corrupt-"
 _STATE_FILE = "state.mxtpu"
 _META_FILE = "meta.json"
 
@@ -54,6 +74,9 @@ class AtomicCheckpointer:
     def __init__(self, directory: str, max_to_keep: Optional[int] = None):
         self.directory = os.path.abspath(str(directory))
         self.max_to_keep = max_to_keep
+        # the newest step a restore() actually verified + deserialized:
+        # _gc never collects it, whatever max_to_keep says
+        self._last_verified: Optional[int] = None
         os.makedirs(self.directory, exist_ok=True)
         self._sweep_tmp()
 
@@ -91,6 +114,13 @@ class AtomicCheckpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def quarantined(self) -> List[str]:
+        """Names of quarantined (``corrupt-*``) directories — kept for
+        forensics, never restored from, never GC'd."""
+        return sorted(name for name in os.listdir(self.directory)
+                      if name.startswith(_CORRUPT_PREFIX)
+                      and os.path.isdir(os.path.join(self.directory, name)))
+
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"{_STEP_PREFIX}{step:08d}")
 
@@ -118,9 +148,23 @@ class AtomicCheckpointer:
                            f"{_TMP_PREFIX}{step:08d}-{os.getpid()}")
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
-        _save(os.path.join(tmp, _STATE_FILE), dict(tree))
+        # tee-digest the state file in the same pass that writes it —
+        # the manifest records exactly the bytes that went through the
+        # writer, with no re-read between write and digest
+        hasher = TreeHasher()
+        _save(os.path.join(tmp, _STATE_FILE), dict(tree), tee=hasher)
         with open(os.path.join(tmp, _META_FILE), "w") as f:
-            json.dump({"step": step, **(meta or {})}, f)
+            # the integrity stamp lets verify tell a DELETED manifest
+            # (corrupt) from a pre-manifest legacy checkpoint; stamped
+            # AFTER the caller's meta so a round-tripped meta dict can
+            # never mask the reserved step/integrity keys
+            doc = dict(meta or {})
+            doc["step"] = step
+            doc["integrity"] = MANIFEST_SCHEMA_VERSION
+            json.dump(doc, f)
+        # manifest INSIDE the tmp dir, before the commit rename: the
+        # digests are atomic with the data they describe
+        write_manifest(tmp, precomputed={_STATE_FILE: hasher.hexdigest()})
         inject("checkpoint.commit")
         final = self._step_dir(step)
         aside = None
@@ -142,6 +186,11 @@ class AtomicCheckpointer:
             raise
         if aside is not None:
             shutil.rmtree(aside, ignore_errors=True)
+        if poison("checkpoint.corrupt") is not None:
+            # chaos: post-commit bit rot on the committed state file —
+            # fires BEFORE _gc so the verify-or-skip GC contract is
+            # exercised on exactly the save that rotted
+            flip_bytes(os.path.join(final, _STATE_FILE))
         self._gc()
         # fleet counter for DIRECT checkpointer users; ResilientLoop
         # additionally counts its own commits into stats()["resilience"]
@@ -155,33 +204,118 @@ class AtomicCheckpointer:
         return final
 
     def _gc(self):
+        """Collect oldest committed steps beyond ``max_to_keep`` —
+        verify-or-skip: quarantined dirs are invisible here (they left
+        the ``step-`` namespace), and at least one INTACT step always
+        survives.  The old blind version could delete every fallback
+        right after a commit whose bytes were already corrupt on disk,
+        leaving zero restorable state."""
         if self.max_to_keep is None:
             return
         steps = self.all_steps()
-        for s in steps[:max(0, len(steps) - self.max_to_keep)]:
+        excess = steps[:max(0, len(steps) - self.max_to_keep)]
+        if not excess:
+            return
+        newest_intact = None
+        for s in reversed(steps):
+            status, _why = verify_step_dir(self._step_dir(s), _META_FILE)
+            if status != "corrupt":          # legacy counts as restorable
+                newest_intact = s
+                break
+        if newest_intact is None:
+            # every step is corrupt: delete NOTHING — the dirs are
+            # evidence, and restore() will quarantine + raise typed
+            return
+        keep = {newest_intact, self._last_verified}
+        for s in excess:
+            if s in keep:
+                continue
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     # ------------------------------------------------------------- restore
+    def _quarantine(self, step: int, reason: str) -> str:
+        """Move a corrupt step dir aside as ``corrupt-<step>`` (suffixed
+        for uniqueness if the step rots more than once) — NEVER deleted:
+        the bytes are the only forensic evidence of what went wrong."""
+        src = self._step_dir(step)
+        dst = os.path.join(self.directory, f"{_CORRUPT_PREFIX}{step:08d}")
+        n = 1
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(self.directory,
+                               f"{_CORRUPT_PREFIX}{step:08d}-{n}")
+        os.rename(src, dst)
+        try:
+            with open(os.path.join(dst, "QUARANTINE.txt"), "w") as f:
+                f.write(reason + "\n")
+        except OSError:
+            pass                   # evidence preservation is best-effort
+        _count_registry("mxtpu_checkpoint_quarantined_total",
+                        help="corrupt checkpoint step dirs quarantined "
+                             "(renamed corrupt-<step>, kept on disk)")
+        return dst
+
     def restore(self, step: Optional[int] = None) \
             -> Tuple[Dict[str, Any], dict]:
+        """Verified restore of the requested (or latest) step.
+
+        Each candidate is digest-verified BEFORE deserialization; a
+        corrupt/torn/missing-file step is quarantined and restore falls
+        back to the next-older step — so the returned ``meta["step"]``
+        may be older than asked, and callers resuming training replay
+        from it (``ResilientLoop`` already keys its replay off the
+        meta).  Manifest-less legacy steps restore with a one-time
+        warning.  Raises :class:`CheckpointCorruptError` (carrying the
+        steps this call quarantined) only when no intact step remains;
+        asking for a step that never existed keeps raising the plain
+        ``MXNetError``.
+        """
         from ..utils.serialization import load as _load
 
         inject("checkpoint.restore")
+        steps = self.all_steps()
         if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise MXNetError(
-                f"no checkpoint found under {self.directory} "
-                f"(all_steps={self.all_steps()})")
-        path = self._step_dir(int(step))
-        if not os.path.isdir(path):
-            raise MXNetError(
-                f"no checkpoint for step {step} under {self.directory} "
-                f"(all_steps={self.all_steps()})")
-        tree = _load(os.path.join(path, _STATE_FILE))
-        with open(os.path.join(path, _META_FILE)) as f:
-            meta = json.load(f)
-        return tree, meta
+            if not steps:
+                raise MXNetError(
+                    f"no checkpoint found under {self.directory} "
+                    f"(all_steps={self.all_steps()})")
+            candidates = steps[::-1]
+        else:
+            step = int(step)
+            if not os.path.isdir(self._step_dir(step)):
+                raise MXNetError(
+                    f"no checkpoint for step {step} under "
+                    f"{self.directory} (all_steps={self.all_steps()})")
+            candidates = [s for s in steps if s <= step][::-1]
+        quarantined: List[int] = []
+        for s in candidates:
+            path = self._step_dir(s)
+            status, why = verify_step_dir(path, _META_FILE)
+            if status == "corrupt":
+                self._quarantine(s, why or "verification failed")
+                quarantined.append(s)
+                continue
+            if status == "legacy":
+                _warn_legacy_once(path)
+            try:
+                tree = _load(os.path.join(path, _STATE_FILE))
+                with open(os.path.join(path, _META_FILE)) as f:
+                    meta = json.load(f)
+            except Exception as e:
+                # digests matched (or legacy had none) yet the payload
+                # would not deserialize — same failure class, same
+                # response.  BaseException (SimulatedPreemption, ^C)
+                # still propagates: a kill is not corruption.
+                self._quarantine(s, f"deserialize failed: {e!r}")
+                quarantined.append(s)
+                continue
+            self._last_verified = s
+            return tree, meta
+        raise CheckpointCorruptError(
+            f"no intact checkpoint under {self.directory}: "
+            f"{len(quarantined)} step(s) quarantined this call "
+            f"({quarantined}, newest first); corrupt-* dirs kept for "
+            "forensics", quarantined=quarantined)
 
     def __repr__(self):
         return (f"AtomicCheckpointer({self.directory!r}, "

@@ -41,6 +41,20 @@ fires whatever the plan registered for that hit:
   ``io.bad_batch`` corrupts an input batch before iterator-level
   quarantine).  The training-health guardrails (docs/guardrails.md)
   must contain these exactly like ResilientLoop contains kills.
+- ``corrupt_at`` — *state* faults: like ``nonfinite_at`` this never
+  raises; the site's :func:`poison` query fires and the caller corrupts
+  its own durable state (``checkpoint.corrupt`` flips bytes in the
+  just-committed checkpoint file, simulating post-commit bit rot that
+  the verified-restore path — docs/integrity.md — must detect,
+  quarantine, and fall back across).
+
+Sites can additionally be *scoped*: callers that own a natural identity
+(each serving engine passes its claimed name) fire BOTH the plain site
+and ``"<site>@<scope>"``, so a plan can target one replica of a fleet —
+``delay_at("serving.decode_step@fleet-r1", every=1, seconds=0.1)``
+models exactly the gray failure (slow but health-passing replica) the
+fleet's SUSPECT ejection exists to catch.  The disabled hot path still
+pays only one global load + ``None`` check.
 
 Firing is deterministic: ``at=N`` fires on the Nth hit of the site
 (1-based), ``every=K`` on every Kth, and ``prob=p`` draws from a
@@ -215,6 +229,19 @@ class FaultPlan:
                                     max_fires=max_fires))
         return self
 
+    def corrupt_at(self, site: str, *, at: Optional[int] = None,
+                   every: Optional[int] = None,
+                   prob: Optional[float] = None,
+                   max_fires: Optional[int] = None) -> "FaultPlan":
+        """Register a STATE-corruption fault: the site's :func:`poison`
+        query fires (returns a sentinel value) and the caller corrupts
+        its own durable state — e.g. ``checkpoint.corrupt`` flips bytes
+        in the file a save just committed.  Never raises at the site:
+        real bit rot doesn't announce itself either."""
+        self.specs.append(FaultSpec(site, "corrupt", at=at, every=every,
+                                    prob=prob, max_fires=max_fires))
+        return self
+
     # -------------------------------------------------------------- firing
     def fire(self, site: str):
         """Count a hit at ``site`` and execute whatever is due.  Called
@@ -294,13 +321,17 @@ def active_plan() -> Optional[FaultPlan]:
     return _ACTIVE
 
 
-def inject(site: str) -> None:
+def inject(site: str, scope: Optional[str] = None) -> None:
     """Injection-site hook.  Zero-cost when no plan is active: one global
     load and a None check — keep this the ONLY code on the disabled
-    path."""
+    path.  ``scope`` (an engine/replica name) additionally fires the
+    scoped site ``"<site>@<scope>"`` so plans can target one instance;
+    the string is only built once a plan is active."""
     plan = _ACTIVE
     if plan is not None:
         plan.fire(site)
+        if scope is not None:
+            plan.fire(f"{site}@{scope}")
 
 
 def poison(site: str) -> Optional[float]:

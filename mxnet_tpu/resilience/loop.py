@@ -187,6 +187,34 @@ class ResilientLoop:
         if build is not None and getattr(tr, "_built", None) is not True:
             build(data, labels)
 
+    def _verified_restore(self, step: Optional[int]):
+        """Restore through the checkpointer's verified path and account
+        for what it did: each step it quarantined counts one
+        ``checkpoint_quarantines``, and landing on an OLDER step than
+        asked (corruption fallback, docs/integrity.md) counts one
+        ``checkpoint_fallbacks``.  The caller keys resume/rewind off the
+        returned ``meta["step"]``, so a fallback is automatically
+        replayed from the right offset."""
+        ck = self.checkpointer
+        q_before = len(ck.quarantined())
+        try:
+            tree, meta = ck.restore(step)
+        finally:
+            # count even when the chain is exhausted and restore raises
+            # CheckpointCorruptError — the total-corruption incident is
+            # exactly when the counter matters most
+            dq = len(ck.quarantined()) - q_before
+            if dq:
+                self.metrics.count("checkpoint_quarantines", dq)
+        if step is not None and int(meta.get("step", step)) != int(step):
+            self.metrics.count("checkpoint_fallbacks")
+            tr = _trace_active()
+            if tr is not None:
+                tr.event("checkpoint.fallback", requested=int(step),
+                         restored=int(meta.get("step", step)),
+                         quarantined=dq)
+        return tree, meta
+
     def _commit(self, step: int, extra_meta: Optional[dict] = None) -> None:
         tr = _trace_active()
         if tr is None:
@@ -249,10 +277,12 @@ class ResilientLoop:
             raise _base.MXNetError(f"steps must be >= 0, got {steps}")
         report = {"completed_steps": 0, "resumed_from": None,
                   "preempted": False, "retries": 0, "final_loss": None,
-                  "bad_steps": 0, "rewinds": 0}
+                  "bad_steps": 0, "rewinds": 0, "checkpoint_fallbacks": 0}
         retries_before = self.metrics.counters.get("retries", 0)
         bad_before = self.metrics.counters.get("bad_steps", 0)
         rewinds_before = self.metrics.counters.get("rewinds", 0)
+        fallbacks_before = self.metrics.counters.get(
+            "checkpoint_fallbacks", 0)
         start = 0
         latest = self.checkpointer.latest_step()
         if latest is not None:
@@ -264,7 +294,11 @@ class ResilientLoop:
                 probe = next(iter(make_iter()))
             data, labels = _normalize_batch(probe)
             self._ensure_built(data, labels)
-            tree, meta = self.checkpointer.restore(latest)
+            # verified restore: a corrupt latest step is quarantined and
+            # the loop resumes from the newest INTACT step — start comes
+            # from the restored meta, so the replay offset follows the
+            # fallback automatically
+            tree, meta = self._verified_restore(latest)
             self.trainer.load_state_dict(tree)
             start = int(meta.get("step", latest))
             report["resumed_from"] = start
@@ -324,6 +358,9 @@ class ResilientLoop:
             self.metrics.counters.get("bad_steps", 0) - bad_before
         report["rewinds"] = \
             self.metrics.counters.get("rewinds", 0) - rewinds_before
+        report["checkpoint_fallbacks"] = \
+            self.metrics.counters.get("checkpoint_fallbacks", 0) \
+            - fallbacks_before
         return report
 
     # ------------------------------------------------------ bad-step policy
@@ -349,12 +386,15 @@ class ResilientLoop:
                     f"{consecutive_bad} consecutive non-finite steps "
                     f"by step {step} and no committed checkpoint to "
                     "rewind to (on_bad_step='rewind')")
-            tree, _meta = self.checkpointer.restore(latest)
+            tree, _meta = self._verified_restore(latest)
             self.trainer.load_state_dict(tree)
             self.metrics.count("rewinds")
             tr = _trace_active()
             if tr is not None:
-                tr.event("loop.rewind", step=step, restored=latest,
+                # _meta, not latest: a corrupt latest step means the
+                # verified restore fell back to an older one
+                tr.event("loop.rewind", step=step,
+                         restored=int(_meta.get("step", latest)),
                          consecutive_bad=consecutive_bad)
             return 0
         return consecutive_bad

@@ -1239,8 +1239,9 @@ class InferenceEngine:
             self._heartbeat = time.monotonic()
             # deliberately OUTSIDE the recovery net: a raise here kills
             # the scheduler thread, which is exactly the crash the
-            # watchdog exists to detect
-            _inject("serving.scheduler")
+            # watchdog exists to detect.  scope= lets a plan target one
+            # replica of a fleet (docs/integrity.md gray failures).
+            _inject("serving.scheduler", scope=self.name)
             with self._cond:
                 idle = (self._alloc is None
                         or self._alloc.active_count == 0)
@@ -1277,7 +1278,7 @@ class InferenceEngine:
         counted = False
         while True:
             try:
-                _inject(site)
+                _inject(site, scope=self.name)
                 if counted:
                     # a retry re-executes device work (an honest span)
                     # but is the SAME logical step: don't re-count the

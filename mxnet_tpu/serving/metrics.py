@@ -125,9 +125,13 @@ class ServingMetrics:
                  "prefix_hits", "prefix_misses", "prefix_tokens_saved",
                  "prefix_evictions", "prefix_inserts", "prefix_faults",
                  # resilience: transient-step retries, watchdog
-                 # condemnations, atomic checkpoint commits, resumes
+                 # condemnations, atomic checkpoint commits, resumes;
+                 # state integrity (docs/integrity.md): corrupt steps
+                 # quarantined during verified restore and restores
+                 # that fell back to an older intact step
                  "retries", "watchdog_trips", "checkpoint_commits",
-                 "resumes",
+                 "resumes", "checkpoint_quarantines",
+                 "checkpoint_fallbacks",
                  # training-health guardrails (docs/guardrails.md):
                  # skipped non-finite training steps, checkpoint
                  # rewinds, quarantined input batches, and per-request
@@ -352,6 +356,8 @@ class ServingMetrics:
             "resilience": {k: c[k] for k in
                            ("retries", "watchdog_trips",
                             "checkpoint_commits", "resumes",
+                            "checkpoint_quarantines",
+                            "checkpoint_fallbacks",
                             "bad_steps", "rewinds",
                             "quarantined_batches",
                             "nonfinite_outputs")},

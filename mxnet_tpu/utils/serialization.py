@@ -32,12 +32,18 @@ def _to_numpy(v: NDArray) -> onp.ndarray:
 
 
 def save(fname: str, data: Union[Dict[str, NDArray], List[NDArray],
-                                 NDArray]):
+                                 NDArray], tee=None):
     """Write atomically: the container is assembled in a temp file in the
     target directory and committed with one ``os.replace``, so a crash
     mid-write (host preemption, OOM-kill) can never corrupt an existing
     file at ``fname`` — Trainer.save_states over the previous state file
-    either fully replaces it or leaves it untouched."""
+    either fully replaces it or leaves it untouched.
+
+    ``tee`` (an object with ``update(bytes)``, e.g.
+    :class:`~mxnet_tpu.resilience.integrity.TreeHasher`) observes every
+    byte in write order, so a caller building a checkpoint manifest
+    (docs/integrity.md) digests the file in the same pass that writes
+    it instead of re-reading it afterwards."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
@@ -77,11 +83,11 @@ def save(fname: str, data: Union[Dict[str, NDArray], List[NDArray],
             mode = 0o644
         os.fchmod(fd, mode)
         with os.fdopen(fd, "wb") as f:
-            f.write(MAGIC)
-            f.write(struct.pack("<Q", len(header)))
-            f.write(header)
-            for b in blobs:
-                f.write(b)
+            for piece in (MAGIC, struct.pack("<Q", len(header)), header,
+                          *blobs):
+                f.write(piece)
+                if tee is not None:
+                    tee.update(piece)
             f.flush()
             os.fsync(f.fileno())
         _inject("serialization.commit")

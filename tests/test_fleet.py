@@ -494,3 +494,247 @@ def test_affinity_beats_random_routing_ttft():
     assert hit_a > hit_r, (hit_a, hit_r)
     assert hit_a >= 0.8, hit_a
     assert ttft_a < ttft_r, (ttft_a, ttft_r)
+
+
+# -------------------------------------------------- gray-failure ejection
+
+
+class _FakeEngine:
+    """Just enough engine surface for ReplicaHandle state-machine units."""
+
+    def __init__(self, live=True):
+        self.live = live
+
+    def health(self):
+        return {"live": self.live, "crashed": None if self.live
+                else "test-induced"}
+
+
+def test_suspect_state_machine_ladder_and_death():
+    """ReplicaHandle units (docs/integrity.md): mark_suspect uses the
+    probation/backoff ladder keyed on consecutive ejections; unsuspect
+    resets the latency window; a SUSPECT that fails health() goes DEAD
+    normally; DEAD/DRAINING replicas cannot be marked suspect."""
+    from mxnet_tpu.fleet import SUSPECT, ReplicaHandle
+    from mxnet_tpu.fleet.replica import DEAD, HEALTHY
+    h = ReplicaHandle("r0", _FakeEngine(), probation=0.5,
+                      probation_backoff=2.0, probation_max=30.0)
+    for s in (0.01, 0.02, 0.5):
+        h.observe_latency(s)
+    assert h.latency.snapshot()["count"] == 3
+    assert h.mark_suspect("slow", now=100.0)
+    assert h.state == SUSPECT and not h.routable()
+    assert h.suspect_until == 100.5                  # ladder rung 1
+    assert not h.mark_suspect("again", now=100.1)    # already suspect
+    assert not h.due_for_unsuspect(now=100.4)
+    assert h.due_for_unsuspect(now=100.6)
+    assert h.unsuspect()
+    assert h.state == HEALTHY
+    assert h.latency.snapshot()["count"] == 0        # window cleared
+    assert h.mark_suspect("still slow", now=200.0)
+    assert h.suspect_until == 201.0                  # rung 2: doubled
+    # a suspect whose engine actually dies goes DEAD through probe()
+    h.engine.live = False
+    assert h.probe(now=200.1)
+    assert h.state == DEAD and h.probation_until is not None
+    assert not h.mark_suspect("dead now", now=200.2)
+    assert h.total_suspects == 2 and h.total_deaths == 1
+
+
+def test_gray_detector_two_replica_fleet_ejects_outlier():
+    """_gray_check judges each replica against the median of its PEERS'
+    EWMAs (self-excluded).  Regression: with the candidate included in
+    its own median, a 2-replica fleet could NEVER eject — the bar is
+    m*(f+s)/2 and s >= m*(f+s)/2 has no positive solution for any
+    multiplier >= 2, so the outlier inflated its own bar forever."""
+    import threading as _threading
+    from mxnet_tpu.fleet import FleetRouter, SUSPECT, ReplicaHandle
+    from mxnet_tpu.fleet.replica import HEALTHY
+    r = FleetRouter.__new__(FleetRouter)   # only what _gray_check reads
+    r.gray_ejection = True
+    r.gray_multiplier = 4.0
+    r.gray_min_samples = 4
+    r._counters = {}
+    r._counters_lock = _threading.Lock()
+    fast = ReplicaHandle("r0", _FakeEngine())
+    slow = ReplicaHandle("r1", _FakeEngine())
+    r._handles = [fast, slow]
+    for _ in range(6):
+        fast.observe_latency(0.01)
+        slow.observe_latency(0.5)          # 50x its only peer
+    r._gray_check(now=100.0)
+    assert slow.state == SUSPECT
+    assert fast.state == HEALTHY           # judged vs the SLOW peer's
+    assert fast.suspects == 0              # median: far under, ladder reset
+    assert r._counters["gray_ejections"] == 1
+
+
+def test_timed_out_request_feeds_gray_latency_evidence():
+    """A replica that holds a request past its deadline must feed the
+    gray detector a latency sample — otherwise a replica slow enough
+    that EVERYTHING times out contributes zero samples and keeps its
+    keyspace forever (the worst gray regime, invisible).  Admission-time
+    DeadlineInfeasibleError stays excluded: its near-instant rejection
+    is not latency evidence and would dilute the window."""
+    from mxnet_tpu.fleet.router import FleetFuture
+    from mxnet_tpu.serving.errors import (DeadlineInfeasibleError,
+                                          RequestTimeoutError)
+
+    class _StubRouter:
+        def __init__(self):
+            self.samples = []
+
+        def _observe_completion(self, handle, seconds):
+            self.samples.append((handle, seconds))
+
+    class _TimedOutFut:
+        trace_id = None
+        t_done = None
+
+        def __init__(self, exc):
+            self._exc = exc
+
+        def done(self):
+            return True
+
+        def result(self, timeout=None):
+            raise self._exc
+
+    router = _StubRouter()
+    handle = object()
+    fut = FleetFuture(router, object(), handle, _TimedOutFut(
+        RequestTimeoutError("deadline exceeded fleet-side")))
+    with pytest.raises(RequestTimeoutError):
+        fut.result(1.0)
+    assert len(router.samples) == 1 and router.samples[0][0] is handle
+
+    router2 = _StubRouter()
+    fut2 = FleetFuture(router2, object(), handle, _TimedOutFut(
+        DeadlineInfeasibleError("infeasible on arrival")))
+    with pytest.raises(DeadlineInfeasibleError):
+        fut2.result(1.0)
+    assert router2.samples == []           # admission reject: no sample
+
+
+def test_suspect_is_not_saturation_evidence(net):
+    """A SUSPECT replica is skipped by placement WITHOUT counting as a
+    shed: traffic flows to the healthy rest, no FleetSaturatedError, no
+    coordinated brownout; all-SUSPECT surfaces NoHealthyReplicaError
+    (typed apart from saturation)."""
+    from mxnet_tpu.serving import FleetSaturatedError
+    fleet = FleetRouter(factory=_factory(net), num_replicas=2,
+                        name="graysat_fleet", health_interval=10.0)
+    fleet.warmup()
+    p = _prompts((6,), seed=101)[0]
+    ref = _refs(net, [p], 3)[0]
+    with fleet:
+        ha, hb = fleet._handles
+        assert ha.mark_suspect("test: gray")
+        for _ in range(3):
+            onp.testing.assert_array_equal(
+                ref, fleet.infer(p, max_new_tokens=3))
+        s = fleet.stats()
+        assert s["router"].get("sheds", 0) == 0
+        assert s["router"].get("fleet_brownouts", 0) == 0
+        assert ha.routed == 0 and hb.routed == 3
+        assert s["replicas"][ha.name]["state"] == "suspect"
+        # every replica suspect: typed NoHealthyReplica, never a shed
+        assert hb.mark_suspect("test: gray too")
+        with pytest.raises(NoHealthyReplicaError):
+            fleet.submit(p, max_new_tokens=3)
+        with pytest.raises(NoHealthyReplicaError):
+            try:
+                fleet.submit(p, max_new_tokens=3)
+            except FleetSaturatedError:        # would be the WRONG type
+                pytest.fail("SUSPECT read as saturation")
+        assert ha.unsuspect() and hb.unsuspect()
+        onp.testing.assert_array_equal(ref,
+                                       fleet.infer(p, max_new_tokens=3))
+
+
+@pytest.mark.chaos
+def test_gray_replica_ejected_and_readmitted_no_rebuild(net):
+    """THE gray-failure contract (docs/integrity.md): one replica of
+    three serves ~10x slow (scoped delay fault at ITS decode-step site)
+    while still answering health().  The router must SUSPECT-eject it
+    off the completion-latency outlier signal (zero lost requests, its
+    HRW keyspace remapping onto the healthy rest), keep it unroutable
+    while suspect, then re-admit it WITHOUT a rebuild once the window
+    clears — zero compiles on traffic, warm caches — and never read the
+    ejection as fleet saturation."""
+    from mxnet_tpu.fleet import SUSPECT
+    from mxnet_tpu.resilience import FaultPlan
+    fleet = FleetRouter(factory=_factory(net), num_replicas=3,
+                        name="gray_fleet", routing="least_loaded",
+                        health_interval=0.02, gray_min_samples=4,
+                        gray_multiplier=3.0, probation=1.0)
+    n_warm = sum(fleet.warmup().values())
+    prompts = _prompts((5, 6, 7, 5, 6, 7), seed=111)
+    refs = _refs(net, prompts, 3)
+    slow = fleet._by_name["gray_fleet-r1"]
+    plan = FaultPlan().delay_at(
+        "serving.decode_step@gray_fleet-r1", 0.1, every=1)
+    lost = 0
+    with fleet:
+        plan.__enter__()
+        try:
+            for _burst in range(8):
+                futs = [fleet.submit(p, max_new_tokens=3, timeout=30.0)
+                        for p in prompts]
+                for ref, f in zip(refs, futs):
+                    try:
+                        onp.testing.assert_array_equal(ref, f.result(60))
+                    except AssertionError:
+                        raise
+                    except Exception:
+                        lost += 1
+                if fleet.stats()["router"].get("gray_ejections", 0):
+                    break
+        finally:
+            plan.__exit__(None, None, None)
+        assert lost == 0
+        s = fleet.stats()
+        assert s["router"].get("gray_ejections", 0) >= 1
+        assert slow.state == SUSPECT and "gray failure" in slow.last_error
+        # keyspace: the suspect's HRW share remaps onto the healthy two
+        # — every key it did NOT own keeps its winner (~1/N move)
+        names = [h.name for h in fleet._handles]
+        healthy = [h.name for h in fleet._healthy()]
+        keys = [f"fam-{i}".encode() for i in range(300)]
+        moved = 0
+        for k in keys:
+            w3 = rendezvous_rank(k, names)[0]
+            w2 = rendezvous_rank(k, healthy)[0]
+            if w3 == slow.name:
+                moved += 1
+            else:
+                assert w2 == w3                  # survivors keep keys
+        assert 60 <= moved <= 140, moved         # ~1/3 of 300
+        # while suspect: no traffic lands on it, and the skip is not
+        # saturation evidence
+        routed0 = slow.routed
+        for p, ref in zip(prompts, refs):
+            onp.testing.assert_array_equal(
+                ref, fleet.infer(p, max_new_tokens=3))
+        assert slow.routed == routed0
+        assert fleet.stats()["router"].get("fleet_brownouts", 0) == 0
+        # fault lifted: suspension elapses, the monitor re-admits with
+        # NO rebuild and traffic returns
+        deadline = time.monotonic() + 20
+        while slow.state == SUSPECT and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert slow.state == "healthy"
+        assert fleet.stats()["router"].get("gray_readmissions", 0) >= 1
+        for _ in range(3):
+            # burst submits so least-loaded placement SPREADS (a
+            # sequential infer always ties onto the first replica)
+            futs = [fleet.submit(p, max_new_tokens=3, timeout=30.0)
+                    for p in prompts]
+            for ref, f in zip(refs, futs):
+                onp.testing.assert_array_equal(ref, f.result(60))
+        assert slow.routed > routed0             # back in rotation
+        s = fleet.stats()
+        assert s["replicas"][slow.name]["restarts"] == 0   # no rebuild
+        compiles = sum(rep["stats"]["compile_cache"]["compiles"]
+                       for rep in s["replicas"].values())
+        assert compiles == n_warm                # zero compiles on traffic

@@ -1,0 +1,371 @@
+"""End-to-end state integrity (docs/integrity.md).
+
+The corruption matrix for verified checkpoints: bit flips, truncation,
+deleted files, deleted/torn manifests — every case must be DETECTED
+before deserialization, the corrupt step QUARANTINED (renamed, never
+deleted), and restore must fall back down the chain to the newest
+intact step, raising the typed ``CheckpointCorruptError`` only when
+nothing intact remains.  Legacy (pre-manifest) checkpoints stay
+restorable with a one-time warning.  Plus the ``verify_checkpoint``
+CLI, the verify-or-skip GC contract, and the ``LatencyTracker`` unit
+behind the fleet's gray-failure ejection.
+"""
+import importlib.util
+import json
+import os
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.resilience import (AtomicCheckpointer, CheckpointCorruptError,
+                                  FaultPlan, LatencyTracker)
+from mxnet_tpu.resilience.integrity import (MANIFEST_FILE, _reset_legacy_warning,
+                                            file_digest, flip_bytes,
+                                            verify_step_dir, write_manifest)
+
+# ---------------------------------------------------------------- helpers
+
+
+def _tree(v, n=6):
+    return {"w": nd.array(onp.full(n, float(v), "float32")),
+            "b": nd.array(onp.arange(n, dtype="float32") * v)}
+
+
+def _save_steps(ck, steps):
+    for s in steps:
+        ck.save(s, _tree(s), meta={"note": f"s{s}"})
+
+
+def _state_path(tmp_path, step):
+    return str(tmp_path / f"step-{step:08d}" / "state.mxtpu")
+
+
+def _assert_is_step(tree, meta, step):
+    assert meta["step"] == step
+    onp.testing.assert_array_equal(tree["w"].asnumpy(),
+                                   onp.full(6, float(step), "float32"))
+    onp.testing.assert_array_equal(tree["b"].asnumpy(),
+                                   onp.arange(6, dtype="float32") * step)
+
+
+# ------------------------------------------------------- manifest basics
+
+
+def test_save_writes_manifest_and_verifies_intact(tmp_path):
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1])
+    step_dir = str(tmp_path / "step-00000001")
+    manifest = os.path.join(step_dir, MANIFEST_FILE)
+    assert os.path.exists(manifest)
+    with open(manifest) as f:
+        doc = json.load(f)
+    assert set(doc["files"]) == {"state.mxtpu", "meta.json"}
+    for name, spec in doc["files"].items():
+        path = os.path.join(step_dir, name)
+        assert spec["size"] == os.path.getsize(path)
+        assert spec["blake2b"] == file_digest(path)
+    assert verify_step_dir(step_dir) == ("intact", None)
+
+
+def test_verify_detects_every_corruption_mode(tmp_path):
+    modes = {
+        "bit_flip": lambda d: flip_bytes(os.path.join(d, "state.mxtpu")),
+        "truncation": lambda d: open(os.path.join(d, "state.mxtpu"),
+                                     "r+b").truncate(
+            os.path.getsize(os.path.join(d, "state.mxtpu")) // 2),
+        "deleted_state": lambda d: os.remove(
+            os.path.join(d, "state.mxtpu")),
+        "torn_manifest": lambda d: open(os.path.join(d, MANIFEST_FILE),
+                                        "w").write('{"files": '),
+        "deleted_manifest": lambda d: os.remove(
+            os.path.join(d, MANIFEST_FILE)),
+    }
+    for name, corrupt in modes.items():
+        d = tmp_path / name
+        ck = AtomicCheckpointer(str(d))
+        _save_steps(ck, [1])
+        step_dir = str(d / "step-00000001")
+        corrupt(step_dir)
+        status, reason = verify_step_dir(step_dir)
+        assert status == "corrupt", (name, status, reason)
+        assert reason, name
+
+
+def test_corrupt_latest_falls_back_bit_identical(tmp_path):
+    """THE fallback contract: a rotted latest step is quarantined and
+    restore returns the previous step's bytes EXACTLY — the same arrays
+    a restore before the corruption would have produced."""
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2])
+    before, before_meta = ck.restore(1)        # pre-corruption reference
+    flip_bytes(_state_path(tmp_path, 2))
+    tree, meta = ck.restore()                  # asked for latest (=2)
+    _assert_is_step(tree, meta, 1)
+    assert meta["note"] == "s1"
+    for k in before:
+        onp.testing.assert_array_equal(tree[k].asnumpy(),
+                                       before[k].asnumpy())
+    # quarantined: renamed, never deleted, payload preserved
+    assert ck.all_steps() == [1]
+    assert ck.quarantined() == ["corrupt-00000002"]
+    q = tmp_path / "corrupt-00000002"
+    assert (q / "state.mxtpu").exists() and (q / MANIFEST_FILE).exists()
+    assert "digest mismatch" in (q / "QUARANTINE.txt").read_text()
+
+
+def test_truncated_and_missing_state_fall_back(tmp_path):
+    for sub, corrupt in (
+            ("trunc", lambda p: open(p, "r+b").truncate(10)),
+            ("gone", os.remove)):
+        d = tmp_path / sub
+        ck = AtomicCheckpointer(str(d))
+        _save_steps(ck, [1, 2])
+        corrupt(str(d / "step-00000002" / "state.mxtpu"))
+        tree, meta = ck.restore()
+        _assert_is_step(tree, meta, 1)
+        assert ck.quarantined() == ["corrupt-00000002"]
+
+
+def test_torn_manifest_quarantines_deleted_manifest_detected(tmp_path):
+    """A torn manifest is corruption; a DELETED manifest is too (the
+    meta's integrity stamp says one should exist) — neither is confused
+    with a legacy pre-manifest checkpoint."""
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2, 3])
+    with open(str(tmp_path / "step-00000003" / MANIFEST_FILE), "w") as f:
+        f.write('{"schema_version": 1, "files": ')      # torn JSON
+    os.remove(str(tmp_path / "step-00000002" / MANIFEST_FILE))
+    tree, meta = ck.restore()
+    _assert_is_step(tree, meta, 1)
+    assert ck.quarantined() == ["corrupt-00000002", "corrupt-00000003"]
+
+
+def test_destroyed_step_no_manifest_no_meta_is_corrupt_not_legacy(tmp_path):
+    """Manifest AND meta gone/torn = damage, not age: a true legacy
+    save always committed a readable meta.json, so the offline CLI must
+    flag the step instead of blessing it as merely legacy."""
+    from mxnet_tpu.resilience.integrity import verify_step_dir
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2])
+    d2 = str(tmp_path / "step-00000002")
+    os.remove(os.path.join(d2, MANIFEST_FILE))
+    os.remove(os.path.join(d2, "meta.json"))
+    status, why = verify_step_dir(d2)
+    assert status == "corrupt" and "meta file unreadable" in why
+    # torn (not deleted) meta classifies the same way
+    d1 = str(tmp_path / "step-00000001")
+    os.remove(os.path.join(d1, MANIFEST_FILE))
+    with open(os.path.join(d1, "meta.json"), "w") as f:
+        f.write('{"step": 1, "integ')
+    assert verify_step_dir(d1)[0] == "corrupt"
+
+
+def test_explicit_step_restore_falls_back_below_requested(tmp_path):
+    """restore(step=2) with step 2 corrupt falls back to 1, never
+    'forward' to the newer step 3."""
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2, 3])
+    flip_bytes(_state_path(tmp_path, 2))
+    tree, meta = ck.restore(2)
+    _assert_is_step(tree, meta, 1)
+    # step 3 untouched and still the latest
+    assert ck.all_steps() == [1, 3]
+    _assert_is_step(*ck.restore(), 3)
+
+
+def test_all_corrupt_raises_typed_with_quarantine_list(tmp_path):
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2])
+    flip_bytes(_state_path(tmp_path, 1))
+    flip_bytes(_state_path(tmp_path, 2))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ck.restore()
+    assert ei.value.quarantined == [2, 1]          # newest first
+    assert isinstance(ei.value, mx.MXNetError)     # fits the taxonomy
+    # nothing deleted: both dirs live on as evidence
+    assert ck.quarantined() == ["corrupt-00000001", "corrupt-00000002"]
+    # missing-step / empty-dir errors keep their ORIGINAL types
+    with pytest.raises(mx.MXNetError, match="all_steps"):
+        ck.restore(9)
+    with pytest.raises(mx.MXNetError, match=r"all_steps=\[\]"):
+        ck.restore()
+
+
+def test_legacy_manifestless_restores_with_one_time_warning(tmp_path):
+    """A pre-integrity checkpoint (no manifest, no meta stamp) still
+    restores — with a single per-process warning, not one per call."""
+    from mxnet_tpu.utils.serialization import save as _ser_save
+    d = tmp_path / "step-00000005"
+    os.makedirs(str(d))
+    _ser_save(str(d / "state.mxtpu"), _tree(5))
+    with open(str(d / "meta.json"), "w") as f:
+        json.dump({"step": 5, "note": "s5"}, f)    # no integrity stamp
+    assert verify_step_dir(str(d)) == ("legacy", None)
+    ck = AtomicCheckpointer(str(tmp_path))
+    _reset_legacy_warning()
+    with pytest.warns(UserWarning, match="pre-integrity"):
+        tree, meta = ck.restore()
+    _assert_is_step(tree, meta, 5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")             # second restore: silent
+        tree, meta = ck.restore()
+    _assert_is_step(tree, meta, 5)
+
+
+def test_quarantine_survives_resave_of_same_step(tmp_path):
+    """Re-saving a step whose old incarnation was quarantined must not
+    clobber the evidence; a second rot of the SAME step quarantines
+    under a unique suffix."""
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2])
+    flip_bytes(_state_path(tmp_path, 2))
+    ck.restore()                                   # quarantines step 2
+    assert ck.quarantined() == ["corrupt-00000002"]
+    ck.save(2, _tree(20), meta={"note": "resaved"})
+    assert ck.quarantined() == ["corrupt-00000002"]
+    tree, meta = ck.restore(2)
+    assert meta["note"] == "resaved"
+    onp.testing.assert_array_equal(tree["w"].asnumpy(),
+                                   onp.full(6, 20.0, "float32"))
+    flip_bytes(_state_path(tmp_path, 2))           # rot it AGAIN
+    tree, meta = ck.restore()
+    _assert_is_step(tree, meta, 1)
+    assert ck.quarantined() == ["corrupt-00000002", "corrupt-00000002-2"]
+
+
+# ------------------------------------------------------------ GC contract
+
+
+@pytest.mark.chaos
+def test_gc_never_deletes_the_last_intact_fallback(tmp_path):
+    """The satellite fix: a commit whose bytes rot immediately
+    (checkpoint.corrupt fires between the rename and _gc) must NOT let
+    GC collect the older intact steps — verify-or-skip retains >=1
+    restorable step."""
+    ck = AtomicCheckpointer(str(tmp_path), max_to_keep=1)
+    ck.save(1, _tree(1))
+    plan = FaultPlan().corrupt_at("checkpoint.corrupt", at=1)
+    with plan:
+        ck.save(2, _tree(2))
+    assert plan.fired("checkpoint.corrupt") == 1
+    # blind GC would have deleted step 1 here, leaving ZERO restorable
+    assert ck.all_steps() == [1, 2]
+    tree, meta = ck.restore()
+    _assert_is_step(tree, meta, 1)
+    assert ck.quarantined() == ["corrupt-00000002"]
+    # a later INTACT commit lets GC shrink again — but never below the
+    # step the last restore verified
+    ck.save(3, _tree(3))
+    assert ck.all_steps() == [1, 3]
+    _assert_is_step(*ck.restore(3), 3)
+    ck.save(4, _tree(4))
+    assert ck.all_steps() == [3, 4]
+
+
+def test_gc_with_all_corrupt_retains_everything(tmp_path):
+    ck = AtomicCheckpointer(str(tmp_path))         # no GC while saving
+    _save_steps(ck, [1, 2])
+    flip_bytes(_state_path(tmp_path, 1))
+    flip_bytes(_state_path(tmp_path, 2))
+    ck2 = AtomicCheckpointer(str(tmp_path), max_to_keep=1)
+    ck2._gc()
+    assert ck2.all_steps() == [1, 2]               # evidence, not garbage
+
+
+# --------------------------------------------------------------- the CLI
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "verify_checkpoint", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "verify_checkpoint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_verify_checkpoint_cli_reports_and_exit_codes(tmp_path, capsys):
+    cli = _load_cli()
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2, 3])
+    flip_bytes(_state_path(tmp_path, 2))
+    os.remove(str(tmp_path / "step-00000001" / MANIFEST_FILE))
+    # make step 1 GENUINELY legacy: strip the meta integrity stamp
+    meta_path = str(tmp_path / "step-00000001" / "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta.pop("integrity")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    rc = cli.main([str(tmp_path)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1 and report["ok"] is False
+    assert report["steps"]["step-00000001"]["status"] == "legacy"
+    assert report["steps"]["step-00000002"]["status"] == "corrupt"
+    assert "digest mismatch" in report["steps"]["step-00000002"]["reason"]
+    assert report["steps"]["step-00000003"]["status"] == "intact"
+    assert (report["intact"], report["legacy"], report["corrupt"]) \
+        == (1, 1, 1)
+
+    # quarantining the corrupt step turns the report green — quarantined
+    # dirs are listed as PAST corruption, not new findings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")    # step 1 is legacy: may warn
+        ck.restore(2)
+    out = tmp_path / "report.json"
+    rc = cli.main([str(tmp_path), "--out", str(out)])
+    report = json.loads(out.read_text())
+    assert rc == 0 and report["ok"] is True
+    assert report["quarantined"] == ["corrupt-00000002"]
+
+    assert cli.main([str(tmp_path / "nope")]) == 2
+
+
+# ----------------------------------------------------- registry counters
+
+
+def test_quarantine_and_verify_failure_counters(tmp_path):
+    from mxnet_tpu.observability import default_registry
+    ck = AtomicCheckpointer(str(tmp_path))
+    _save_steps(ck, [1, 2])
+    flip_bytes(_state_path(tmp_path, 2))
+    ck.restore()
+
+    def _value(name):
+        return sum(s["value"] for s in default_registry().collect()["samples"]
+                   if s["name"] == name)
+
+    assert _value("mxtpu_checkpoint_quarantined_total") >= 1
+    assert _value("mxtpu_integrity_verify_failures_total") >= 1
+
+
+# ----------------------------------------------------- latency tracker
+
+
+def test_latency_tracker_ewma_window_and_percentiles():
+    t = LatencyTracker(window=8, alpha=0.5)
+    assert t.snapshot() == {"count": 0, "ewma": 0.0, "p50": 0.0,
+                            "p99": 0.0}
+    t.observe(0.1)
+    assert t.snapshot()["ewma"] == pytest.approx(0.1)   # seeded, not decayed
+    t.observe(0.3)
+    assert t.snapshot()["ewma"] == pytest.approx(0.2)
+    for _ in range(8):
+        t.observe(0.01)                                 # flush the window
+    s = t.snapshot()
+    assert s["count"] == 8
+    assert s["p50"] == pytest.approx(0.01) and s["p99"] == pytest.approx(0.01)
+    t.observe(1.0)
+    s = t.snapshot()
+    assert s["p99"] == pytest.approx(1.0)               # tail is the max
+    assert s["p50"] == pytest.approx(0.01)              # median is not
+    total = t.total
+    t.reset()
+    assert t.snapshot()["count"] == 0 and t.total == total
+    with pytest.raises(mx.MXNetError):
+        LatencyTracker(alpha=0.0)

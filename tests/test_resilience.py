@@ -641,3 +641,57 @@ def test_deadline_alias_is_exported():
     from mxnet_tpu.serving import errors
     assert "DeadlineExceededError" in errors.__all__
     assert issubclass(EngineCrashedError, ServingError)
+
+
+# -------------------------------------------- verified-restore integration
+
+
+@pytest.mark.chaos
+def test_resume_through_corrupt_latest_checkpoint(tmp_path):
+    """End-to-end state integrity (docs/integrity.md): training is
+    KILLED, then the latest committed step rots on disk (the
+    checkpoint.corrupt fault flips bytes right after its commit).  A
+    fresh process must QUARANTINE the corrupt step, fall back to the
+    newest intact one, replay forward, and finish with parameters
+    BIT-IDENTICAL to the fault-free run — PR 2's kill-resume contract
+    extended to a disk that lies."""
+    from mxnet_tpu.resilience import CheckpointCorruptError  # exported
+    mesh = _make_mesh()
+    STEPS = 12
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "ref"), save_every=2,
+                             seed=7)
+        loop.run(_make_iter, STEPS)
+        ref = _params_of(tr)
+
+        # saves land after steps 2/4/6 (hits 1/2/3 of the save site);
+        # corrupt_at(at=3) rots the step-6 commit, kill_at(at=7) dies
+        # executing the 7th step — so the resume finds latest=6 corrupt
+        plan = (FaultPlan()
+                .kill_at("trainer.step", at=7)
+                .corrupt_at("checkpoint.corrupt", at=3))
+        with plan:
+            tr2 = _make_trainer()
+            loop2 = ResilientLoop(tr2, str(tmp_path / "chaos"),
+                                  save_every=2, seed=7)
+            with pytest.raises(SimulatedPreemption):
+                loop2.run(_make_iter, STEPS)
+            assert plan.fired("checkpoint.corrupt") == 1
+            tr3 = _make_trainer()                  # "fresh process"
+            loop3 = ResilientLoop(tr3, str(tmp_path / "chaos"),
+                                  save_every=2, seed=7)
+            report = loop3.run(_make_iter, STEPS)
+    assert report["resumed_from"] == 4             # fell back below 6
+    assert report["completed_steps"] == STEPS
+    assert report["checkpoint_fallbacks"] == 1
+    assert loop3.metrics.counters["checkpoint_quarantines"] == 1
+    assert loop3.metrics.counters["resumes"] == 1
+    assert loop3.checkpointer.quarantined() == ["corrupt-00000006"]
+    # the re-committed step 6 (from the replay) coexists with the
+    # quarantined corpse of its first incarnation
+    assert 6 in loop3.checkpointer.all_steps()
+    for a, b in zip(ref, _params_of(tr3)):
+        onp.testing.assert_array_equal(a, b)       # exact on CPU
+    assert "checkpoint_quarantines" in \
+        loop3.metrics.stats()["resilience"]

@@ -165,6 +165,7 @@ def serving_scenarios(net):
         ("rolling_restart", lambda: fleet_rolling_restart(net)),
         ("overload_storm", lambda: serving_overload_storm(net)),
         ("retry_storm", lambda: fleet_retry_storm(net)),
+        ("gray_replica", lambda: fleet_gray_replica(net)),
     ]
 
 
@@ -664,6 +665,118 @@ def fleet_retry_storm(net):
     }
 
 
+def fleet_gray_replica(net):
+    """Gray-failure chaos (docs/integrity.md): one replica of three
+    serves ~10x slow — a scoped delay fault at ITS decode-step site —
+    while still answering ``health()``.  Invariants: the router
+    SUSPECT-ejects it off the completion-latency outlier signal within
+    the window with ZERO lost requests (in-flight work on the gray
+    replica finishes); request p99 RECOVERS once placement skips it;
+    the ejection is never read as saturation (no coordinated brownout);
+    and when the fault lifts the replica is re-admitted WITHOUT a
+    rebuild — warm caches, zero compiles on traffic — and takes load
+    again with the prefix cache still hitting."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import FaultPlan
+
+    rs = onp.random.RandomState(13)
+    shared = rs.randint(0, 61, (8,)).astype("int32")
+    prompts = [onp.concatenate([shared[:4 + (i % 3)],
+                                rs.randint(0, 61, (3,)).astype("int32")])
+               for i in range(6)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    fleet = _fleet(net, n=3, name="chaos_gray", routing="least_loaded",
+                   health_interval=0.02, gray_min_samples=4,
+                   gray_multiplier=3.0, probation=1.0)
+    n_warm = sum(fleet.warmup().values())
+    slow = fleet._by_name["chaos_gray-r1"]
+    plan = FaultPlan().delay_at("serving.decode_step@chaos_gray-r1",
+                                0.1, every=1)
+    lost = mismatched = 0
+
+    def wave(latencies=None):
+        nonlocal lost, mismatched
+        futs = [(ref, time.monotonic(),
+                 fleet.submit(p, max_new_tokens=3, timeout=30.0))
+                for p, ref in zip(prompts, refs)]
+        for ref, t0, f in futs:
+            try:
+                out = f.result(60)
+                if latencies is not None:
+                    latencies.append(time.monotonic() - t0)
+                if not onp.array_equal(out, ref):
+                    mismatched += 1
+            except Exception:
+                lost += 1
+
+    storm_lat, after_lat = [], []
+    with fleet:
+        plan.__enter__()
+        try:
+            ejected = False
+            for _burst in range(8):
+                wave(storm_lat)
+                if fleet.stats()["router"].get("gray_ejections", 0):
+                    ejected = True
+                    break
+            # post-ejection, fault still active: the suspect is skipped,
+            # so p99 must come back down to healthy-replica latency
+            routed0 = slow.routed
+            for _ in range(3):
+                wave(after_lat)
+            suspect_skipped = slow.routed == routed0
+        finally:
+            plan.__exit__(None, None, None)
+        storm_lat.sort()
+        after_lat.sort()
+        p99_storm = storm_lat[int(0.99 * (len(storm_lat) - 1))] \
+            if storm_lat else 0.0
+        p99_after = after_lat[int(0.99 * (len(after_lat) - 1))] \
+            if after_lat else 0.0
+        brownouts = fleet.stats()["router"].get("fleet_brownouts", 0)
+        # fault lifted: the monitor re-admits without a rebuild
+        deadline = time.monotonic() + 20
+        while slow.state == "suspect" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        readmitted = slow.state == "healthy"
+        hits0 = fleet.stats()["aggregate"]["prefix_hits"]
+        routed1 = slow.routed
+        for _ in range(3):
+            wave()
+        s = fleet.stats()
+        took_traffic = slow.routed > routed1
+        hit_recovered = s["aggregate"]["prefix_hits"] > hits0
+        compiles = sum(rep["stats"]["compile_cache"]["compiles"]
+                       for rep in s["replicas"].values())
+    _join_zombies()
+    passed = (lost == 0 and mismatched == 0 and ejected
+              and suspect_skipped and p99_after < p99_storm
+              and p99_storm >= 0.1          # the delay actually showed
+              and brownouts == 0 and readmitted and took_traffic
+              and hit_recovered
+              and s["replicas"]["chaos_gray-r1"]["restarts"] == 0
+              and compiles == n_warm)
+    return {
+        "name": "fleet/gray_replica",
+        "passed": bool(passed),
+        "detail": {"requests": len(storm_lat) + len(after_lat) + 18,
+                   "lost": lost, "mismatched": mismatched,
+                   "ejected": ejected, "suspect_skipped": suspect_skipped,
+                   "p99_storm_s": round(p99_storm, 3),
+                   "p99_after_ejection_s": round(p99_after, 3),
+                   "brownouts": brownouts, "readmitted": readmitted,
+                   "took_traffic_after": took_traffic,
+                   "hit_rate_recovered": hit_recovered,
+                   "rebuilds": s["replicas"]["chaos_gray-r1"]["restarts"],
+                   "compiles_after_warmup": compiles - n_warm,
+                   "suspect_reason": slow.last_error,
+                   "router": s["router"]},
+    }
+
+
 # ------------------------------------------------------- training scenarios
 
 def _make_trainer(**kw):
@@ -773,6 +886,89 @@ def training_commit_kill():
             "detail": {"died_mid_save": died, "latest": ck.latest_step(),
                        "previous_intact": intact},
         }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def training_checkpoint_corruption(steps=12):
+    """Verified-checkpoint chaos (docs/integrity.md): training is
+    KILLED, and the latest committed step's bytes rot on disk (the
+    ``checkpoint.corrupt`` fault flips them right after the commit
+    rename).  Contract: the resumed run detects the corruption via the
+    manifest, QUARANTINES the dir (``corrupt-*``, never deleted), falls
+    back to the newest intact step, replays forward, and finishes with
+    parameters BIT-IDENTICAL to the fault-free run — and the
+    ``verify_checkpoint`` CLI flags the quarantined dir with a nonzero
+    exit before quarantine, zero after."""
+    import subprocess
+
+    import numpy as onp
+
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.resilience import (FaultPlan, ResilientLoop,
+                                      SimulatedPreemption)
+    mesh = par.make_mesh(dp=1)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    ckdir = os.path.join(workdir, "chaos")
+    try:
+        with par.use_mesh(mesh):
+            tr = _make_trainer()
+            loop = ResilientLoop(tr, os.path.join(workdir, "ref"),
+                                 save_every=2, seed=7)
+            loop.run(_make_iter, steps)
+            ref = [p.data().asnumpy().copy() for _, p in tr._trainable]
+
+            # saves land after steps 2/4/6; corrupt_at(at=3) rots the
+            # step-6 commit, kill_at(at=7) dies on the 7th step
+            plan = (FaultPlan()
+                    .kill_at("trainer.step", at=7)
+                    .corrupt_at("checkpoint.corrupt", at=3))
+            died = False
+            with plan:
+                tr2 = _make_trainer()
+                loop2 = ResilientLoop(tr2, ckdir, save_every=2, seed=7)
+                try:
+                    loop2.run(_make_iter, steps)
+                except SimulatedPreemption:
+                    died = True
+                # the CLI must flag the rotted (not yet quarantined) dir
+                cli = subprocess.run(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(
+                         os.path.abspath(__file__)),
+                         "verify_checkpoint.py"), ckdir],
+                    capture_output=True, text=True)
+                flagged = cli.returncode == 1 and \
+                    '"corrupt"' in cli.stdout
+                tr3 = _make_trainer()              # "fresh process"
+                loop3 = ResilientLoop(tr3, ckdir, save_every=2, seed=7)
+                report = loop3.run(_make_iter, steps)
+            got = [p.data().asnumpy() for _, p in tr3._trainable]
+            exact = all(onp.array_equal(a, b) for a, b in zip(ref, got))
+            quarantined = loop3.checkpointer.quarantined()
+            cli2 = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "verify_checkpoint.py"), ckdir],
+                capture_output=True, text=True)
+            passed = (died and flagged
+                      and report["resumed_from"] == 4
+                      and report["completed_steps"] == steps
+                      and report["checkpoint_fallbacks"] == 1
+                      and quarantined == ["corrupt-00000006"]
+                      and exact and cli2.returncode == 0)
+            return {
+                "name": "training/checkpoint_corruption",
+                "passed": bool(passed),
+                "detail": {"died": died, "cli_flagged_corruption": flagged,
+                           "resumed_from": report["resumed_from"],
+                           "checkpoint_fallbacks":
+                               report["checkpoint_fallbacks"],
+                           "quarantined": quarantined,
+                           "params_bit_identical": bool(exact),
+                           "cli_exit_after_quarantine": cli2.returncode,
+                           "faults_fired": plan.fired()},
+            }
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -943,6 +1139,7 @@ def main():
         run(thunk)
     run(training_kill_resume, kills=args.kills, steps=args.steps)
     run(training_commit_kill)
+    run(training_checkpoint_corruption)
     run(training_nan_storm)
     run(training_persistent_nan_rewind)
     run(training_bad_batch_quarantine)
