@@ -1,0 +1,42 @@
+"""``mxnet_tpu.analysis`` — correctness tooling that mechanically
+enforces the invariants the rest of the tree hand-maintains
+(docs/static_analysis.md):
+
+1. :mod:`~mxnet_tpu.analysis.lockwitness` — a runtime lock-order
+   witness in the faults.py zero-cost-when-disabled pattern: project
+   locks are constructed through :func:`named_lock` /
+   :func:`named_rlock` / :func:`named_condition`, and when enabled the
+   witness builds the process lock-ordering graph, flags cycles
+   (potential deadlocks) and blocking calls under held locks.
+2. :mod:`~mxnet_tpu.analysis.lint` — the AST project linter behind
+   ``tools/mxlint.py``: fault sites must be registered, metrics must be
+   named and documented, serving/fleet raises must be MXNetError-typed,
+   locks must be ``with``-scoped, monotonic-clock convention holds.
+
+The lockwitness half is imported eagerly (every lock-owning module
+needs the constructors at import); the linter loads lazily — it pulls
+in ``ast`` machinery no serving process wants.
+"""
+from .lockwitness import (LockOrderError, LockWitness, active_witness,
+                          disable, enable, known_lock_sites, named_condition,
+                          named_lock, named_rlock, note_blocking)
+
+__all__ = [
+    "LockOrderError", "LockWitness", "active_witness", "disable",
+    "enable", "known_lock_sites", "named_condition", "named_lock",
+    "named_rlock", "note_blocking",
+    "run_lint", "Finding", "RULES",
+]
+
+_LAZY = {"run_lint": ".lint", "Finding": ".lint", "RULES": ".lint"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        obj = getattr(mod, name)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(
+        f"module 'mxnet_tpu.analysis' has no attribute {name!r}")

@@ -1,0 +1,517 @@
+"""mxlint — the AST project linter behind ``tools/mxlint.py``.
+
+Eight PRs accumulated contracts that nothing checked mechanically:
+fault-injection sites are stringly typed, metric names follow an
+undocumented convention, the serving/fleet error taxonomy is
+hand-maintained, and lock discipline lives in reviewers' heads.  Each
+rule here codifies one of those contracts (docs/static_analysis.md has
+the catalog with rationale and the how-to-add-a-rule recipe):
+
+``fault-site``
+    Every site literal fired through ``inject``/``poison`` (and
+    targeted by :class:`FaultPlan` builders) must be declared in
+    ``faults.KNOWN_SITES`` via ``register_site`` — a typo'd site is
+    silently dead chaos coverage.
+``metric-name``
+    Every complete ``mxtpu_*`` metric-name literal must match
+    ``mxtpu_[a-z0-9_]+`` and appear in the docs/observability.md
+    catalog (templated entries like ``mxtpu_serving_<counter>_total``
+    match as families) — an undocumented metric is invisible to the
+    fleet scraper's dashboards.
+``typed-raise``
+    No bare ``ValueError``/``RuntimeError``/``KeyError``/``TypeError``/
+    ``Exception`` raised inside ``serving/`` or ``fleet/`` — every
+    failure a caller can see must be MXNetError-typed
+    (docs/serving.md error taxonomy).
+``naked-acquire``
+    Locks are acquired via ``with``; a bare ``.acquire()`` is allowed
+    only when the IMMEDIATELY following statement is a ``try`` whose
+    ``finally`` releases the same object — anything else leaks the lock
+    on the first exception between acquire and release.
+``wall-clock``
+    No ``time.time()`` inside the components that follow the
+    monotonic-clock convention (``serving``, ``fleet``, ``resilience``,
+    ``observability``, ``analysis``) — NTP steps wall clocks backwards,
+    which turns deadline/ordering arithmetic into negative durations.
+``lock-allowlist``
+    The lockwitness allowlist file must be well-formed: known kinds,
+    sites that exist (statically collected from ``named_lock``/
+    ``named_rlock``/``named_condition``/``note_blocking`` literals),
+    and a real justification string per entry — the escape hatch is
+    itself under analysis.
+
+Suppression: append ``# mxlint: disable=<rule>[,<rule>...]`` to the
+offending line (``disable=all`` silences every rule for that line).
+Use sparingly; every pragma is a reviewer conversation.
+
+The linter is PURELY static — it parses source with :mod:`ast` and
+never imports the code under analysis, so it runs in CI without jax or
+a device."""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "RULES", "run_lint", "collect_files"]
+
+RULES: Dict[str, str] = {
+    "fault-site": "fault site literal not registered in faults.KNOWN_SITES",
+    "metric-name": "metric literal violates mxtpu_* naming or is missing "
+                   "from the docs/observability.md catalog",
+    "typed-raise": "untyped exception raised on a serving/fleet path "
+                   "(must be MXNetError-typed)",
+    "naked-acquire": "lock acquired outside `with` without a matching "
+                     "try/finally release",
+    "wall-clock": "time.time() used where the monotonic-clock convention "
+                  "applies",
+    "lock-allowlist": "malformed lockwitness allowlist entry",
+}
+
+#: component directories where the monotonic-clock convention applies
+WALL_CLOCK_SCOPE = ("serving", "fleet", "resilience", "observability",
+                    "analysis")
+#: component directories where raises must be MXNetError-typed
+TYPED_RAISE_SCOPE = ("serving", "fleet")
+#: exception names considered untyped on those paths
+UNTYPED_RAISES = ("ValueError", "RuntimeError", "KeyError", "TypeError",
+                  "IndexError", "Exception")
+
+#: call names whose first positional string argument is a fault site
+FAULT_SITE_CALLS = ("inject", "_inject", "poison", "_poison", "maybe_fire",
+                    "_run_step")
+#: FaultPlan builder methods whose first argument is a fault site
+FAULT_PLAN_BUILDERS = ("raise_at", "delay_at", "kill_at", "call_at",
+                       "nonfinite_at", "corrupt_at")
+#: lockwitness constructors whose first argument is a lock site
+LOCK_SITE_CALLS = ("named_lock", "named_rlock", "named_condition",
+                   "_named_lock", "_named_rlock", "_named_condition")
+
+METRIC_RE = re.compile(r"^mxtpu_[a-z0-9_]+$")
+_METRIC_DOC_RE = re.compile(r"mxtpu_[a-z0-9_<>]*[a-z0-9_>]")
+_PRAGMA_RE = re.compile(r"#\s*mxlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+ALLOWLIST_KINDS = ("cycle", "blocking", "same_site")
+
+
+class Finding:
+    """One lint violation: where, which rule, and why."""
+
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = path
+        self.line = int(line)
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into the .py list to lint."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def _component(path: str) -> Optional[str]:
+    """The component directory a file lives in (``serving``, ``fleet``,
+    …): the segment after the LAST ``mxnet_tpu`` path element — a
+    checkout directory itself named ``mxnet_tpu`` must not shadow the
+    package root and silently widen/disable the scoped rules."""
+    parts = os.path.normpath(path).split(os.sep)
+    for i in range(len(parts) - 2, -1, -1):
+        if parts[i] == "mxnet_tpu":
+            nxt = parts[i + 1]
+            return None if nxt.endswith(".py") else nxt
+    # fixture trees: treat the immediate parent directory as component
+    return parts[-2] if len(parts) >= 2 else None
+
+
+def _pragmas(source: str) -> Dict[int, Set[str]]:
+    """line number → rules disabled on that line."""
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), 1):
+        m = _PRAGMA_RE.search(text)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _str_arg(call: ast.Call) -> Optional[Tuple[str, int]]:
+    """The first positional argument if it is a plain string literal."""
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value, call.args[0].lineno
+    return None
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+# --------------------------------------------------------- site collection
+
+def collect_registered_fault_sites(trees) -> Set[str]:
+    """Every ``register_site("...")`` literal in the scanned tree — the
+    static mirror of ``faults.KNOWN_SITES`` (faults.py declares the
+    in-tree sites with exactly these calls) — PLUS the in-package
+    faults.py registry itself, so a partial lint
+    (``mxlint.py mxnet_tpu/serving/engine.py``) that does not scan
+    faults.py still knows the real sites instead of flagging every
+    legitimate literal."""
+    sites: Set[str] = set()
+    trees = list(trees)
+    faults_py = os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "resilience",
+        "faults.py"))
+    if os.path.exists(faults_py) \
+            and not any(os.path.abspath(p) == faults_py
+                        for p, _t, _s in trees):
+        try:
+            with open(faults_py, encoding="utf-8") as f:
+                trees.append((faults_py, ast.parse(f.read()), ""))
+        except (OSError, SyntaxError):
+            pass
+    for _path, tree, _src in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node) == "register_site":
+                lit = _str_arg(node)
+                if lit:
+                    sites.add(lit[0])
+    return sites
+
+
+def collect_lock_sites(trees) -> Set[str]:
+    """Every lock/blocking site constructed in the scanned tree:
+    ``named_*`` first args (+ their ``.wait`` blocking names) and
+    ``note_blocking`` literals."""
+    sites: Set[str] = set()
+    for _path, tree, _src in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            lit = _str_arg(node)
+            if lit is None:
+                continue
+            if name in LOCK_SITE_CALLS:
+                sites.add(lit[0])
+                sites.add(lit[0] + ".wait")
+            elif name in ("note_blocking", "_note_blocking"):
+                sites.add(lit[0])
+    return sites
+
+
+def _doc_catalog(doc_path: Optional[str]):
+    """Parse docs/observability.md into (exact-name set, template-regex
+    list).  ``mxtpu_serving_<counter>_total`` becomes a family regex."""
+    exact: Set[str] = set()
+    families: List[re.Pattern] = []
+    if not doc_path or not os.path.exists(doc_path):
+        return None
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    for tok in set(_METRIC_DOC_RE.findall(text)):
+        if "<" in tok:
+            # templated family: mxtpu_serving_<counter>_total
+            pat = re.sub(r"<[a-z0-9_]+>", "[a-z0-9_]+", re.escape(tok))
+            families.append(re.compile("^" + pat + "$"))
+        else:
+            exact.add(tok)
+    return exact, families
+
+
+def _find_repo_root(paths: Sequence[str]) -> Optional[str]:
+    """Walk up from the first path to a directory holding docs/."""
+    cur = os.path.abspath(paths[0] if paths else ".")
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    for _ in range(10):
+        if os.path.isdir(os.path.join(cur, "docs")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+    return None
+
+
+# ----------------------------------------------------------------- checks
+
+def _check_fault_sites(path, tree, known: Set[str], findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in FAULT_SITE_CALLS or name in FAULT_PLAN_BUILDERS:
+            lit = _str_arg(node)
+            if lit is None:
+                continue            # dynamic site: runtime check owns it
+            site, line = lit
+            base = site.split("@", 1)[0]
+            if base not in known:
+                findings.append(Finding(
+                    path, line, "fault-site",
+                    f"fault site {site!r} is not registered in "
+                    f"faults.KNOWN_SITES — a typo'd site is silently "
+                    f"dead chaos coverage; declare it with "
+                    f"register_site()"))
+
+
+def _check_metric_names(path, tree, catalog, findings):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Constant) \
+                or not isinstance(node.value, str):
+            continue
+        v = node.value
+        # a CANDIDATE metric name: mxtpu_ + word chars only.  Thread
+        # names ('mxtpu-digest'), filenames ('mxtpu_io.cc'), prose and
+        # prefix fragments ('mxtpu_serving_') are not metric literals.
+        if not re.match(r"^mxtpu_[A-Za-z0-9_]+$", v) or v.endswith("_"):
+            continue
+        if not METRIC_RE.match(v):
+            findings.append(Finding(
+                path, node.lineno, "metric-name",
+                f"metric literal {v!r} violates the mxtpu_[a-z0-9_]+ "
+                f"naming convention"))
+            continue
+        if catalog is None:
+            continue
+        exact, families = catalog
+        if v in exact or any(f.match(v) for f in families):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "metric-name",
+            f"metric {v!r} is not in the docs/observability.md catalog "
+            f"— undocumented metrics are invisible to fleet dashboards"))
+
+
+def _check_typed_raises(path, tree, findings):
+    comp = _component(path)
+    if comp not in TYPED_RAISE_SCOPE:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            name = exc.id
+        if name in UNTYPED_RAISES:
+            findings.append(Finding(
+                path, node.lineno, "typed-raise",
+                f"raise {name} on a {comp}/ path — every failure a "
+                f"caller can see must be MXNetError-typed "
+                f"(docs/serving.md error taxonomy)"))
+
+
+def _stmt_blocks(tree):
+    """Yield every list of sibling statements in the module."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(node, field, None)
+            if isinstance(block, list) and block \
+                    and isinstance(block[0], ast.stmt):
+                yield block
+        for handler in getattr(node, "handlers", []) or []:
+            if handler.body:
+                yield handler.body
+
+
+def _check_naked_acquire(path, tree, findings):
+    acquires = [node for node in ast.walk(tree)
+                if isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"]
+    if not acquires:
+        return
+    # allowed shape: `x.acquire()` / `got = x.acquire(timeout=...)` as a
+    # statement whose NEXT sibling is a try whose finally releases the
+    # same object (a bounded acquire cannot use `with`, so this is the
+    # one blessed non-context form)
+    allowed = set()
+    for block in _stmt_blocks(tree):
+        for i, stmt in enumerate(block):
+            if isinstance(stmt, ast.Expr):
+                call = stmt.value
+            elif isinstance(stmt, ast.Assign):
+                call = stmt.value
+            else:
+                continue
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "acquire"
+                    and i + 1 < len(block)
+                    and isinstance(block[i + 1], ast.Try)):
+                continue
+            target = ast.dump(call.func.value)
+            for fin in block[i + 1].finalbody:
+                for sub in ast.walk(fin):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "release" \
+                            and ast.dump(sub.func.value) == target:
+                        allowed.add(id(stmt.value))
+    seen = set()
+    for node in acquires:
+        key = (node.lineno, node.col_offset)
+        if id(node) in allowed or key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            path, node.lineno, "naked-acquire",
+            "lock acquired outside `with` — an exception between "
+            "acquire and release leaks the lock; use `with lock:` "
+            "(or acquire immediately followed by try/finally "
+            "release)"))
+
+
+def _check_wall_clock(path, tree, findings):
+    if _component(path) not in WALL_CLOCK_SCOPE:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "time" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "time":
+            findings.append(Finding(
+                path, node.lineno, "wall-clock",
+                "time.time() where the monotonic-clock convention "
+                "applies — NTP steps make wall-clock deltas go "
+                "negative; use time.monotonic() (or pragma a genuine "
+                "epoch timestamp)"))
+
+
+def check_allowlist(allowlist_path: str, lock_sites: Set[str],
+                    findings: List[Finding]) -> None:
+    """Validate the lockwitness allowlist file (absent file = nothing
+    to validate)."""
+    if not os.path.exists(allowlist_path):
+        return
+    try:
+        with open(allowlist_path, encoding="utf-8") as f:
+            data = json.load(f)
+    except ValueError as e:
+        findings.append(Finding(allowlist_path, 1, "lock-allowlist",
+                                f"not valid JSON: {e}"))
+        return
+    entries = data.get("entries") if isinstance(data, dict) else data
+    if not isinstance(entries, list):
+        findings.append(Finding(
+            allowlist_path, 1, "lock-allowlist",
+            "expected {\"entries\": [...]} or a top-level list"))
+        return
+    for i, e in enumerate(entries):
+        where = f"entry {i}"
+        if not isinstance(e, dict):
+            findings.append(Finding(allowlist_path, 1, "lock-allowlist",
+                                    f"{where}: not an object"))
+            continue
+        kind = e.get("kind")
+        if kind not in ALLOWLIST_KINDS:
+            findings.append(Finding(
+                allowlist_path, 1, "lock-allowlist",
+                f"{where}: kind must be one of {ALLOWLIST_KINDS}, "
+                f"got {kind!r}"))
+        sites = e.get("sites")
+        if not (isinstance(sites, list) and sites
+                and all(isinstance(s, str) for s in sites)):
+            findings.append(Finding(
+                allowlist_path, 1, "lock-allowlist",
+                f"{where}: sites must be a non-empty list of strings"))
+            sites = []
+        for s in sites:
+            if lock_sites and s not in lock_sites:
+                findings.append(Finding(
+                    allowlist_path, 1, "lock-allowlist",
+                    f"{where}: unknown lock/blocking site {s!r} — not "
+                    f"constructed anywhere in the linted tree (stale "
+                    f"entry after a rename?)"))
+        just = e.get("justification", "")
+        if not isinstance(just, str) or len(just.strip()) < 20:
+            findings.append(Finding(
+                allowlist_path, 1, "lock-allowlist",
+                f"{where}: justification must explain WHY the finding "
+                f"is safe (>= 20 chars), got {just!r}"))
+
+
+# ------------------------------------------------------------------ driver
+
+def run_lint(paths: Sequence[str],
+             doc_catalog_path: Optional[str] = None,
+             allowlist_path: Optional[str] = None) -> List[Finding]:
+    """Lint ``paths`` (files or directories).  ``doc_catalog_path``
+    defaults to ``<repo>/docs/observability.md`` found by walking up
+    from the first path; ``allowlist_path`` defaults to the in-package
+    ``lockwitness_allowlist.json``.  Returns pragma-filtered findings
+    sorted by (path, line)."""
+    files = collect_files(paths)
+    trees = []
+    findings: List[Finding] = []
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 1, "parse",
+                                    f"syntax error: {e.msg}"))
+            continue
+        trees.append((path, tree, src))
+
+    known_sites = collect_registered_fault_sites(trees)
+    lock_sites = collect_lock_sites(trees)
+
+    root = _find_repo_root(paths)
+    if doc_catalog_path is None and root is not None:
+        cand = os.path.join(root, "docs", "observability.md")
+        doc_catalog_path = cand if os.path.exists(cand) else None
+    catalog = _doc_catalog(doc_catalog_path)
+
+    if allowlist_path is None:
+        from .lockwitness import DEFAULT_ALLOWLIST_PATH
+        allowlist_path = DEFAULT_ALLOWLIST_PATH
+    check_allowlist(allowlist_path, lock_sites, findings)
+
+    for path, tree, src in trees:
+        per_file: List[Finding] = []
+        _check_fault_sites(path, tree, known_sites, per_file)
+        _check_metric_names(path, tree, catalog, per_file)
+        _check_typed_raises(path, tree, per_file)
+        _check_naked_acquire(path, tree, per_file)
+        _check_wall_clock(path, tree, per_file)
+        pragmas = _pragmas(src)
+        for f in per_file:
+            disabled = pragmas.get(f.line, set())
+            if f.rule in disabled or "all" in disabled:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

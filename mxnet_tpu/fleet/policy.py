@@ -44,6 +44,8 @@ import hashlib
 import threading
 from typing import List, Optional, Sequence
 
+from ..analysis.lockwitness import named_lock as _named_lock
+from ..serving.errors import ServingError
 from ..serving.prefix_cache import PrefixCache
 
 __all__ = ["RoutingPolicy", "rendezvous_rank", "rendezvous_hash"]
@@ -71,7 +73,7 @@ def rendezvous_rank(key: bytes, names: Sequence[str]) -> List[str]:
 def rendezvous_hash(key: bytes, names: Sequence[str]) -> str:
     """The HRW winner for ``key`` among ``names``."""
     if not names:
-        raise ValueError("rendezvous_hash needs at least one name")
+        raise ServingError("rendezvous_hash needs at least one name")
     return rendezvous_rank(key, names)[0]
 
 
@@ -100,7 +102,8 @@ class RoutingPolicy:
         # indices are just LRU tickets bounding the tree
         self._tree = PrefixCache(int(tracker_entries), row_base=0,
                                  min_tokens=self.min_tokens)
-        self._lock = threading.Lock()
+        self._lock = _named_lock("fleet.policy.tracker",
+                                 "router-side prefix radix tracker")
 
     def affinity_key(self, tokens) -> Optional[bytes]:
         """The affinity key for a prompt, or ``None`` when it is too

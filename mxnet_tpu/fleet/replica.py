@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..analysis.lockwitness import named_lock as _named_lock
 from ..resilience.integrity import LatencyTracker
 from ..serving.overload import CircuitBreaker
 
@@ -97,7 +98,8 @@ class ReplicaHandle:
         self.suspects = 0            # consecutive gray ejections (ladder)
         self.total_suspects = 0
         self.suspect_until: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = _named_lock("fleet.replica",
+                                 "replica lifecycle state")
 
     # ---------------------------------------------------------------- state
     def routable(self) -> bool:

@@ -74,6 +74,8 @@ from typing import Callable, List, Optional, Sequence, Set, Tuple
 
 import numpy as onp
 
+from ..analysis.lockwitness import (named_lock as _named_lock,
+                                    note_blocking as _note_blocking)
 from ..resilience.faults import inject as _inject
 from ..serving.errors import (DeadlineInfeasibleError, EngineCrashedError,
                               EngineStoppedError, FleetSaturatedError,
@@ -126,7 +128,8 @@ class FleetFuture:
                  handle: ReplicaHandle, inner):
         self._router = router
         self._req = req
-        self._lock = threading.Lock()
+        self._lock = _named_lock("fleet.future",
+                                 "per-request attempt list")
         self._attempts: List[Tuple[ReplicaHandle, object]] = [(handle, inner)]
         self._exc: Optional[BaseException] = None   # terminal failure
         self._hedged = False
@@ -163,6 +166,7 @@ class FleetFuture:
                 any(f.done() for _h, f in self._attempts)
 
     def result(self, timeout: Optional[float] = None):
+        _note_blocking("fleet.future_wait")
         client_deadline = None if timeout is None else \
             time.monotonic() + timeout
         while True:
@@ -437,7 +441,7 @@ class FleetRouter:
                  seed: int = 0,
                  name: str = "fleet"):
         if routing not in ("affinity", "least_loaded", "random"):
-            raise ValueError(f"routing must be 'affinity'|'least_loaded'|"
+            raise ServingError(f"routing must be 'affinity'|'least_loaded'|"
                              f"'random', got {routing!r}")
         self.name = str(name)
         self.routing = routing
@@ -459,7 +463,8 @@ class FleetRouter:
         self.gray_multiplier = float(gray_multiplier)
         self.gray_min_samples = int(gray_min_samples)
         self.gray_window = int(gray_window)
-        self._sat_lock = threading.Lock()
+        self._sat_lock = _named_lock("fleet.router.saturation",
+                                     "all-replicas-shed event window")
         # last `saturation_threshold` all-replicas-shed event times
         self._sat_times = collections.deque(
             maxlen=max(1, self.saturation_threshold))
@@ -467,7 +472,8 @@ class FleetRouter:
         self._policy = RoutingPolicy(affinity_min_tokens, affinity_window,
                                      tracker_entries)
         self._rng = _pyrandom.Random(int(seed))
-        self._rng_lock = threading.Lock()
+        self._rng_lock = _named_lock("fleet.router.rng",
+                                     "seeded routing tiebreak RNG")
 
         if engines is None:
             if factory is None or not num_replicas:
@@ -504,10 +510,12 @@ class FleetRouter:
             else max(2, 2 * engines[0].num_slots)
 
         self._counters = {}
-        self._counters_lock = threading.Lock()
+        self._counters_lock = _named_lock("fleet.router.counters",
+                                          "fleet counter map")
         self._mon_stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
-        self._stop_lock = threading.Lock()
+        self._stop_lock = _named_lock("fleet.router.stop",
+                                      "stop()/drain mutual exclusion")
         self._stopping = False
         self._prev_handlers = None
         self._register_collector()

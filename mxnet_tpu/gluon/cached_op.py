@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import base as _base
+from ..analysis.lockwitness import named_rlock as _named_rlock
 from .. import random as _random
 from ..autograd.tape import OpNode, OutRef, node_of
 from ..ndarray import NDArray
@@ -43,7 +44,8 @@ _WARNED_FOREIGN_TRACE = False
 #: ``p._data`` read lands inside the swap window and captures a
 #: DynamicJaxprTracer (UnexpectedTracerError at its next dispatch).
 #: RLock: a trace that re-enters (nested pure fns) must not self-deadlock.
-_PARAM_SWAP_LOCK = threading.RLock()
+_PARAM_SWAP_LOCK = _named_rlock("gluon.param_swap",
+                                "trace-time parameter payload swaps")
 
 
 def param_snapshot(items):

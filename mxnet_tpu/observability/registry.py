@@ -41,6 +41,8 @@ import time
 import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockwitness import named_lock as _named_lock
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_registry"]
 
@@ -63,7 +65,7 @@ class Counter:
         self.name = name
         self.help = help
         self.labels = dict(labels or {})
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.metric", "per-metric value state")
         self._value = 0
 
     def inc(self, n: int = 1):
@@ -100,7 +102,7 @@ class Gauge:
         self.help = help
         self.labels = dict(labels or {})
         self.fn = fn
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.metric", "per-metric value state")
         self._value = 0.0
 
     def set(self, v: float):
@@ -151,7 +153,7 @@ class Histogram:
         self.name = name
         self.help = help
         self.labels = dict(labels or {})
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.metric", "per-metric value state")
         self._hist = LatencyHistogram()
 
     def observe(self, seconds: float):
@@ -210,7 +212,8 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.registry",
+                                 "metric/collector name maps")
         self._metrics: Dict[tuple, object] = {}
         self._collectors: Dict[str, Callable] = {}
 
@@ -301,7 +304,10 @@ class MetricsRegistry:
                 for key in dead_metrics:
                     self._metrics.pop(key, None)
         return {"schema_version": SCHEMA_VERSION,
-                "collected_at": time.time(),
+                # epoch timestamp for external consumers — never used
+                # for ordering, so the monotonic-clock convention does
+                # not apply
+                "collected_at": time.time(),  # mxlint: disable=wall-clock
                 "samples": samples}
 
 

@@ -34,6 +34,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..analysis.lockwitness import named_lock as _named_lock
+
 __all__ = ["Span", "Tracer", "enable", "disable", "active"]
 
 
@@ -122,7 +124,8 @@ class Tracer:
                  profiler_markers: bool = False):
         self.capacity = int(capacity)
         self.profiler_markers = bool(profiler_markers)
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.trace_ring",
+                                 "tracer span ring buffer")
         self._ring: deque = deque(maxlen=self.capacity)
         self._ids = itertools.count(1)
         self.dropped = 0          # spans evicted by the ring bound
@@ -212,7 +215,7 @@ class Tracer:
 # The one active tracer.  Written under _LOCK; read lock-free on hot
 # paths (a torn read of a single reference is impossible in CPython).
 _ACTIVE: Optional[Tracer] = None
-_LOCK = threading.Lock()
+_LOCK = _named_lock("obs.trace_global", "active-tracer swaps")
 
 
 def enable(capacity: int = 4096,

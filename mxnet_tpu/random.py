@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 import jax
 import numpy as onp
 
+from .analysis.lockwitness import named_lock as _named_lock
 from .context import Context, current_context
 
 __all__ = ["seed", "next_key", "RandomState", "push_trace_key",
@@ -32,7 +33,8 @@ _tls = threading.local()
 
 class RandomState:
     def __init__(self, seed_: int = 0):
-        self._lock = threading.Lock()
+        self._lock = _named_lock("random.generator",
+                                 "seeded generator state")
         self.seed(seed_)
 
     def seed(self, seed_: int, ctx: Optional[Context] = None):

@@ -68,18 +68,33 @@ fault as ``(site, hit, action)`` so tests and
 from __future__ import annotations
 
 import random as _pyrandom
+import re
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from ..analysis.lockwitness import named_lock as _named_lock
 from ..base import MXNetError
 
 __all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "RetryableFault",
-           "SimulatedPreemption", "inject", "poison", "active_plan"]
+           "SimulatedPreemption", "UnknownFaultSiteError", "inject",
+           "poison", "active_plan", "register_site", "known_sites",
+           "KNOWN_SITES"]
 
 
 class InjectedFault(MXNetError):
     """An exception raised on purpose by an active :class:`FaultPlan`."""
+
+
+class UnknownFaultSiteError(MXNetError):
+    """A :class:`FaultPlan` targeted a site nobody registered.
+
+    Before this error existed a typo'd site (``"serving.decode_setp"``)
+    built a plan that silently never fired — dead chaos coverage that
+    LOOKED like a passing test.  Sites are now declared centrally in
+    :data:`KNOWN_SITES` (or by callers via :func:`register_site`) and
+    plan builders reject anything else at build time, where the typo is
+    one stack frame from its author."""
 
 
 class RetryableFault(InjectedFault):
@@ -97,6 +112,85 @@ class SimulatedPreemption(BaseException):
     """
 
 
+# --------------------------------------------------------------- site registry
+#
+# The central declaration of every injection site in the tree.  Call
+# sites fire these literals through :func:`inject`/:func:`poison`;
+# ``tools/mxlint.py`` (docs/static_analysis.md, rule fault-site) checks
+# statically that every fired literal appears here, and plan builders
+# check dynamically that every TARGETED site does — both directions of
+# the typo'd-site failure mode are closed.
+KNOWN_SITES: dict = {}
+
+_SITE_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+
+def register_site(site: str, doc: str = "") -> str:
+    """Declare an injection site (idempotent; returns ``site``).
+
+    Sites are dotted lowercase paths (``"subsystem.event"``).  The
+    in-tree sites below are registered at import; tests and downstream
+    code exercising the fault machinery with their own sites must
+    register them first — that is the point: a site nobody declared is
+    a site nobody instruments."""
+    if not _SITE_RE.match(site):
+        raise MXNetError(
+            f"invalid fault site name {site!r}: want dotted lowercase "
+            f"like 'serving.decode_step'")
+    KNOWN_SITES.setdefault(site, doc)
+    return site
+
+
+def known_sites() -> tuple:
+    """Sorted snapshot of every registered site."""
+    return tuple(sorted(KNOWN_SITES))
+
+
+def _site_base(site: str) -> str:
+    """Strip the ``@<scope>`` suffix a scoped plan targets."""
+    return site.split("@", 1)[0]
+
+
+def _check_site(site: str) -> str:
+    if not isinstance(site, str) or _site_base(site) not in KNOWN_SITES:
+        raise UnknownFaultSiteError(
+            f"unknown fault site {site!r}: not in faults.KNOWN_SITES — "
+            f"a plan targeting it would silently never fire; declare it "
+            f"with faults.register_site() (known: "
+            f"{', '.join(known_sites())})")
+    return site
+
+
+# serving engine (docs/serving.md, docs/resilience.md)
+register_site("serving.scheduler", "top of every scheduler cycle")
+register_site("serving.prefill", "batched full/chunked prefill dispatch")
+register_site("serving.decode_step", "batched decode-step dispatch")
+register_site("serving.forward", "batched forward-mode dispatch")
+register_site("serving.prefix_lookup", "prefix-cache host radix-tree ops")
+register_site("serving.prefix_copy", "prefix-cache compiled row copy")
+# overload control (docs/overload.md) — degrades, never fails a request
+register_site("overload.admission", "priority/deadline admission gate")
+register_site("overload.preempt", "slot-preemption attempt")
+# training (docs/resilience.md, docs/guardrails.md)
+register_site("trainer.step", "ShardedTrainer compiled step")
+register_site("trainer.loss_nonfinite", "poison: loss NaN/Inf splice")
+register_site("trainer.grad_nonfinite", "poison: gradient NaN/Inf splice")
+register_site("io.bad_batch", "poison: corrupt an input batch")
+# checkpointing (docs/resilience.md, docs/integrity.md)
+register_site("checkpoint.save", "AtomicCheckpointer serialize phase")
+register_site("checkpoint.commit", "AtomicCheckpointer commit rename")
+register_site("checkpoint.restore", "checkpoint restore/deserialize")
+register_site("checkpoint.corrupt", "poison: post-commit bit rot")
+register_site("serialization.commit", "utils.serialization atomic replace")
+# kvstore
+register_site("kvstore.push", "kvstore push RPC")
+register_site("kvstore.pull", "kvstore pull RPC")
+# fleet tier (docs/fleet.md)
+register_site("fleet.route", "placement decision (degrades least-loaded)")
+register_site("fleet.failover", "one failover attempt (budget untouched)")
+register_site("fleet.drain", "replica drain (delay models a hang)")
+
+
 class FaultSpec:
     """One registered fault: where, when, and what."""
 
@@ -109,10 +203,10 @@ class FaultSpec:
                  fn: Optional[Callable] = None, value: float = float("nan"),
                  max_fires: Optional[int] = None):
         if action not in ("raise", "delay", "kill", "call", "corrupt"):
-            raise ValueError(f"unknown fault action {action!r}")
+            raise MXNetError(f"unknown fault action {action!r}")
         if sum(x is not None for x in (at, every, prob)) != 1:
-            raise ValueError("exactly one of at=/every=/prob= must be set")
-        self.site = site
+            raise MXNetError("exactly one of at=/every=/prob= must be set")
+        self.site = _check_site(site)
         self.action = action
         self.at = at
         self.every = every
@@ -145,7 +239,7 @@ class FaultSpec:
 # The one active plan.  Written only under _PLAN_LOCK; read lock-free on
 # the hot path (a torn read is impossible for a single reference).
 _ACTIVE: Optional["FaultPlan"] = None
-_PLAN_LOCK = threading.Lock()
+_PLAN_LOCK = _named_lock("faults.plan_global", "active-plan swaps")
 
 
 class FaultPlan:
@@ -168,7 +262,7 @@ class FaultPlan:
     def __init__(self, seed: int = 0):
         self.seed = int(seed)
         self._rng = _pyrandom.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = _named_lock("faults.plan", "per-plan hit counters")
         self.specs: List[FaultSpec] = []
         self.hits: dict = {}
         self.log: List[Tuple[str, int, str]] = []

@@ -46,6 +46,7 @@ import threading
 import warnings
 from typing import Dict, Optional, Tuple
 
+from ..analysis.lockwitness import named_lock as _named_lock
 from ..base import MXNetError
 
 __all__ = ["CheckpointCorruptError", "LatencyTracker", "MANIFEST_FILE",
@@ -64,7 +65,8 @@ _DIGEST_SIZE = 16          # BLAKE2b-128: collision-safe for bit rot
 # CPU-sanity state files single-leaf = single-core)
 _TREE_CHUNK = 1 << 20
 _DIGEST_WORKERS = max(2, min(8, os.cpu_count() or 2))
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = _named_lock("integrity.digest_pool",
+                         "lazy shared leaf-hash executor")
 _POOL = None
 
 
@@ -333,7 +335,8 @@ class LatencyTracker:
             maxlen=max(1, int(window)))
         self.ewma = 0.0
         self.total = 0          # lifetime observations (never reset back)
-        self._lock = threading.Lock()
+        self._lock = _named_lock("integrity.latency_tracker",
+                                 "gray-failure latency window")
 
     def observe(self, seconds: float):
         s = max(0.0, float(seconds))

@@ -34,7 +34,8 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from .errors import EngineStoppedError, QueueFullError
+from ..analysis.lockwitness import named_condition as _named_condition
+from .errors import EngineStoppedError, QueueFullError, ServingError
 from .overload import PRIORITIES, PRIORITY_BATCH
 
 __all__ = ["BucketLattice", "DynamicBatcher"]
@@ -64,7 +65,7 @@ class BucketLattice:
         sb = tuple(sorted(set(seq_buckets))) if seq_buckets else \
             _pow2_lattice(min(16, max_seq), max_seq)
         if bb[0] < 1 or sb[0] < 1:
-            raise ValueError(f"buckets must be >= 1, got {bb} / {sb}")
+            raise ServingError(f"buckets must be >= 1, got {bb} / {sb}")
         self.batch_buckets = bb
         self.seq_buckets = sb
 
@@ -73,7 +74,7 @@ class BucketLattice:
         for b in buckets:
             if v <= b:
                 return b
-        raise ValueError(f"{v} exceeds largest bucket {buckets[-1]}")
+        raise ServingError(f"{v} exceeds largest bucket {buckets[-1]}")
 
     def batch(self, n: int) -> int:
         return self._round_up(n, self.batch_buckets)
@@ -128,7 +129,8 @@ class DynamicBatcher:
     def __init__(self, max_depth: int = 64,
                  cond: Optional[threading.Condition] = None):
         self.max_depth = max_depth
-        self._cond = cond or threading.Condition()
+        self._cond = cond or _named_condition(
+            "serving.batcher.cond", "standalone-batcher admission queue")
         # one FIFO per priority class, highest (ordinal 0) first
         self._qs: Tuple[deque, ...] = tuple(
             deque() for _ in PRIORITIES)

@@ -86,6 +86,9 @@ from typing import Optional, Sequence
 
 import numpy as onp
 
+from ..analysis.lockwitness import (named_condition as _named_condition,
+                                    named_lock as _named_lock,
+                                    note_blocking as _note_blocking)
 from ..observability.trace import active as _trace_active
 from ..resilience.faults import RetryableFault, inject as _inject
 from .batcher import BucketLattice, DynamicBatcher
@@ -112,7 +115,8 @@ __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
 # engine releases its name, so sequential same-name engines (tests, the
 # rebuilt-engine case) keep the plain name.
 _LIVE_NAMES = weakref.WeakValueDictionary()
-_NAME_LOCK = threading.Lock()
+_NAME_LOCK = _named_lock("serving.engine_names",
+                         "process-wide live-engine name claims")
 
 
 def _claim_engine_name(base: str, engine: "InferenceEngine") -> str:
@@ -171,6 +175,7 @@ class InferenceFuture:
             self._ev.set()
 
     def result(self, timeout: Optional[float] = None):
+        _note_blocking("serving.future_wait")
         if not self._ev.wait(timeout):
             raise TimeoutError("result() wait timed out (the request may "
                                "still complete server-side)")
@@ -335,9 +340,9 @@ class InferenceEngine:
             mode = "decode" if hasattr(net, "decode_step") and \
                 hasattr(net, "prefill_slots") else "forward"
         if mode not in ("decode", "forward"):
-            raise ValueError(f"mode must be 'decode'|'forward', got {mode}")
+            raise ServingError(f"mode must be 'decode'|'forward', got {mode}")
         if mode == "decode" and not hasattr(net, "prefill_slots"):
-            raise ValueError(f"{type(net).__name__} lacks the serving "
+            raise ServingError(f"{type(net).__name__} lacks the serving "
                              "decode surface (prefill_slots/decode_step)")
         self.net = net
         self.mode = mode
@@ -358,7 +363,7 @@ class InferenceEngine:
             self.max_length = int(max_length or net.max_length)
             if getattr(net, "max_length", None) is not None and \
                     self.max_length > net.max_length:
-                raise ValueError(
+                raise ServingError(
                     f"max_length={self.max_length} exceeds the model's "
                     f"position table (net.max_length={net.max_length}) — "
                     "positions past it would silently clamp, not error")
@@ -368,18 +373,18 @@ class InferenceEngine:
                 max_batch=min(self.max_batch, self.num_slots),
                 max_seq=self.max_length)
             if self.lattice.max_seq > self.max_length:
-                raise ValueError(
+                raise ServingError(
                     f"largest seq bucket {self.lattice.max_seq} exceeds "
                     f"KV length max_length={self.max_length}")
             self._alloc = SlotAllocator(self.num_slots)
             self.prefix_pool_rows = int(prefix_pool_rows)
             if self.prefix_pool_rows < 0:
-                raise ValueError(f"prefix_pool_rows must be >= 0, got "
+                raise ServingError(f"prefix_pool_rows must be >= 0, got "
                                  f"{self.prefix_pool_rows}")
             self.prefill_chunk = int(prefill_chunk) \
                 if prefill_chunk is not None else self.lattice.max_seq
             if self.prefill_chunk < 1:
-                raise ValueError(f"prefill_chunk must be >= 1, got "
+                raise ServingError(f"prefill_chunk must be >= 1, got "
                                  f"{self.prefill_chunk}")
             self.prefill_chunk = min(self.prefill_chunk,
                                      self.lattice.max_seq)
@@ -421,10 +426,13 @@ class InferenceEngine:
         self.watchdog_interval = float(watchdog_interval)
         self.max_request_retries = int(max_request_retries)
         self.retry_backoff = float(retry_backoff)
-        self._cond = threading.Condition()
+        self._cond = _named_condition(
+            "serving.engine.cond", "admission queue + scheduler wakeups")
         self._batcher = DynamicBatcher(queue_depth, cond=self._cond)
-        self._step_lock = threading.Lock()
-        self._stop_lock = threading.Lock()
+        self._step_lock = _named_lock(
+            "serving.engine.step", "in-flight state vs stop()/watchdog")
+        self._stop_lock = _named_lock(
+            "serving.engine.stop", "stop()/condemn() mutual exclusion")
         self._thread: Optional[threading.Thread] = None
         self._watchdog = None
         self._heartbeat: Optional[float] = None
@@ -1008,11 +1016,14 @@ class InferenceEngine:
         try:
             pr = self.default_priority if priority is None \
                 else priority_ordinal(priority)
-        except ValueError as e:
+        except ServingError as e:
             # an unknown class is the REQUEST's own fault and must obey
-            # the typed-error contract like every other bad input — a
-            # raw ValueError would skip the rejection audit and escape
-            # the fleet router's exception taxonomy untyped
+            # the typed-error contract like every other bad input:
+            # priority_ordinal's generic ServingError is re-raised as
+            # InvalidRequestError through the rejection audit so it
+            # stamps exactly one counter + one trace event
+            if isinstance(e, InvalidRequestError):
+                raise
             self._reject("invalid", InvalidRequestError(str(e)))
         if self._crashed is not None:
             self._reject("crashed",
@@ -1279,6 +1290,10 @@ class InferenceEngine:
         while True:
             try:
                 _inject(site, scope=self.name)
+                # compiled-program dispatch blocks for the whole device
+                # step — doing so under any project lock stalls every
+                # producer for that long (lockwitness finding)
+                _note_blocking("serving.dispatch")
                 if counted:
                     # a retry re-executes device work (an honest span)
                     # but is the SAME logical step: don't re-count the

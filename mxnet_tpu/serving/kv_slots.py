@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .errors import ServingError
+
 __all__ = ["SlotState", "SlotAllocator"]
 
 
@@ -76,7 +78,7 @@ class SlotAllocator:
 
     def __init__(self, num_slots: int):
         if num_slots < 1:
-            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+            raise ServingError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
         self.scratch = num_slots           # row S of the (S+1, ...) cache
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
@@ -94,7 +96,7 @@ class SlotAllocator:
         """Lease a free row for ``state``; raises if none free (the
         engine admits at most ``free_count`` requests per cycle)."""
         if not self._free:
-            raise RuntimeError("no free KV slots (admission bug: engine "
+            raise ServingError("no free KV slots (admission bug: engine "
                                "must admit <= free_count)")
         slot = self._free.pop()
         self._active[slot] = state

@@ -21,6 +21,7 @@ import weakref
 from typing import Dict, List, Optional
 
 from .. import profiler as _profiler
+from ..analysis.lockwitness import named_lock as _named_lock
 
 __all__ = ["LatencyHistogram", "ServingMetrics"]
 
@@ -155,7 +156,8 @@ class ServingMetrics:
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = _named_lock("serving.metrics",
+                                 "per-engine counter/histogram state")
         self.counters = {k: 0 for k in self._COUNTERS}
         # overload observability (docs/overload.md): sheds keyed by
         # (reason, priority class) and completions keyed by class —

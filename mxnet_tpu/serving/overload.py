@@ -46,6 +46,9 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis.lockwitness import named_lock as _named_lock
+from .errors import ServingError
+
 __all__ = ["PRIORITIES", "PRIORITY_INTERACTIVE", "PRIORITY_BATCH",
            "PRIORITY_BEST_EFFORT", "priority_ordinal", "priority_name",
            "SHED_REASONS", "OverloadController", "RetryBudget",
@@ -70,12 +73,12 @@ def priority_ordinal(priority) -> int:
     classes so a typo'd priority fails the submit, not the scheduler."""
     if isinstance(priority, int):
         if not 0 <= priority < len(PRIORITIES):
-            raise ValueError(f"priority ordinal out of range: {priority}")
+            raise ServingError(f"priority ordinal out of range: {priority}")
         return priority
     try:
         return PRIORITIES.index(priority)
     except ValueError:
-        raise ValueError(f"unknown priority {priority!r} — expected one "
+        raise ServingError(f"unknown priority {priority!r} — expected one "
                          f"of {PRIORITIES}") from None
 
 
@@ -129,7 +132,7 @@ class OverloadController:
         self.interval = float(interval)
         self.hold = float(hold)
         if not (0.0 < self.floor <= 1.0):
-            raise ValueError(f"floor must be in (0, 1], got {self.floor}")
+            raise ServingError(f"floor must be in (0, 1], got {self.floor}")
         self.factor = 1.0
         self.brownouts = 0           # lifetime brownout entries
         self._last_change = 0.0
@@ -240,11 +243,12 @@ class RetryBudget:
         self.rate = float(rate)
         self.burst = float(burst)
         if self.rate < 0 or self.burst < 1:
-            raise ValueError(f"need rate >= 0 and burst >= 1, got "
+            raise ServingError(f"need rate >= 0 and burst >= 1, got "
                              f"rate={rate}, burst={burst}")
         self._tokens = self.burst
         self._t: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = _named_lock("fleet.retry_budget",
+                                 "failover/hedge token bucket")
         self.denied = 0              # lifetime try_acquire failures
 
     def _refill(self, now: float) -> None:
@@ -301,7 +305,8 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: Optional[float] = None
         self._probe_at: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = _named_lock("fleet.circuit_breaker",
+                                 "per-replica breaker state")
         self.opens = 0               # lifetime open transitions
 
     def allow(self, now: Optional[float] = None) -> bool:

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from .errors import ServingError
+
 __all__ = ["PrefixCache", "PrefixEntry"]
 
 
@@ -76,7 +78,7 @@ class PrefixCache:
     def __init__(self, pool_rows: int, row_base: int,
                  min_tokens: int = 1):
         if pool_rows < 1:
-            raise ValueError(f"pool_rows must be >= 1, got {pool_rows}")
+            raise ServingError(f"pool_rows must be >= 1, got {pool_rows}")
         self.pool_rows = int(pool_rows)
         self.row_base = int(row_base)
         self.min_tokens = max(1, int(min_tokens))
@@ -151,7 +153,7 @@ class PrefixCache:
 
     def unpin(self, entry: PrefixEntry):
         if entry.refs <= 0:
-            raise RuntimeError(f"unpin of unpinned {entry!r}")
+            raise ServingError(f"unpin of unpinned {entry!r}")
         entry.refs -= 1
 
     # -------------------------------------------------------------- insert

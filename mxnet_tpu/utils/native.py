@@ -17,11 +17,13 @@ from typing import Optional
 
 import numpy as onp
 
+from ..analysis.lockwitness import named_lock as _named_lock
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "src", "mxtpu_io.cc"))
 _LIB = os.path.abspath(os.path.join(_NATIVE_DIR, "libmxtpu_io.so"))
 
-_lock = threading.Lock()
+_lock = _named_lock("native.build", "one-shot native lib build")
 _lib = None
 _tried = False
 

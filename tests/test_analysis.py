@@ -1,0 +1,477 @@
+"""mxnet_tpu.analysis — the mxlint static rules and the lockwitness
+runtime lock-order witness (docs/static_analysis.md).
+
+Three contract groups:
+
+1. Per-rule fixtures: each mxlint rule catches its seeded violation
+   (positive) and stays quiet on the compliant twin (negative).
+2. The repo itself is clean: ``run_lint(mxnet_tpu/)`` returns zero
+   findings — the tier-1 guard that keeps future PRs inside the
+   invariants PRs 1–8 accumulated.
+3. Lockwitness semantics: constructed A→B / B→A cycles are detected,
+   blocking-under-lock is detected, and the disabled mode returns
+   PLAIN threading primitives (the zero-cost contract, like the
+   ``obs`` marker's tracing-overhead test but structural: disabled
+   means the witness isn't even in the call path).
+"""
+import os
+import sys
+import threading
+
+import pytest
+
+from mxnet_tpu.analysis import lockwitness as lw
+from mxnet_tpu.analysis.lint import Finding, RULES, run_lint
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience.faults import (FaultPlan, KNOWN_SITES,
+                                         UnknownFaultSiteError,
+                                         register_site)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mxnet_tpu")
+CATALOG = os.path.join(REPO, "docs", "observability.md")
+
+
+# ------------------------------------------------------------ lint fixtures
+
+
+def _lint_snippet(tmp_path, source, component="serving", name="fix.py",
+                  catalog=None):
+    d = tmp_path / component
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(source, encoding="utf-8")
+    return run_lint([str(tmp_path)], doc_catalog_path=catalog,
+                    allowlist_path=str(tmp_path / "no_allowlist.json"))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_rule_fault_site(tmp_path):
+    bad = (
+        "from mxnet_tpu.resilience.faults import inject, register_site\n"
+        "register_site('fixture.good')\n"
+        "inject('fixture.good')\n"
+        "inject('fixture.good@replica-1')\n"
+        "inject('fixture.typo')\n"
+    )
+    fs = _lint_snippet(tmp_path, bad)
+    assert _rules(fs) == ["fault-site"]
+    assert len(fs) == 1 and "'fixture.typo'" in fs[0].message
+    # FaultPlan builders are covered too
+    (tmp_path / "serving" / "fix.py").write_text(
+        "from mxnet_tpu.resilience.faults import FaultPlan\n"
+        "FaultPlan().kill_at('fixture.unseen', at=1)\n")
+    fs = run_lint([str(tmp_path)])
+    assert _rules(fs) == ["fault-site"]
+
+
+def test_rule_metric_name(tmp_path):
+    cat = tmp_path / "catalog.md"
+    cat.write_text("| `mxtpu_fixture_documented` | gauge |\n"
+                   "| `mxtpu_fixture_<counter>_total` | counter |\n")
+    src = (
+        "A = 'mxtpu_fixture_documented'\n"       # exact: ok
+        "B = 'mxtpu_fixture_anything_total'\n"   # family match: ok
+        "C = 'mxtpu_fixture_'\n"                 # prefix fragment: skipped
+        "D = 'mxtpu-fixture-thread'\n"           # thread name: skipped
+        "E = 'mxtpu_Fixture_Bad'\n"              # naming violation
+        "F = 'mxtpu_fixture_undocumented'\n"     # not in catalog
+    )
+    fs = _lint_snippet(tmp_path, src, catalog=str(cat))
+    assert _rules(fs) == ["metric-name"] and len(fs) == 2
+    lines = sorted(f.line for f in fs)
+    assert lines == [5, 6]
+
+
+def test_rule_typed_raise(tmp_path):
+    src = (
+        "from mxnet_tpu.base import MXNetError\n"
+        "class GoodError(MXNetError):\n    pass\n"
+        "def f(x):\n"
+        "    if x == 1:\n        raise ValueError('untyped')\n"
+        "    if x == 2:\n        raise RuntimeError('untyped')\n"
+        "    raise GoodError('typed is fine')\n"
+    )
+    fs = _lint_snippet(tmp_path, src, component="fleet")
+    assert _rules(fs) == ["typed-raise"] and len(fs) == 2
+    # outside serving/fleet the taxonomy rule does not apply
+    fs = _lint_snippet(tmp_path, src, component="gluon")
+    assert all(f.rule != "typed-raise" or "fleet" in f.path for f in fs)
+    # a CHECKOUT directory itself named mxnet_tpu must not shadow the
+    # package root and un-scope the rule (component = segment after the
+    # LAST mxnet_tpu element)
+    fs = _lint_snippet(tmp_path / "mxnet_tpu" / "mxnet_tpu", src,
+                       component="serving")
+    assert "typed-raise" in _rules(fs)
+
+
+def test_rule_naked_acquire(tmp_path):
+    src = (
+        "import threading\n"
+        "L = threading.Lock()\n"
+        "def good():\n"
+        "    with L:\n        pass\n"
+        "    got = L.acquire(timeout=1.0)\n"
+        "    try:\n        pass\n"
+        "    finally:\n"
+        "        if got:\n            L.release()\n"
+        "def bad():\n"
+        "    L.acquire()\n"
+        "    L.release()\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["naked-acquire"] and len(fs) == 1
+    assert fs[0].line == 13
+
+
+def test_rule_wall_clock_scoped_and_pragma(tmp_path):
+    src = ("import time\n"
+           "def f():\n"
+           "    t0 = time.time()\n"
+           "    t1 = time.time()  # mxlint: disable=wall-clock\n"
+           "    return time.monotonic() - t0 + t1\n")
+    fs = _lint_snippet(tmp_path, src, component="resilience")
+    assert _rules(fs) == ["wall-clock"] and len(fs) == 1
+    assert fs[0].line == 3                    # the pragma'd line passed
+    # outside the convention components wall clock is allowed
+    assert _lint_snippet(tmp_path / "other", src, component="gluon") == []
+
+
+def test_rule_lock_allowlist(tmp_path):
+    d = tmp_path / "serving"
+    d.mkdir()
+    (d / "locks.py").write_text(
+        "from mxnet_tpu.analysis.lockwitness import named_lock\n"
+        "L = named_lock('fixture.lock_a')\n")
+    allow = tmp_path / "allow.json"
+    # well-formed entry: quiet
+    allow.write_text(
+        '{"entries": [{"kind": "blocking", "sites": ["fixture.lock_a"], '
+        '"justification": "held only for a bounded in-memory append"}]}')
+    fs = run_lint([str(tmp_path)], allowlist_path=str(allow))
+    assert fs == []
+    # unknown site + bad kind + missing justification: three findings
+    allow.write_text(
+        '{"entries": [{"kind": "nonsense", "sites": ["fixture.renamed"], '
+        '"justification": "no"}]}')
+    fs = run_lint([str(tmp_path)], allowlist_path=str(allow))
+    assert _rules(fs) == ["lock-allowlist"] and len(fs) == 3
+
+
+def test_partial_lint_knows_real_fault_sites():
+    """Linting a single file must not false-positive on legitimate
+    sites: the in-package faults.py registry is merged in even when it
+    is outside the scanned set."""
+    engine = os.path.join(PKG, "serving", "engine.py")
+    findings = run_lint([engine], doc_catalog_path=CATALOG)
+    assert [f for f in findings if f.rule == "fault-site"] == [], findings
+
+
+def test_repo_is_lint_clean():
+    """THE tier-1 guard: the shipped tree has zero findings, so any
+    future drift from the codified contracts fails CI here."""
+    findings = run_lint([PKG], doc_catalog_path=CATALOG)
+    assert findings == [], "\n".join(repr(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import mxlint
+    finally:
+        sys.path.pop(0)
+    assert mxlint.main([PKG, "--doc-catalog", CATALOG]) == 0
+    bad = tmp_path / "fleet"
+    bad.mkdir()
+    (bad / "x.py").write_text("def f():\n    raise ValueError('x')\n")
+    out = tmp_path / "report.json"
+    assert mxlint.main([str(tmp_path), "--json", str(out)]) == 1
+    import json
+    rep = json.loads(out.read_text())
+    assert rep["count"] == 1 and rep["findings"][0]["rule"] == "typed-raise"
+    assert mxlint.main([str(tmp_path / "missing")]) == 2
+    assert mxlint.main(["--list-rules"]) == 0
+
+
+# ------------------------------------------------------- fault site registry
+
+
+def test_fault_plan_rejects_unknown_site_typed():
+    with pytest.raises(UnknownFaultSiteError):
+        FaultPlan().raise_at("serving.decode_setp", at=1)   # the typo
+    with pytest.raises(UnknownFaultSiteError):
+        FaultPlan().delay_at("nobody.registered", 0.1, every=1)
+    # scoped targeting validates the base site
+    FaultPlan().delay_at("serving.decode_step@some-replica", 0.1, at=1)
+    with pytest.raises(UnknownFaultSiteError):
+        FaultPlan().delay_at("serving.decode_setp@r1", 0.1, at=1)
+
+
+def test_register_site_validates_and_is_idempotent():
+    s = register_site("fixture.reg_site", "doc one")
+    assert s == "fixture.reg_site" and KNOWN_SITES[s] == "doc one"
+    register_site("fixture.reg_site", "doc two")     # idempotent: first doc
+    assert KNOWN_SITES[s] == "doc one"
+    with pytest.raises(MXNetError):
+        register_site("NotDotted")
+    with pytest.raises(MXNetError):
+        register_site("Upper.Case")
+    # every in-tree inject/poison literal is centrally declared
+    for site in ("serving.decode_step", "overload.preempt", "fleet.route",
+                 "checkpoint.corrupt", "trainer.grad_nonfinite",
+                 "kvstore.pull", "serialization.commit", "io.bad_batch"):
+        assert site in KNOWN_SITES
+
+
+# --------------------------------------------------------------- lockwitness
+
+
+@pytest.fixture
+def witness():
+    prev = lw.active_witness()       # a MXTPU_LOCKWITNESS=1 suite run
+    w = lw.enable()
+    try:
+        yield w
+    finally:
+        lw.disable()
+        if prev is not None:         # restore the suite-wide witness
+            with lw._WITNESS_LOCK:
+                lw._ACTIVE = prev
+
+
+def test_disabled_mode_zero_cost_contract():
+    """Disabled, the constructors return PLAIN threading primitives:
+    no wrapper in the call path at all — the structural analogue of
+    faults.py's one-global-load-plus-None-check contract."""
+    if lw.active_witness() is not None:
+        pytest.skip("suite runs under MXTPU_LOCKWITNESS=1 — the "
+                    "disabled-mode contract is meaningless here")
+    assert lw.active_witness() is None
+    assert type(lw.named_lock("fixture.zc")) is type(threading.Lock())
+    assert isinstance(lw.named_condition("fixture.zc_cond"),
+                      threading.Condition)
+    assert not isinstance(lw.named_condition("fixture.zc_cond"),
+                          lw._WitnessedCondition)
+    # note_blocking with no witness: pure no-op
+    lw.note_blocking("fixture.zc_block")
+    # sites are still registered for the linter's benefit
+    assert "fixture.zc" in lw.KNOWN_LOCK_SITES
+
+
+def test_cycle_detected(witness):
+    a = lw.named_lock("fixture.cyc_a")
+    b = lw.named_lock("fixture.cyc_b")
+    with a:
+        with b:
+            pass
+    assert witness.cycles() == []        # one direction alone is fine
+    with b:
+        with a:
+            pass
+    cyc = witness.cycles()
+    assert len(cyc) == 1
+    assert set(cyc[0]["sites"]) == {"fixture.cyc_a", "fixture.cyc_b"}
+    rep = witness.report()
+    assert rep["cycles"] == 1 and rep["edges"] >= 2
+    assert rep["acquisitions"] >= 4
+
+
+def _restore(prev):
+    lw.disable()
+    if prev is not None:
+        with lw._WITNESS_LOCK:
+            lw._ACTIVE = prev
+
+
+def test_cycle_raises_in_strict_mode():
+    prev = lw.active_witness()
+    w = lw.enable(raise_on_cycle=True)
+    try:
+        a = lw.named_lock("fixture.strict_a")
+        b = lw.named_lock("fixture.strict_b")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lw.LockOrderError):
+            with b:
+                with a:
+                    pass
+        # the acquisition that raised must UNDO itself: the raw lock
+        # released and the held-stack entry popped — a caller catching
+        # LockOrderError at a request boundary must not inherit a
+        # leaked lock or phantom ordering edges
+        with a:
+            pass                 # re-acquirable immediately
+        assert all(not s for s in w._stacks.values())
+    finally:
+        _restore(prev)
+
+
+def test_cross_thread_cycle_detected(witness):
+    """The witness merges per-thread observations into one graph: the
+    A→B edge from thread 1 plus B→A from thread 2 is the deadlock."""
+    a = lw.named_lock("fixture.xt_a")
+    b = lw.named_lock("fixture.xt_b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    assert witness.cycles() == []
+    th = threading.Thread(target=t2)
+    th.start()
+    th.join()
+    assert len(witness.cycles()) == 1
+
+
+def test_cross_thread_release_leaves_no_phantom(witness):
+    """threading.Lock allows release from another thread (handoff).
+    The releasing thread must pop the OWNER's held entry, or the stale
+    entry fabricates phantom edges for the owner's lifetime."""
+    handoff = lw.named_lock("fixture.handoff")
+    other = lw.named_lock("fixture.handoff_other")
+    handoff.acquire()
+    th = threading.Thread(target=handoff.release)
+    th.start()
+    th.join()
+    with other:                  # must NOT create handoff -> other
+        pass
+    assert witness.report()["edge_list"] == []
+    assert all(not s for s in witness._stacks.values())
+
+
+def test_blocking_under_lock_detected(witness):
+    l1 = lw.named_lock("fixture.blk_hold")
+    lw.note_blocking("fixture.blk_free")          # no lock held: quiet
+    with l1:
+        lw.note_blocking("fixture.blk_call")
+    found = [f for f in witness.findings if f["kind"] == "blocking"]
+    assert len(found) == 1
+    assert "fixture.blk_hold" in found[0]["sites"]
+    assert "fixture.blk_call" in found[0]["sites"]
+
+
+def test_condition_wait_own_lock_is_quiet(witness):
+    """cond.wait releases ITS OWN lock — only a SECOND held lock makes
+    waiting a finding."""
+    cond = lw.named_condition("fixture.cw_cond")
+    with cond:
+        cond.wait(timeout=0.01)
+    assert [f for f in witness.findings if f["kind"] == "blocking"] == []
+    other = lw.named_lock("fixture.cw_other")
+    with other:
+        with cond:
+            cond.wait(timeout=0.01)
+    found = [f for f in witness.findings if f["kind"] == "blocking"]
+    assert len(found) == 1 and "fixture.cw_other" in found[0]["sites"]
+
+
+def test_same_site_nesting_flagged_reentrant_is_not(witness):
+    r = lw.named_rlock("fixture.ss_rlock")
+    with r:
+        with r:                  # reentrant same OBJECT: fine
+            pass
+    assert witness.findings == []
+    l1 = lw.named_lock("fixture.ss_pair")
+    l2 = lw.named_lock("fixture.ss_pair")
+    with l1:
+        with l2:                 # two instances of one site: hazard
+            pass
+    assert [f["kind"] for f in witness.findings] == ["same_site"]
+
+
+def test_allowlist_swallows_findings(tmp_path):
+    allow = tmp_path / "allow.json"
+    allow.write_text(
+        '{"entries": [{"kind": "blocking", '
+        '"sites": ["fixture.al_hold", "fixture.al_call"], '
+        '"justification": "fixture: exercised by test_analysis only"}]}')
+    prev = lw.active_witness()
+    w = lw.enable(allowlist_path=str(allow))
+    try:
+        with lw.named_lock("fixture.al_hold"):
+            lw.note_blocking("fixture.al_call")
+        assert w.findings == []
+        assert len(w.allowed) == 1
+    finally:
+        _restore(prev)
+
+
+def test_witness_survives_release_out_of_order(witness):
+    """Release order need not mirror acquisition order (the engine's
+    bounded-acquire paths do this); the held stack must stay sane."""
+    a = lw.named_lock("fixture.ro_a")
+    b = lw.named_lock("fixture.ro_b")
+    a.acquire()
+    try:
+        b.acquire()
+        try:
+            pass
+        finally:
+            a.release()        # out of order on purpose
+    finally:
+        b.release()
+    with a:
+        pass
+    assert witness.cycles() == []
+    assert witness.report()["acquisitions"] == 3
+
+
+def test_witness_over_live_engine_zero_cycles():
+    """End-to-end: a real engine serving real traffic under the witness
+    shows ZERO lock-order cycles, and every blocking finding is one the
+    shipped allowlist already justifies — the fast-tier slice of what
+    ``chaos_sweep --lockwitness`` and the tier-1-under-witness job
+    (docs/static_analysis.md) assert at scale."""
+    import numpy as onp
+    from mxnet_tpu.models import get_gpt2
+    from mxnet_tpu.serving import InferenceEngine
+
+    prev = lw.active_witness()
+    w = lw.enable()          # BEFORE engine construction
+    try:
+        onp.random.seed(3)
+        net = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                       num_heads=2, max_length=32, dropout=0.0)
+        net.initialize()
+        eng = InferenceEngine(net, num_slots=2, max_batch=2,
+                              seq_buckets=(8,), default_max_new_tokens=4,
+                              name="lockwitness-e2e")
+        try:
+            eng.warmup()
+            eng.start()
+            futs = [eng.submit(
+                onp.random.randint(0, 61, (5,)).astype("int32"))
+                for _ in range(4)]
+            for f in futs:
+                f.result(timeout=60)
+        finally:
+            eng.stop()
+        rep = w.report()
+        assert rep["cycles"] == 0, rep["findings"]
+        assert rep["findings"] == [], rep["findings"]
+        assert rep["acquisitions"] > 0 and rep["edges"] > 0
+    finally:
+        _restore(prev)
+
+
+def test_shipped_allowlist_is_valid():
+    """Whatever ships in lockwitness_allowlist.json must load and pass
+    the linter's shape validation (rule lock-allowlist) — covered by
+    test_repo_is_lint_clean too, but this pins the loader side."""
+    entries = lw.load_allowlist()
+    for e in entries:
+        assert e.get("kind") in ("cycle", "blocking", "same_site")
+        assert len(e.get("justification", "").strip()) >= 20

@@ -24,10 +24,16 @@ from mxnet_tpu.resilience import (AtomicCheckpointer, FaultPlan,
                                   InjectedFault, ResilientLoop,
                                   RetryableFault, SimulatedPreemption,
                                   active_plan, inject)
+from mxnet_tpu.resilience.faults import register_site
 from mxnet_tpu.serving import (DeadlineExceededError, EngineCrashedError,
                                EngineStoppedError, InferenceEngine,
                                QueueFullError, RequestTimeoutError,
                                ServingError)
+
+# ad-hoc sites exercising the fault machinery itself: plans reject
+# unregistered sites (faults.KNOWN_SITES), so declare these up front
+for _s in ("test.a", "test.b", "test.c", "test.s", "test.x", "test.k"):
+    register_site(_s, "test_resilience fault-machinery fixture site")
 
 # ---------------------------------------------------------------- fixtures
 
@@ -73,36 +79,36 @@ def _join_scheduler(eng, timeout=30):
 
 def test_fault_plan_fires_deterministically():
     plan = (FaultPlan(seed=5)
-            .raise_at("a", at=3)
-            .raise_at("b", every=2, max_fires=2)
-            .delay_at("c", 0.0, at=1))
+            .raise_at("test.a", at=3)
+            .raise_at("test.b", every=2, max_fires=2)
+            .delay_at("test.c", 0.0, at=1))
     with plan:
         for _ in range(2):
-            inject("a")                       # hits 1, 2: no fire
+            inject("test.a")                       # hits 1, 2: no fire
         with pytest.raises(InjectedFault):
-            inject("a")                       # hit 3 fires
-        inject("a")                           # at= fires exactly once
+            inject("test.a")                       # hit 3 fires
+        inject("test.a")                           # at= fires exactly once
         fired_b = 0
         for _ in range(8):
             try:
-                inject("b")
+                inject("test.b")
             except InjectedFault:
                 fired_b += 1
         assert fired_b == 2                   # max_fires bound
-        inject("c")                           # delay of 0 is a no-op fire
-    assert plan.hits["a"] == 4
-    assert plan.fired("a") == 1 and plan.fired("b") == 2
-    assert ("c", 1, "delay") in plan.log
+        inject("test.c")                           # delay of 0 is a no-op fire
+    assert plan.hits["test.a"] == 4
+    assert plan.fired("test.a") == 1 and plan.fired("test.b") == 2
+    assert ("test.c", 1, "delay") in plan.log
 
 
 def test_fault_plan_seeded_probability_reproducible():
     def pattern(seed):
-        plan = FaultPlan(seed=seed).raise_at("s", prob=0.3)
+        plan = FaultPlan(seed=seed).raise_at("test.s", prob=0.3)
         out = []
         with plan:
             for _ in range(64):
                 try:
-                    inject("s")
+                    inject("test.s")
                     out.append(0)
                 except InjectedFault:
                     out.append(1)
@@ -117,24 +123,24 @@ def test_fault_plan_seeded_probability_reproducible():
 def test_fault_plan_scoping_and_zero_cost_disabled():
     assert active_plan() is None
     inject("anything")                         # no plan: pure no-op
-    plan = FaultPlan().raise_at("x", at=1)
+    plan = FaultPlan().raise_at("test.x", at=1)
     with plan:
         assert active_plan() is plan
         with pytest.raises(mx.MXNetError):     # no nesting
             with FaultPlan():
                 pass
         with pytest.raises(InjectedFault):
-            inject("x")
+            inject("test.x")
     assert active_plan() is None
-    inject("x")                                # scope ended: no-op again
+    inject("test.x")                                # scope ended: no-op again
 
 
 def test_kill_is_base_exception():
-    plan = FaultPlan().kill_at("k", at=1)
+    plan = FaultPlan().kill_at("test.k", at=1)
     with plan:
         try:
             try:
-                inject("k")
+                inject("test.k")
             except Exception:                  # a generic handler must
                 pytest.fail("kill was swallowed by except Exception")
         except SimulatedPreemption:

@@ -1,0 +1,77 @@
+"""mxlint CLI — run the project linter over the tree.
+
+The rules (docs/static_analysis.md) codify the contracts PRs 1–8
+accumulated: registered fault sites, documented mxtpu_* metrics,
+MXNetError-typed serving/fleet raises, `with`-scoped locks, the
+monotonic-clock convention, and a well-formed lockwitness allowlist.
+
+Usage::
+
+    python tools/mxlint.py [paths...]          # default: mxnet_tpu/
+    python tools/mxlint.py --list-rules
+    python tools/mxlint.py --json report.json mxnet_tpu/
+
+Exit code 0 when clean, 1 on any finding, 2 on usage errors — the
+verify_checkpoint.py convention, so CI can distinguish "violations"
+from "you pointed me at nothing".  The linter is purely static (ast);
+it needs no jax and touches no device.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mxnet_tpu project linter (docs/static_analysis.md); "
+                    "exit 1 on findings, 2 on usage errors")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO, "mxnet_tpu")],
+                    help="files or directories to lint "
+                         "(default: the mxnet_tpu package)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write findings as a JSON report")
+    ap.add_argument("--doc-catalog", default=None,
+                    help="metric catalog markdown (default: "
+                         "<repo>/docs/observability.md)")
+    ap.add_argument("--allowlist", default=None,
+                    help="lockwitness allowlist to validate (default: "
+                         "mxnet_tpu/analysis/lockwitness_allowlist.json)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.analysis.lint import RULES, run_lint
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:15s} {desc}")
+        return 0
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"mxlint: no such path: {p!r}", file=sys.stderr)
+            return 2
+
+    findings = run_lint(args.paths, doc_catalog_path=args.doc_catalog,
+                        allowlist_path=args.allowlist)
+    for f in findings:
+        print(f"{os.path.relpath(f.path)}:{f.line}: {f.rule}: {f.message}")
+    if args.json:
+        with open(args.json, "w") as out:
+            json.dump({"findings": [f.as_dict() for f in findings],
+                       "count": len(findings)}, out, indent=2)
+    if findings:
+        print(f"mxlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
