@@ -12,10 +12,17 @@ enforces the invariants the rest of the tree hand-maintains
    ``tools/mxlint.py``: fault sites must be registered, metrics must be
    named and documented, serving/fleet raises must be MXNetError-typed,
    locks must be ``with``-scoped, monotonic-clock convention holds.
+3. :mod:`~mxnet_tpu.analysis.raceguard` — static guarded-by race
+   detection over the named-lock stack (which attribute belongs to
+   which lock; outside-lock accesses, validated annotations/pragmas,
+   callbacks-under-lock) plus the guard map
+   (``docs/concurrency_contract.json``) that
+   ``tools/chaos_sweep.py --corroborate`` cross-checks against the
+   witness's acquisition dump.
 
 The lockwitness half is imported eagerly (every lock-owning module
-needs the constructors at import); the linter loads lazily — it pulls
-in ``ast`` machinery no serving process wants.
+needs the constructors at import); the linter and raceguard load
+lazily — they pull in ``ast`` machinery no serving process wants.
 """
 from .lockwitness import (LockOrderError, LockWitness, active_witness,
                           disable, enable, known_lock_sites, named_condition,
@@ -26,12 +33,19 @@ __all__ = [
     "enable", "known_lock_sites", "named_condition", "named_lock",
     "named_rlock", "note_blocking",
     "run_lint", "Finding", "RULES",
+    "build_guard_map", "corroborate", "raceguard",
 ]
 
-_LAZY = {"run_lint": ".lint", "Finding": ".lint", "RULES": ".lint"}
+_LAZY = {"run_lint": ".lint", "Finding": ".lint", "RULES": ".lint",
+         "build_guard_map": ".raceguard", "corroborate": ".raceguard"}
 
 
 def __getattr__(name):
+    if name in ("raceguard", "lint"):      # lazy submodules
+        import importlib
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
     if name in _LAZY:
         import importlib
         mod = importlib.import_module(_LAZY[name], __name__)

@@ -1,11 +1,13 @@
 """mxlint — the AST project linter behind ``tools/mxlint.py``.
 
-Eight PRs accumulated contracts that nothing checked mechanically:
+Nine PRs accumulated contracts that nothing checked mechanically:
 fault-injection sites are stringly typed, metric names follow an
 undocumented convention, the serving/fleet error taxonomy is
-hand-maintained, and lock discipline lives in reviewers' heads.  Each
-rule here codifies one of those contracts (docs/static_analysis.md has
-the catalog with rationale and the how-to-add-a-rule recipe):
+hand-maintained, lock discipline lives in reviewers' heads, and the
+named-lock stack's *guarded-by* relation (which attribute belongs to
+which lock) was implicit.  Each rule here codifies one of those
+contracts (docs/static_analysis.md has the catalog with rationale and
+the how-to-add-a-rule recipe):
 
 ``fault-site``
     Every site literal fired through ``inject``/``poison`` (and
@@ -14,7 +16,7 @@ the catalog with rationale and the how-to-add-a-rule recipe):
     silently dead chaos coverage.
 ``metric-name``
     Every complete ``mxtpu_*`` metric-name literal must match
-    ``mxtpu_[a-z0-9_]+`` and appear in the docs/observability.md
+    ``mxtpu_[a-z0-9_]+`` AND appear in the docs/observability.md
     catalog (templated entries like ``mxtpu_serving_<counter>_total``
     match as families) — an undocumented metric is invisible to the
     fleet scraper's dashboards.
@@ -39,10 +41,25 @@ the catalog with rationale and the how-to-add-a-rule recipe):
     ``named_rlock``/``named_condition``/``note_blocking`` literals),
     and a real justification string per entry — the escape hatch is
     itself under analysis.
+``guarded-by`` / ``guard-declare`` / ``callback-under-lock``
+    The raceguard pass (:mod:`~mxnet_tpu.analysis.raceguard`): every
+    attribute written under a named lock belongs to that lock, and any
+    access reached outside it is a statically-detected race; its
+    declaration/pragma grammar is validated; and resolving futures or
+    invoking user callbacks while a guard is held is flagged as the
+    static analogue of the lockwitness ``blocking`` finding.
+
+All rules run over ONE shared parse and ONE node index per file (a
+single ``ast.walk``) — adding a rule must not add a tree traversal;
+the wall-time contract over the full package is pinned in
+``tests/test_analysis.py``.
 
 Suppression: append ``# mxlint: disable=<rule>[,<rule>...]`` to the
 offending line (``disable=all`` silences every rule for that line).
-Use sparingly; every pragma is a reviewer conversation.
+The raceguard rules prefer their own *justified* pragmas
+(``# raceguard: unguarded(<why>)`` / ``callback-ok(<why>)``) — those
+carry a validated >= 20-char justification, so use them instead of the
+bare disable.  Use sparingly; every pragma is a reviewer conversation.
 
 The linter is PURELY static — it parses source with :mod:`ast` and
 never imports the code under analysis, so it runs in CI without jax or
@@ -55,7 +72,9 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-__all__ = ["Finding", "RULES", "run_lint", "collect_files"]
+from . import raceguard as _raceguard
+
+__all__ = ["Finding", "FileIndex", "RULES", "run_lint", "collect_files"]
 
 RULES: Dict[str, str] = {
     "fault-site": "fault site literal not registered in faults.KNOWN_SITES",
@@ -69,6 +88,7 @@ RULES: Dict[str, str] = {
                   "applies",
     "lock-allowlist": "malformed lockwitness allowlist entry",
 }
+RULES.update(_raceguard.RACEGUARD_RULES)
 
 #: component directories where the monotonic-clock convention applies
 WALL_CLOCK_SCOPE = ("serving", "fleet", "resilience", "observability",
@@ -94,6 +114,13 @@ _METRIC_DOC_RE = re.compile(r"mxtpu_[a-z0-9_<>]*[a-z0-9_>]")
 _PRAGMA_RE = re.compile(r"#\s*mxlint:\s*disable=([a-zA-Z0-9_,\- ]+)")
 
 ALLOWLIST_KINDS = ("cycle", "blocking", "same_site")
+
+#: statement-list owners the FileIndex collects blocks from
+#: (``except*`` arrives in 3.11; ``match`` cases are handled apart)
+_BLOCK_NODES = (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+                ast.ClassDef, ast.With, ast.AsyncWith, ast.If, ast.While,
+                ast.For, ast.AsyncFor, ast.Try) + (
+                    (ast.TryStar,) if hasattr(ast, "TryStar") else ())
 
 
 class Finding:
@@ -172,9 +199,58 @@ def _call_name(call: ast.Call) -> Optional[str]:
     return None
 
 
+# ------------------------------------------------------- shared file index
+
+class FileIndex:
+    """One parse + ONE ``ast.walk`` per file; every rule reads the node
+    lists it needs from here instead of re-walking the tree.  The
+    raceguard pass shares ``tree``/``source`` (its class-structured
+    traversal is not expressible as flat node lists, but it re-parses
+    nothing)."""
+
+    __slots__ = ("path", "tree", "source", "component", "pragmas",
+                 "calls", "str_constants", "raises", "blocks")
+
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.component = _component(path)
+        self.pragmas = _pragmas(source)
+        calls: List[ast.Call] = []
+        consts: List[ast.Constant] = []
+        raises: List[ast.Raise] = []
+        blocks: List[List[ast.stmt]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                consts.append(node)
+            elif isinstance(node, ast.Raise):
+                raises.append(node)
+            if isinstance(node, _BLOCK_NODES):
+                for field in ("body", "orelse", "finalbody"):
+                    block = getattr(node, field, None)
+                    if isinstance(block, list) and block \
+                            and isinstance(block[0], ast.stmt):
+                        blocks.append(block)
+                for handler in getattr(node, "handlers", []) or []:
+                    if handler.body:
+                        blocks.append(handler.body)
+            elif isinstance(node, ast.Match):
+                for case in node.cases:
+                    if case.body:
+                        blocks.append(case.body)
+        self.calls = calls
+        self.str_constants = consts
+        self.raises = raises
+        self.blocks = blocks
+
+
 # --------------------------------------------------------- site collection
 
-def collect_registered_fault_sites(trees) -> Set[str]:
+def collect_registered_fault_sites(indexes: Sequence[FileIndex]) -> Set[str]:
     """Every ``register_site("...")`` literal in the scanned tree — the
     static mirror of ``faults.KNOWN_SITES`` (faults.py declares the
     in-tree sites with exactly these calls) — PLUS the in-package
@@ -183,37 +259,36 @@ def collect_registered_fault_sites(trees) -> Set[str]:
     faults.py still knows the real sites instead of flagging every
     legitimate literal."""
     sites: Set[str] = set()
-    trees = list(trees)
+    call_lists = [idx.calls for idx in indexes]
     faults_py = os.path.normpath(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "..", "resilience",
         "faults.py"))
     if os.path.exists(faults_py) \
-            and not any(os.path.abspath(p) == faults_py
-                        for p, _t, _s in trees):
+            and not any(os.path.abspath(idx.path) == faults_py
+                        for idx in indexes):
         try:
             with open(faults_py, encoding="utf-8") as f:
-                trees.append((faults_py, ast.parse(f.read()), ""))
+                tree = ast.parse(f.read())
+            call_lists.append([n for n in ast.walk(tree)
+                               if isinstance(n, ast.Call)])
         except (OSError, SyntaxError):
             pass
-    for _path, tree, _src in trees:
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) \
-                    and _call_name(node) == "register_site":
+    for calls in call_lists:
+        for node in calls:
+            if _call_name(node) == "register_site":
                 lit = _str_arg(node)
                 if lit:
                     sites.add(lit[0])
     return sites
 
 
-def collect_lock_sites(trees) -> Set[str]:
+def collect_lock_sites(indexes: Sequence[FileIndex]) -> Set[str]:
     """Every lock/blocking site constructed in the scanned tree:
     ``named_*`` first args (+ their ``.wait`` blocking names) and
     ``note_blocking`` literals."""
     sites: Set[str] = set()
-    for _path, tree, _src in trees:
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
+    for idx in indexes:
+        for node in idx.calls:
             name = _call_name(node)
             lit = _str_arg(node)
             if lit is None:
@@ -262,10 +337,8 @@ def _find_repo_root(paths: Sequence[str]) -> Optional[str]:
 
 # ----------------------------------------------------------------- checks
 
-def _check_fault_sites(path, tree, known: Set[str], findings):
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+def _check_fault_sites(idx: FileIndex, known: Set[str], findings):
+    for node in idx.calls:
         name = _call_name(node)
         if name in FAULT_SITE_CALLS or name in FAULT_PLAN_BUILDERS:
             lit = _str_arg(node)
@@ -275,18 +348,15 @@ def _check_fault_sites(path, tree, known: Set[str], findings):
             base = site.split("@", 1)[0]
             if base not in known:
                 findings.append(Finding(
-                    path, line, "fault-site",
+                    idx.path, line, "fault-site",
                     f"fault site {site!r} is not registered in "
                     f"faults.KNOWN_SITES — a typo'd site is silently "
                     f"dead chaos coverage; declare it with "
                     f"register_site()"))
 
 
-def _check_metric_names(path, tree, catalog, findings):
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Constant) \
-                or not isinstance(node.value, str):
-            continue
+def _check_metric_names(idx: FileIndex, catalog, findings):
+    for node in idx.str_constants:
         v = node.value
         # a CANDIDATE metric name: mxtpu_ + word chars only.  Thread
         # names ('mxtpu-digest'), filenames ('mxtpu_io.cc'), prose and
@@ -295,7 +365,7 @@ def _check_metric_names(path, tree, catalog, findings):
             continue
         if not METRIC_RE.match(v):
             findings.append(Finding(
-                path, node.lineno, "metric-name",
+                idx.path, node.lineno, "metric-name",
                 f"metric literal {v!r} violates the mxtpu_[a-z0-9_]+ "
                 f"naming convention"))
             continue
@@ -305,17 +375,17 @@ def _check_metric_names(path, tree, catalog, findings):
         if v in exact or any(f.match(v) for f in families):
             continue
         findings.append(Finding(
-            path, node.lineno, "metric-name",
+            idx.path, node.lineno, "metric-name",
             f"metric {v!r} is not in the docs/observability.md catalog "
             f"— undocumented metrics are invisible to fleet dashboards"))
 
 
-def _check_typed_raises(path, tree, findings):
-    comp = _component(path)
+def _check_typed_raises(idx: FileIndex, findings):
+    comp = idx.component
     if comp not in TYPED_RAISE_SCOPE:
         return
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Raise) or node.exc is None:
+    for node in idx.raises:
+        if node.exc is None:
             continue
         exc = node.exc
         name = None
@@ -325,29 +395,15 @@ def _check_typed_raises(path, tree, findings):
             name = exc.id
         if name in UNTYPED_RAISES:
             findings.append(Finding(
-                path, node.lineno, "typed-raise",
+                idx.path, node.lineno, "typed-raise",
                 f"raise {name} on a {comp}/ path — every failure a "
                 f"caller can see must be MXNetError-typed "
                 f"(docs/serving.md error taxonomy)"))
 
 
-def _stmt_blocks(tree):
-    """Yield every list of sibling statements in the module."""
-    for node in ast.walk(tree):
-        for field in ("body", "orelse", "finalbody"):
-            block = getattr(node, field, None)
-            if isinstance(block, list) and block \
-                    and isinstance(block[0], ast.stmt):
-                yield block
-        for handler in getattr(node, "handlers", []) or []:
-            if handler.body:
-                yield handler.body
-
-
-def _check_naked_acquire(path, tree, findings):
-    acquires = [node for node in ast.walk(tree)
-                if isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
+def _check_naked_acquire(idx: FileIndex, findings):
+    acquires = [node for node in idx.calls
+                if isinstance(node.func, ast.Attribute)
                 and node.func.attr == "acquire"]
     if not acquires:
         return
@@ -356,7 +412,7 @@ def _check_naked_acquire(path, tree, findings):
     # same object (a bounded acquire cannot use `with`, so this is the
     # one blessed non-context form)
     allowed = set()
-    for block in _stmt_blocks(tree):
+    for block in idx.blocks:
         for i, stmt in enumerate(block):
             if isinstance(stmt, ast.Expr):
                 call = stmt.value
@@ -385,28 +441,36 @@ def _check_naked_acquire(path, tree, findings):
             continue
         seen.add(key)
         findings.append(Finding(
-            path, node.lineno, "naked-acquire",
+            idx.path, node.lineno, "naked-acquire",
             "lock acquired outside `with` — an exception between "
             "acquire and release leaks the lock; use `with lock:` "
             "(or acquire immediately followed by try/finally "
             "release)"))
 
 
-def _check_wall_clock(path, tree, findings):
-    if _component(path) not in WALL_CLOCK_SCOPE:
+def _check_wall_clock(idx: FileIndex, findings):
+    if idx.component not in WALL_CLOCK_SCOPE:
         return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
+    for node in idx.calls:
+        if isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "time" \
                 and isinstance(node.func.value, ast.Name) \
                 and node.func.value.id == "time":
             findings.append(Finding(
-                path, node.lineno, "wall-clock",
+                idx.path, node.lineno, "wall-clock",
                 "time.time() where the monotonic-clock convention "
                 "applies — NTP steps make wall-clock deltas go "
                 "negative; use time.monotonic() (or pragma a genuine "
                 "epoch timestamp)"))
+
+
+def _check_raceguard(idx: FileIndex, findings):
+    """The guarded-by pass (docs/static_analysis.md): shares this
+    file's parse; its own justified pragmas are applied inside the
+    pass, the central ``mxlint: disable`` filter applies after."""
+    mod = _raceguard.analyze_module(idx.path, idx.tree, idx.source)
+    for r in mod.findings:
+        findings.append(Finding(idx.path, r.line, r.rule, r.message))
 
 
 def check_allowlist(allowlist_path: str, lock_sites: Set[str],
@@ -473,7 +537,7 @@ def run_lint(paths: Sequence[str],
     ``lockwitness_allowlist.json``.  Returns pragma-filtered findings
     sorted by (path, line)."""
     files = collect_files(paths)
-    trees = []
+    indexes: List[FileIndex] = []
     findings: List[Finding] = []
     for path in files:
         with open(path, encoding="utf-8") as f:
@@ -484,10 +548,10 @@ def run_lint(paths: Sequence[str],
             findings.append(Finding(path, e.lineno or 1, "parse",
                                     f"syntax error: {e.msg}"))
             continue
-        trees.append((path, tree, src))
+        indexes.append(FileIndex(path, tree, src))
 
-    known_sites = collect_registered_fault_sites(trees)
-    lock_sites = collect_lock_sites(trees)
+    known_sites = collect_registered_fault_sites(indexes)
+    lock_sites = collect_lock_sites(indexes)
 
     root = _find_repo_root(paths)
     if doc_catalog_path is None and root is not None:
@@ -500,16 +564,16 @@ def run_lint(paths: Sequence[str],
         allowlist_path = DEFAULT_ALLOWLIST_PATH
     check_allowlist(allowlist_path, lock_sites, findings)
 
-    for path, tree, src in trees:
+    for idx in indexes:
         per_file: List[Finding] = []
-        _check_fault_sites(path, tree, known_sites, per_file)
-        _check_metric_names(path, tree, catalog, per_file)
-        _check_typed_raises(path, tree, per_file)
-        _check_naked_acquire(path, tree, per_file)
-        _check_wall_clock(path, tree, per_file)
-        pragmas = _pragmas(src)
+        _check_fault_sites(idx, known_sites, per_file)
+        _check_metric_names(idx, catalog, per_file)
+        _check_typed_raises(idx, per_file)
+        _check_naked_acquire(idx, per_file)
+        _check_wall_clock(idx, per_file)
+        _check_raceguard(idx, per_file)
         for f in per_file:
-            disabled = pragmas.get(f.line, set())
+            disabled = idx.pragmas.get(f.line, set())
             if f.rule in disabled or "all" in disabled:
                 continue
             findings.append(f)

@@ -43,7 +43,11 @@ escape hatch is itself under static analysis.
 ``tools/chaos_sweep.py --lockwitness`` runs the whole chaos matrix
 under the witness and embeds the graph report; the tier-1 suite run
 with ``MXTPU_LOCKWITNESS=1`` is the widest net (numbers recorded in
-docs/static_analysis.md).
+docs/static_analysis.md).  The static other half is
+:mod:`~mxnet_tpu.analysis.raceguard` (which attribute belongs to which
+lock); ``chaos_sweep.py --corroborate`` diffs its guard map against
+this witness's acquisition dump so the two analyses vouch for each
+other.
 """
 from __future__ import annotations
 

@@ -103,7 +103,7 @@ class ReplicaHandle:
 
     # ---------------------------------------------------------------- state
     def routable(self) -> bool:
-        return self.state == HEALTHY
+        return self.state == HEALTHY  # raceguard: unguarded(placement hot path: atomic str read; a stale verdict is re-validated by the typed submit failure path)
 
     def load(self) -> int:
         """Instantaneous placement load: admission-queue depth plus
@@ -111,7 +111,7 @@ class ReplicaHandle:
         ``mxtpu_serving_queue_depth`` / ``mxtpu_serving_active_slots``
         registry gauges, read straight off the engine so routing never
         pays a full registry collect()."""
-        eng = self.engine
+        eng = self.engine  # raceguard: unguarded(engine ref is swapped atomically on rebuild; a corpse read here fails typed and reroutes)
         try:
             q = len(eng._batcher)
             a = eng._alloc.active_count if eng._alloc is not None else 0
@@ -121,7 +121,7 @@ class ReplicaHandle:
 
     def queue_depth(self) -> int:
         try:
-            return len(self.engine._batcher)
+            return len(self.engine._batcher)  # raceguard: unguarded(engine ref is swapped atomically on rebuild; a corpse read sorts the replica last)
         except Exception:
             return 1 << 30
 
@@ -157,17 +157,21 @@ class ReplicaHandle:
         the replica to DEAD.  A healthy probe resets the consecutive-
         death streak (the backoff ladder restarts).  SUSPECT replicas
         are probed too — slow is survivable, dead is not."""
-        if self.state not in (HEALTHY, SUSPECT):
+        if self.state not in (HEALTHY, SUSPECT):  # raceguard: unguarded(monitor fast path: atomic str read; the transition re-checks under the lock in mark_dead)
             return False
         try:
-            h = self.engine.health()
+            h = self.engine.health()  # raceguard: unguarded(engine ref is swapped atomically on rebuild; probing a corpse reports dead, which is correct)
             live = bool(h["live"])
             reason = h.get("crashed") or "scheduler not live"
         except Exception as e:            # a broken probe IS a dead replica
             live, reason = False, f"health() raised: {e!r}"
         if live:
-            if self.state == HEALTHY:
-                self.deaths = 0
+            with self._lock:
+                # the reset must not race a failing submit path's
+                # locked mark_dead increment — a lost increment would
+                # shorten the probation backoff ladder
+                if self.state == HEALTHY:
+                    self.deaths = 0
             return False
         return self.mark_dead(str(reason), now)
 
@@ -198,8 +202,13 @@ class ReplicaHandle:
 
     def due_for_unsuspect(self, now: Optional[float] = None) -> bool:
         now = time.monotonic() if now is None else now
-        return (self.state == SUSPECT and self.suspect_until is not None
-                and now >= self.suspect_until)
+        with self._lock:
+            # state and suspect_until move together under the lock;
+            # reading them apart could see SUSPECT with a window that
+            # another transition already cleared
+            return (self.state == SUSPECT
+                    and self.suspect_until is not None
+                    and now >= self.suspect_until)
 
     def unsuspect(self) -> bool:
         """Suspension elapsed: return to HEALTHY with a RESET latency
@@ -217,9 +226,11 @@ class ReplicaHandle:
 
     def due_for_readmission(self, now: Optional[float] = None) -> bool:
         now = time.monotonic() if now is None else now
-        return (self.state == DEAD and self.factory is not None
-                and self.probation_until is not None
-                and now >= self.probation_until)
+        with self._lock:
+            # state and probation_until move together under the lock
+            return (self.state == DEAD and self.factory is not None
+                    and self.probation_until is not None
+                    and now >= self.probation_until)
 
     def rebuild(self, abort: Optional[Callable[[], bool]] = None) -> bool:
         """Probation elapsed: build a fresh engine under this replica's
@@ -240,7 +251,7 @@ class ReplicaHandle:
         # and this replica's metric series keep their labels across
         # restarts instead of drifting to "<name>-2"
         try:
-            self.engine.stop(drain=False, timeout=1.0)
+            self.engine.stop(drain=False, timeout=1.0)  # raceguard: unguarded(rebuild runs on the monitor thread, the only engine-ref writer, so its own read cannot race)
         except Exception:
             pass
         try:
@@ -288,7 +299,8 @@ class ReplicaHandle:
             pass
 
     def __repr__(self):
-        return (f"ReplicaHandle({self.name!r}, state={self.state}, "
-                f"deaths={self.total_deaths}, "
-                f"suspects={self.total_suspects}, "
-                f"restarts={self.restarts})")
+        return (f"ReplicaHandle({self.name!r}, "
+                f"state={self.state}, "  # raceguard: unguarded(repr diagnostic: atomic reads, momentary staleness is harmless)
+                f"deaths={self.total_deaths}, "  # raceguard: unguarded(repr diagnostic: atomic reads, momentary staleness is harmless)
+                f"suspects={self.total_suspects}, "  # raceguard: unguarded(repr diagnostic: atomic reads, momentary staleness is harmless)
+                f"restarts={self.restarts})")  # raceguard: unguarded(repr diagnostic: atomic reads, momentary staleness is harmless)

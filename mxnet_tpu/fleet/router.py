@@ -524,7 +524,7 @@ class FleetRouter:
     def start(self) -> "FleetRouter":
         if self._monitor is not None:
             raise ServingError("router already started")
-        if self._stopping:
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise ServingError("router cannot be restarted once stopped "
                                "— build a fresh FleetRouter")
         for h in self._handles:
@@ -643,7 +643,7 @@ class FleetRouter:
         path), then the engine stops.  The replica ends ``STOPPED`` —
         ``restart()`` brings it back.  A drain that outlives ``timeout``
         condemns the replica (see ``stop()``)."""
-        if self._stopping:
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise ServingError("fleet router is stopped")
         h = self._require(replica)
         with h._lock:
@@ -659,7 +659,7 @@ class FleetRouter:
         """Rebuild a drained/dead replica via the factory (fresh engine
         under the same replica name, re-warmed) and return it to
         traffic."""
-        if self._stopping:
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise ServingError("fleet router is stopped")
         h = self._require(replica)
         if h.factory is None:
@@ -738,14 +738,14 @@ class FleetRouter:
                 try:
                     if h.probe():
                         self._count("replica_deaths")
-                    elif h.due_for_readmission() and not self._stopping:
+                    elif h.due_for_readmission() and not self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                         # abort= closes the stop-vs-rebuild race: a
                         # rebuild still in flight when the fleet stops
                         # discards its replacement engine instead of
                         # resurrecting a replica on a stopped fleet
-                        if h.rebuild(abort=lambda: self._stopping):
+                        if h.rebuild(abort=lambda: self._stopping):  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                             self._count("readmissions")
-                    elif h.due_for_unsuspect() and not self._stopping:
+                    elif h.due_for_unsuspect() and not self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                         # suspension elapsed: back to traffic with a
                         # fresh latency window — no rebuild, the engine
                         # never stopped (docs/integrity.md)
@@ -1001,7 +1001,7 @@ class FleetRouter:
         failover resubmissions inherit the REMAINING time, never a
         fresh window.  ``priority`` (docs/overload.md) rides every
         attempt: a failed-over request keeps its class."""
-        if self._stopping:
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise EngineStoppedError("fleet router is stopped")
         if self.mode == "decode":
             payload = onp.asarray(getattr(x, "asnumpy", lambda: x)(),
@@ -1048,7 +1048,7 @@ class FleetRouter:
                             "breaker": h.breaker.state, "engine": eh}
         healthy = len(self._healthy())
         return {"name": self.name, "ready": healthy > 0
-                and not self._stopping,
+                and not self._stopping,  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                 "healthy": healthy, "replicas": reps}
 
     def stats(self) -> dict:

@@ -38,7 +38,7 @@ class RandomState:
         self.seed(seed_)
 
     def seed(self, seed_: int, ctx: Optional[Context] = None):
-        with getattr(self, "_lock", threading.Lock()):
+        with self._lock:
             if ctx is None or not hasattr(self, "_keys"):
                 self._keys: Dict[Context, jax.Array] = {}
                 self._counters: Dict[Context, int] = {}
@@ -56,7 +56,7 @@ class RandomState:
                 self._host_rng = onp.random.RandomState(
                     int(seed_) & 0x7FFFFFFF)
 
-    def _root(self, ctx: Context) -> jax.Array:
+    def _root(self, ctx: Context) -> jax.Array:  # guarded-by: _lock
         if ctx not in self._keys:
             self._keys[ctx] = jax.random.PRNGKey(
                 self._base_seed + (Context.devtype2id[ctx.device_type] << 8)

@@ -146,10 +146,10 @@ class DynamicBatcher:
         return self._cond
 
     def __len__(self):
-        return self._n
+        return self._n  # raceguard: unguarded(atomic int read; gauge/idle probes must not contend with admission)
 
     def empty(self) -> bool:
-        return self._n == 0
+        return self._n == 0  # raceguard: unguarded(atomic int read; the scheduler re-checks under the shared cond before waiting)
 
     def depth_at_or_above(self, ordinal: int) -> int:
         """Queued requests at class ``ordinal`` or higher — the queue a
@@ -311,4 +311,4 @@ class DynamicBatcher:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        return self._closed  # raceguard: unguarded(atomic bool read; close is one-way so a stale False only delays one probe)

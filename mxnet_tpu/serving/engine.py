@@ -760,7 +760,7 @@ class InferenceEngine:
             # after a requested stop a dead thread is a NORMAL exit; a
             # hang during the drain itself must still trip below, so
             # _stopping only suppresses the died-check
-            return None if self._stopping else "scheduler thread died"
+            return None if self._stopping else "scheduler thread died"  # raceguard: unguarded(watchdog heuristic: atomic bool read, stale value only delays one poll)
         if self.hang_timeout is not None and self._heartbeat is not None \
                 and not self._compiling:
             age = time.monotonic() - self._heartbeat
@@ -768,8 +768,9 @@ class InferenceEngine:
             # nor the slot allocator: a forward batch is popped before
             # the compiled call, so a hang there would otherwise look
             # idle and strand the popped futures
-            busy = not self._batcher.empty() or self._cycle_busy or \
-                (self._alloc is not None and self._alloc.active_count > 0)
+            busy = (not self._batcher.empty() or self._cycle_busy  # raceguard: unguarded(watchdog heuristic: atomic bool read, stale value only delays one poll)
+                    or (self._alloc is not None
+                        and self._alloc.active_count > 0))
             if busy and age > self.hang_timeout:
                 return (f"scheduler heartbeat stale for {age:.2f}s "
                         f"(hang_timeout={self.hang_timeout}s) with work "
@@ -846,7 +847,7 @@ class InferenceEngine:
         return {
             "name": self.name,
             "live": live,
-            "ready": live and not self._stopping
+            "ready": live and not self._stopping  # raceguard: unguarded(health probe: atomic bool read, a stale ready flag is corrected next probe)
             and not self._batcher.closed,
             "crashed": None if self._crashed is None else str(self._crashed),
             "heartbeat_age_s": hb_age,
@@ -1232,7 +1233,7 @@ class InferenceEngine:
             "prefix_pool_rows": self.prefix_pool_rows,
             "prefix_entries": len(self._prefix)
             if self._prefix is not None else 0,
-            "prefix_disabled": self._prefix_disabled,
+            "prefix_disabled": self._prefix_disabled,  # raceguard: unguarded(stats snapshot: atomic bool read, staleness bounded by one cycle)
             "running": self._thread is not None,
             "crashed": self._crashed is not None,
             "default_priority": priority_name(self.default_priority),
@@ -1337,7 +1338,7 @@ class InferenceEngine:
             tr.event("serving.error", trace_id=req.trace_id,
                      error=type(exc).__name__)
 
-    def _fail_inflight(self, exc: BaseException):
+    def _fail_inflight(self, exc: BaseException):  # guarded-by: _step_lock
         for req in self._batcher.drain():
             self._fail(req, exc)
         if self._alloc is not None:
@@ -1382,7 +1383,7 @@ class InferenceEngine:
         req.future.set_result(seq)
 
     # ------------------------------------------------------------ decode path
-    def _ensure_caches(self):
+    def _ensure_caches(self):  # guarded-by: _step_lock
         if self._caches is None:
             # slots + scratch + prefix pool share one array per layer so
             # row-to-row copies and slot reads stay in a single buffer
@@ -1449,7 +1450,7 @@ class InferenceEngine:
         the queue as a continuation — dequeue it there; anything still
         unmatched and unresolved carries over to the next sweep rather
         than silently un-cancelling."""
-        if not self._cancels:
+        if not self._cancels:  # raceguard: unguarded(lock-free emptiness fast path; the swap below re-checks under _cond)
             return
         with self._cond:
             cancels, self._cancels = self._cancels, set()
@@ -1564,10 +1565,10 @@ class InferenceEngine:
                      request=req.id, generated=len(st.generated))
 
     # --------------------------------------------------------- prefix cache
-    def _prefix_usable(self) -> bool:
+    def _prefix_usable(self) -> bool:  # guarded-by: _step_lock
         return self._prefix is not None and not self._prefix_disabled
 
-    def _prefix_fault(self, where: str):
+    def _prefix_fault(self, where: str):  # guarded-by: _step_lock
         """Contain a fault at a serving.prefix_* site: the request just
         loses the shortcut (full prefill), never fails.  Repeated
         consecutive faults at EITHER site disable the cache — a
@@ -1581,7 +1582,7 @@ class InferenceEngine:
             self._prefix_disabled = True
             self.metrics.mark("prefix_disabled")
 
-    def _prefix_admit(self, st: SlotState, slot: int):
+    def _prefix_admit(self, st: SlotState, slot: int):  # guarded-by: _step_lock
         """Lease-time prefix reuse: longest-prefix lookup, pin, and the
         device row copy.  On success ``st.filled`` skips the matched
         region; on any contained fault the request prefills in full."""
@@ -1660,7 +1661,7 @@ class InferenceEngine:
             return
         self._pool_insert(st.tokens, slot, st.prompt_len)
 
-    def _pool_insert(self, tokens, slot: int, length: int):
+    def _pool_insert(self, tokens, slot: int, length: int):  # guarded-by: _step_lock
         """Shared slot→pool insert body: radix-tree insert + the
         compiled row copy of K/V ``[0, length)`` from ``slot`` into
         the reserved pool row, with the usual per-site fault
@@ -1746,7 +1747,7 @@ class InferenceEngine:
             chunked.sort(key=lambda it: it[1].request.t_schedule)
             self._prefill_chunk_batch(chunked[:self.lattice.max_batch])
 
-    def _prefill_full(self, rows, tb):
+    def _prefill_full(self, rows, tb):  # guarded-by: _step_lock
         import jax.numpy as jnp
 
         bb = self.lattice.batch(len(rows))
@@ -1787,7 +1788,7 @@ class InferenceEngine:
             st.filled = st.prompt_len
             self._first_token(slot, st, int(first[i]))
 
-    def _prefill_chunk_batch(self, rows):
+    def _prefill_chunk_batch(self, rows):  # guarded-by: _step_lock
         """One chunked/offset prefill call over up to max_batch
         prefilling rows: row i writes K/V for its next
         ``min(remaining, prefill_chunk)`` prompt tokens behind its
@@ -1856,7 +1857,7 @@ class InferenceEngine:
         st.advance(token)
         self._finish_if_done(slot, st)
 
-    def _fail_nonfinite(self, slot: int, st: SlotState, where: str):
+    def _fail_nonfinite(self, slot: int, st: SlotState, where: str):  # guarded-by: _step_lock
         """One request's logits went NaN/Inf: free its slot and fail it
         typed.  Contained per-request — the rest of the batch, the
         scheduler, and the watchdog are untouched.
@@ -1884,7 +1885,7 @@ class InferenceEngine:
             self._release(slot)
             self._complete(st)
 
-    def _decode_step(self):
+    def _decode_step(self):  # guarded-by: _step_lock
         import jax.numpy as jnp
 
         alloc = self._alloc

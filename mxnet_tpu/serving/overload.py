@@ -251,7 +251,7 @@ class RetryBudget:
                                  "failover/hedge token bucket")
         self.denied = 0              # lifetime try_acquire failures
 
-    def _refill(self, now: float) -> None:
+    def _refill(self, now: float) -> None:  # guarded-by: _lock
         """Lazy time-based top-up (caller holds the lock).  The refill
         clock never rewinds: a caller passing a stale ``now`` must not
         cause the same interval to refill twice."""
@@ -287,7 +287,8 @@ class RetryBudget:
 
     def __repr__(self):
         return (f"RetryBudget(rate={self.rate}, burst={self.burst}, "
-                f"available={self.available:.2f}, denied={self.denied})")
+                f"available={self.available:.2f}, "
+                f"denied={self.denied})")  # raceguard: unguarded(repr diagnostic: atomic int read, momentary staleness is harmless)
 
 
 class CircuitBreaker:
@@ -360,4 +361,5 @@ class CircuitBreaker:
                 else "open"
 
     def __repr__(self):
-        return f"CircuitBreaker(state={self.state}, opens={self.opens})"
+        return (f"CircuitBreaker(state={self.state}, "
+                f"opens={self.opens})")  # raceguard: unguarded(repr diagnostic: atomic int read, momentary staleness is harmless)

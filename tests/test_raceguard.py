@@ -1,0 +1,540 @@
+"""raceguard — static guarded-by race detection + the guard-map
+corroboration loop (docs/static_analysis.md).
+
+Contract groups:
+
+1. Per-rule fixtures: ``guarded-by`` / ``guard-declare`` /
+   ``callback-under-lock`` each catch their seeded violation and stay
+   quiet on the compliant twin (``__init__`` exemption,
+   read-only-after-publish, RLock reentrancy, declarations, pragmas).
+2. The guard map: schema shape, deterministic regeneration, and the
+   checked-in ``docs/concurrency_contract.json`` regenerating
+   byte-identical (the drift guard).
+3. Corroboration: the static map diffed against a witness acquisition
+   dump — exercised+mapped passes, claimed-but-cold and
+   witnessed-but-unmapped both fail — including a round-trip against a
+   REAL recorded witness run.
+4. Tooling: the shared-parse lint stays under its wall-time budget on
+   the full package, and ``--sarif`` round-trips findings losslessly
+   with the exit-code contract unchanged.
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from mxnet_tpu.analysis import lockwitness as lw
+from mxnet_tpu.analysis import raceguard as rg
+from mxnet_tpu.analysis.lint import run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mxnet_tpu")
+CATALOG = os.path.join(REPO, "docs", "observability.md")
+CONTRACT = os.path.join(REPO, "docs", "concurrency_contract.json")
+
+
+def _lint_snippet(tmp_path, source, component="serving", name="fix.py"):
+    d = tmp_path / component
+    d.mkdir(parents=True, exist_ok=True)
+    (d / name).write_text(source, encoding="utf-8")
+    return run_lint([str(tmp_path)],
+                    allowlist_path=str(tmp_path / "no_allowlist.json"))
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+HEADER = ("from mxnet_tpu.analysis.lockwitness import named_lock, "
+          "named_rlock, named_condition\n")
+
+
+# ------------------------------------------------------------- guarded-by
+
+def test_guarded_write_and_read_outside_lock(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_basic')\n"
+        "        self.count = 0\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"          # infers count <- _lock
+        "    def bad_write(self):\n"
+        "        self.count = 5\n"               # line 10: finding
+        "    def bad_read(self):\n"
+        "        return self.count\n"            # line 12: finding
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guarded-by"] and len(fs) == 2
+    assert sorted(f.line for f in fs) == [10, 12]
+    assert "write to self.count" in fs[0].message
+    assert "read of self.count" in fs[1].message
+    assert "fixture.rg_basic" in fs[0].message
+
+
+def test_init_writes_exempt_and_read_only_after_publish(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_pub')\n"
+        "        self.mode = 'decode'\n"         # pre-publication write
+        "        self.count = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.count = 1\n"
+        "    def read_published(self):\n"
+        "        return self.mode\n"             # never locked-written: quiet
+    )
+    assert _lint_snippet(tmp_path, src) == []
+
+
+def test_subscript_store_counts_as_write(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_sub')\n"
+        "        self.d = {}\n"
+        "    def locked(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self.d[k] = v\n"            # infers d <- _lock
+        "    def bad(self, k, v):\n"
+        "        self.d[k] = v\n"                # line 9: finding (write)
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guarded-by"]
+    assert any(f.line == 10 and "self.d" in f.message for f in fs)
+
+
+def test_rlock_reentrancy_and_condition_guard(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._r = named_rlock('fixture.rg_rl')\n"
+        "        self._cond = named_condition('fixture.rg_cv')\n"
+        "        self.a = self.b = 0\n"
+        "    def reentrant(self):\n"
+        "        with self._r:\n"
+        "            self.a = 1\n"
+        "            with self._r:\n"            # re-with same guard: fine
+        "                self.a = 2\n"
+        "    def waits(self):\n"
+        "        with self._cond:\n"
+        "            self.b = 1\n"
+        "            self._cond.wait(0.01)\n"
+    )
+    assert _lint_snippet(tmp_path, src) == []
+
+
+def test_bounded_acquire_try_counts_as_held(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_ba')\n"
+        "        self.n = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "    def bounded(self):\n"
+        "        got = self._lock.acquire(timeout=1.0)\n"
+        "        try:\n"
+        "            self.n = 2\n"               # held via blessed form
+        "        finally:\n"
+        "            if got:\n"
+        "                self._lock.release()\n"
+    )
+    assert _lint_snippet(tmp_path, src) == []
+
+
+def test_nested_function_resets_held_set(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_nf')\n"
+        "        self.n = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "            def later():\n"
+        "                return self.n\n"        # line 10: runs post-release
+        "            return later\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guarded-by"]
+    assert [f.line for f in fs] == [10]
+
+
+def test_match_statement_keeps_held_set(tmp_path):
+    """Regression: a ``with self._lock:`` (or the blessed bounded
+    acquire) inside a ``match`` case must keep held-set / sibling-block
+    tracking — the traversals must not fall through to the generic
+    leaf path and false-positive on correctly locked code."""
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_match')\n"
+        "        self.n = 0\n"
+        "    def locked(self, v):\n"
+        "        match v:\n"
+        "            case 1:\n"
+        "                with self._lock:\n"
+        "                    self.n = 1\n"
+        "            case _:\n"
+        "                got = self._lock.acquire(timeout=1.0)\n"
+        "                try:\n"
+        "                    self.n = 2\n"
+        "                finally:\n"
+        "                    if got:\n"
+        "                        self._lock.release()\n"
+        "    def bad(self, v):\n"
+        "        match v:\n"
+        "            case 1:\n"
+        "                self.n = 3\n"      # line 21: genuinely unguarded
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guarded-by"]
+    assert [f.line for f in fs] == [21]
+
+
+# ----------------------------------------------------------- declarations
+
+def test_declaration_widens_inference(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_dec')\n"
+        "        self.boxed = []  # guarded-by: _lock\n"
+        "    def bad(self):\n"
+        "        return self.boxed\n"            # line 7: declared guarded
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            return self.boxed\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guarded-by"]
+    assert [f.line for f in fs] == [7]
+
+
+def test_def_declaration_is_caller_holds_contract(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_ch')\n"
+        "        self.n = 0\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "            self._helper()\n"
+        "    def _helper(self):  # guarded-by: _lock\n"
+        "        self.n += 1\n"                   # quiet: caller holds
+    )
+    assert _lint_snippet(tmp_path, src) == []
+
+
+def test_declaration_unknown_guard_and_orphan(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_uk')\n"
+        "        self.x = 0  # guarded-by: _nonesuch\n"
+        "# guarded-by: _floating\n"
+        "class D:\n"
+        "    pass\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["guard-declare"] and len(fs) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "_nonesuch" in msgs and "orphan" in msgs
+
+
+# ---------------------------------------------------------------- pragmas
+
+def test_pragma_suppresses_with_valid_justification(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_pr')\n"
+        "        self.flag = False\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.flag = True\n"
+        "    def probe(self):\n"
+        "        return self.flag  # raceguard: unguarded(atomic bool "
+        "read on a health probe, staleness is harmless)\n"
+    )
+    assert _lint_snippet(tmp_path, src) == []
+
+
+def test_pragma_justification_too_short_is_a_finding(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_sj')\n"
+        "        self.flag = False\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.flag = True\n"
+        "    def probe(self):\n"
+        "        return self.flag  # raceguard: unguarded(meh)\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    # the under-justified pragma does NOT suppress: both the pragma
+    # violation and the original access are reported
+    assert _rules(fs) == ["guard-declare", "guarded-by"]
+    assert any("justification" in f.message for f in fs)
+
+
+def test_pragma_unknown_verb_and_quoted_text_ignored(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_uv')\n"
+        "    def f(self):\n"
+        "        return '# raceguard: unguarded(not a real pragma)'\n"
+        "    def g(self):\n"
+        "        x = 1  # raceguard: blessed(this verb does not exist)\n"
+        "        return x\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    # the string literal is NOT an annotation (tokenize-based scan);
+    # the unknown verb IS a finding
+    assert _rules(fs) == ["guard-declare"] and len(fs) == 1
+    assert "unknown raceguard pragma verb" in fs[0].message
+
+
+# ---------------------------------------------------- callback-under-lock
+
+def test_callback_under_lock_flagged_outside_quiet(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rg_cb')\n"
+        "        self.waiters = []\n"
+        "    def bad(self, fut, exc):\n"
+        "        with self._lock:\n"
+        "            self.waiters.append(fut)\n"
+        "            fut.set_exception(exc)\n"   # line 9: finding
+        "    def good(self, fut, value):\n"
+        "        with self._lock:\n"
+        "            self.waiters.remove(fut)\n"
+        "        fut.set_result(value)\n"        # outside: quiet
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["callback-under-lock"]
+    assert [f.line for f in fs] == [9]
+    assert "set_exception" in fs[0].message
+
+
+def test_user_callback_names_flagged_and_callback_ok_pragma(tmp_path):
+    src = HEADER + (
+        "class C:\n"
+        "    def __init__(self, cb):\n"
+        "        self._lock = named_lock('fixture.rg_cb2')\n"
+        "        self.cb = cb\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            self.cb()\n"                # line 8: finding
+        "    def blessed(self):\n"
+        "        with self._lock:\n"
+        "            self.cb()  # raceguard: callback-ok(the callback "
+        "is a bound counter increment owned by this class)\n"
+    )
+    fs = _lint_snippet(tmp_path, src)
+    assert _rules(fs) == ["callback-under-lock"]
+    assert [f.line for f in fs] == [8]
+
+
+# -------------------------------------------------------------- guard map
+
+def test_guard_map_schema_and_determinism(tmp_path):
+    d = tmp_path / "serving"
+    d.mkdir(parents=True)
+    (d / "mod.py").write_text(HEADER + (
+        "GLOBAL_LOCK = named_lock('fixture.map_mod')\n"
+        "_STATE = {}\n"
+        "def swap(k, v):\n"
+        "    with GLOBAL_LOCK:\n"
+        "        _STATE[k] = v\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.map_cls')\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"))
+    gmap = rg.build_guard_map([str(tmp_path)])
+    assert gmap["schema_version"] == rg.GUARD_MAP_SCHEMA_VERSION
+    sites = gmap["sites"]
+    assert set(sites) == {"fixture.map_mod", "fixture.map_cls"}
+    cls = sites["fixture.map_cls"]["bindings"][0]
+    assert cls["scope"] == "C" and cls["guard"] == "_lock"
+    assert cls["kind"] == "lock" and cls["attributes"] == ["n"]
+    assert cls["module"].endswith("serving/mod.py")
+    mod = sites["fixture.map_mod"]["bindings"][0]
+    assert mod["scope"] == "module" and mod["attributes"] == ["_STATE"]
+    # deterministic: regenerating yields byte-identical JSON
+    a = json.dumps(gmap, indent=2, sort_keys=True)
+    b = json.dumps(rg.build_guard_map([str(tmp_path)]), indent=2,
+                   sort_keys=True)
+    assert a == b
+
+
+def test_checked_in_concurrency_contract_is_fresh():
+    """THE drift guard: regenerating docs/concurrency_contract.json
+    from the tree is a byte-identical no-op — a PR that moves an
+    attribute between locks (or adds a lock) must regenerate the
+    contract (``python tools/mxlint.py --guard-map
+    docs/concurrency_contract.json``)."""
+    gmap = rg.build_guard_map([PKG], root=REPO)
+    want = json.dumps(gmap, indent=2, sort_keys=True) + "\n"
+    with open(CONTRACT, encoding="utf-8") as f:
+        assert f.read() == want, (
+            "docs/concurrency_contract.json is stale — regenerate with "
+            "tools/mxlint.py --guard-map")
+
+
+def test_corroboration_exempt_sites_are_mapped_and_justified():
+    gmap = json.load(open(CONTRACT))
+    for site, justification in rg.CORROBORATION_EXEMPT.items():
+        assert site in gmap["sites"], site
+        assert len(justification.strip()) >= 20, site
+
+
+# ----------------------------------------------------------- corroboration
+
+def test_corroborate_verdicts():
+    gmap = {"sites": {"fixture.co_a": {}, "fixture.co_b": {},
+                      "native.build": {}}}
+    # every mapped site witnessed (exempt site cold): pass
+    v = rg.corroborate(gmap, {"fixture.co_a": 3, "fixture.co_b": 1})
+    assert v["passed"] and v["unexercised"] == [] and v["unmapped"] == []
+    assert "native.build" in v["exempt"]
+    # a claimed-but-cold site fails
+    v = rg.corroborate(gmap, {"fixture.co_a": 3})
+    assert not v["passed"] and v["unexercised"] == ["fixture.co_b"]
+    # a witnessed-but-unmapped site fails
+    v = rg.corroborate(gmap, {"fixture.co_a": 1, "fixture.co_b": 1,
+                              "fixture.co_ghost": 2})
+    assert not v["passed"] and v["unmapped"] == ["fixture.co_ghost"]
+    # zero-count witness entries are not "exercised"
+    v = rg.corroborate(gmap, {"fixture.co_a": 1, "fixture.co_b": 0})
+    assert not v["passed"] and v["unexercised"] == ["fixture.co_b"]
+
+
+def test_corroboration_round_trip_against_recorded_witness(tmp_path):
+    """End to end: build a module whose guard map claims two sites,
+    RUN it under the witness, and corroborate the map against the
+    recorded acquisition dump — then break the loop both ways."""
+    d = tmp_path / "serving"
+    d.mkdir(parents=True)
+    (d / "live.py").write_text(HEADER + (
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rt_hot')\n"
+        "        self._cold = named_lock('fixture.rt_cold')\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"))
+    gmap = rg.build_guard_map([str(tmp_path)])
+    assert set(gmap["sites"]) == {"fixture.rt_hot", "fixture.rt_cold"}
+
+    prev = lw.active_witness()
+    w = lw.enable()
+    try:
+        ns = {}
+        exec((d / "live.py").read_text(), ns)    # construct + exercise
+        box = ns["Box"]()
+        box.bump()
+        dump = w.report()["per_site"]
+    finally:
+        lw.disable()
+        if prev is not None:
+            with lw._WITNESS_LOCK:
+                lw._ACTIVE = prev
+    # the hot site is proven; the cold one is the corroboration gap
+    v = rg.corroborate(gmap, dump, exempt={})
+    assert not v["passed"] and v["unexercised"] == ["fixture.rt_cold"]
+    # exercise it (recorded dump edit stands in for a second run) ...
+    dump2 = dict(dump, **{"fixture.rt_cold": 1})
+    v = rg.corroborate(gmap, dump2, exempt={})
+    assert v["passed"], v
+    # ... and a witnessed site the map cannot see fails the other way
+    del gmap["sites"]["fixture.rt_hot"]
+    v = rg.corroborate(gmap, dump2, exempt={})
+    assert not v["passed"] and v["unmapped"] == ["fixture.rt_hot"]
+
+
+# ----------------------------------------------------------------- tooling
+
+def test_lint_wall_time_budget_on_full_tree():
+    """All nine rules (six PR-9 + three raceguard) run over ONE shared
+    parse and node index per file; the full-package lint must stay
+    under 5 s — the budget that keeps the tier-1 drift guards cheap."""
+    t0 = time.perf_counter()
+    findings = run_lint([PKG], doc_catalog_path=CATALOG)
+    elapsed = time.perf_counter() - t0
+    assert findings == []
+    assert elapsed < 5.0, f"run_lint({PKG}) took {elapsed:.2f}s"
+
+
+def test_sarif_round_trip_and_exit_codes(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import mxlint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "fleet"
+    bad.mkdir()
+    (bad / "x.py").write_text(
+        HEADER +
+        "def f():\n    raise ValueError('x')\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.sarif')\n"
+        "        self.n = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "    def bad(self):\n"
+        "        return self.n\n")
+    out = tmp_path / "report.sarif"
+    no_allow = str(tmp_path / "no_allowlist.json")
+    # exit-code contract unchanged by --sarif
+    assert mxlint.main([str(tmp_path), "--sarif", str(out),
+                        "--allowlist", no_allow]) == 1
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0" and len(log["runs"]) == 1
+    rule_ids = {r["id"] for r in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"guarded-by", "guard-declare", "callback-under-lock",
+            "typed-raise"} <= rule_ids
+    got = mxlint.from_sarif(log, mxlint._REPO)
+    findings = run_lint([str(tmp_path)], allowlist_path=no_allow)
+    want = [(f.rule, os.path.normpath(f.path), f.line, f.message)
+            for f in findings]
+    assert sorted(got) == sorted(want)
+    assert {r for r, *_ in got} == {"typed-raise", "guarded-by"}
+    # a clean tree writes an empty-results SARIF and exits 0
+    ok = tmp_path / "clean" / "serving"
+    ok.mkdir(parents=True)
+    (ok / "y.py").write_text("x = 1\n")
+    out2 = tmp_path / "clean.sarif"
+    assert mxlint.main([str(tmp_path / "clean"), "--sarif",
+                        str(out2), "--allowlist", no_allow]) == 0
+    assert json.loads(out2.read_text())["runs"][0]["results"] == []
+
+
+def test_guard_map_cli(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import mxlint
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "map.json"
+    assert mxlint.main([PKG, "--guard-map", str(out),
+                        "--doc-catalog", CATALOG]) == 0
+    gmap = json.loads(out.read_text())
+    assert gmap["schema_version"] == rg.GUARD_MAP_SCHEMA_VERSION
+    assert "serving.engine.step" in gmap["sites"]

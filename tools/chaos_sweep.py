@@ -1105,6 +1105,116 @@ def training_bad_batch_quarantine(steps=4):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+# ------------------------------------------------- raceguard corroboration
+
+def corroboration_probes(net):
+    """Drive the guard sites the matrix's scenarios legitimately never
+    reach (docs/static_analysis.md "corroboration semantics"): each
+    probe takes the cold lock on its PUBLIC surface so the statically-
+    claimed guard is proven to be the lock actually acquired at
+    runtime.  Returns a list of (site, how) records for the report."""
+    import numpy as onp
+
+    probed = []
+    # standalone DynamicBatcher: engines pass their own condition in,
+    # so the batcher's named condition only exists standalone
+    from mxnet_tpu.serving.batcher import DynamicBatcher
+    from mxnet_tpu.serving.engine import Request
+    b = DynamicBatcher(max_depth=4)
+    b.put(Request("forward", onp.zeros((2, 2), "float32")))
+    b.drain()
+    b.close()
+    probed.append(("serving.batcher.cond",
+                   "standalone DynamicBatcher put/drain/close"))
+    # tracer lifecycle: the global active-tracer swap and the ring lock
+    from mxnet_tpu.observability import trace
+    tr = trace.enable(capacity=16)
+    tr.event("chaos.corroboration_probe")
+    trace.disable()
+    probed.append(("obs.trace_global + obs.trace_ring",
+                   "trace.enable/event/disable"))
+    # process RNG reseed (the generator lock)
+    import mxnet_tpu as mx
+    mx.random.seed(20260804)
+    probed.append(("random.generator", "mx.random.seed"))
+    # seeded-random routing: the only policy that takes the router's
+    # rng lock — a 2-replica fleet serving a few requests through it
+    fleet = _fleet(net, n=2, name="probe_rand", routing="random")
+    fleet.warmup()
+    with fleet:
+        for p in _prompts((3, 4, 5), seed=21):
+            fleet.infer(p, max_new_tokens=2)
+    _join_zombies()
+    probed.append(("fleet.router.rng", "routing='random' fleet wave"))
+    # multi-leaf digest: the shared leaf-hash pool (and its lock) only
+    # exists for files >= one tree chunk — chaos checkpoints are tiny
+    from mxnet_tpu.resilience.integrity import _TREE_CHUNK, file_digest
+    workdir = tempfile.mkdtemp(prefix="probe_digest_")
+    try:
+        big = os.path.join(workdir, "big.bin")
+        with open(big, "wb") as f:
+            f.write(os.urandom(2 * _TREE_CHUNK + 17))
+        file_digest(big)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    probed.append(("integrity.digest_pool",
+                   "file_digest of a multi-leaf (2 MB) file"))
+    # all-replicas-shed saturation tracking: a 1-replica fleet with a
+    # depth-1 queue, flooded until a submit sheds fleet-wide
+    from mxnet_tpu.serving import QueueFullError
+
+    def tiny_factory(name):
+        return _engine(net, name=name, queue_depth=1,
+                       max_wait_us=200000.0)
+
+    from mxnet_tpu.fleet import FleetRouter
+    sat = FleetRouter(factory=tiny_factory, num_replicas=1,
+                      name="probe_sat", health_interval=0.05,
+                      saturation_threshold=1)
+    sat.warmup()
+    sheds = 0
+    with sat:
+        futs = []
+        for p in _prompts(tuple(range(2, 14)), seed=23):
+            try:
+                futs.append(sat.submit(p, max_new_tokens=3))
+            except QueueFullError:
+                sheds += 1
+        _resolve_all(futs, timeout=60)
+    _join_zombies()
+    probed.append(("fleet.router.saturation",
+                   f"1-replica depth-1 flood ({sheds} fleet-wide sheds)"))
+    return probed
+
+
+def raceguard_corroboration(witness, probed):
+    """Close the static<->dynamic loop: every lock site the raceguard
+    guard map claims must have been ACQUIRED somewhere in the sweep
+    (minus the justified CORROBORATION_EXEMPT sites), and every site
+    the witness saw must be statically mapped.  A claimed-but-never-
+    exercised guard is an unproven contract; a witnessed-but-unmapped
+    site is runtime locking the static analysis cannot see."""
+    from mxnet_tpu.analysis import raceguard
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gmap = raceguard.build_guard_map([os.path.join(repo, "mxnet_tpu")],
+                                     root=repo)
+    verdict = raceguard.corroborate(gmap, witness.report()["per_site"])
+    return {
+        "name": "raceguard_corroboration",
+        "passed": bool(verdict["passed"]),
+        "detail": {
+            "mapped_sites": verdict["mapped_sites"],
+            "witnessed_sites": verdict["witnessed_sites"],
+            "unexercised": verdict["unexercised"],
+            "unmapped": verdict["unmapped"],
+            "exempt": verdict["exempt"],
+            "probes": [f"{site}: {how}" for site, how in probed],
+            "acquisitions_per_mapped_site":
+                verdict["acquisitions_per_mapped_site"],
+        },
+    }
+
+
 # -------------------------------------------------------------------- main
 
 def main():
@@ -1118,7 +1228,17 @@ def main():
                          "'lockwitness' scenario that fails on any "
                          "witnessed cycle or unallowlisted finding and "
                          "embeds the ordering-graph report")
+    ap.add_argument("--corroborate", action="store_true",
+                    help="cross-check the raceguard static guard map "
+                         "against the witness acquisition dump (implies "
+                         "--lockwitness); appends a "
+                         "'raceguard_corroboration' scenario that fails "
+                         "on any claimed-but-never-witnessed or "
+                         "witnessed-but-unmapped lock site")
     args = ap.parse_args()
+
+    if args.corroborate:
+        args.lockwitness = True
 
     witness = None
     if args.lockwitness:
@@ -1162,6 +1282,19 @@ def main():
     run(training_persistent_nan_rewind)
     run(training_bad_batch_quarantine)
 
+    probed = []
+    if witness is not None and args.corroborate:
+        # cold-site probes run UNDER the witness, before its report is
+        # cut, so the lockwitness scenario covers their acquisitions too
+        try:
+            probed = corroboration_probes(net)
+        except Exception:
+            scenarios.append({
+                "name": "raceguard_corroboration", "passed": False,
+                "seconds": 0.0,
+                "detail": {"error": traceback.format_exc(limit=5)}})
+            args.corroborate = False
+
     if witness is not None:
         # the whole matrix ran under the witness: the chaos
         # interleavings (kills, hung drains, replica crashes,
@@ -1187,6 +1320,23 @@ def main():
               f"lockwitness (nodes={wrep['nodes']} edges={wrep['edges']} "
               f"cycles={wrep['cycles']} "
               f"findings={len(wrep['findings'])})", flush=True)
+
+    if witness is not None and args.corroborate:
+        t0 = time.perf_counter()
+        try:
+            rec = raceguard_corroboration(witness, probed)
+        except Exception:
+            rec = {"name": "raceguard_corroboration", "passed": False,
+                   "detail": {"error": traceback.format_exc(limit=5)}}
+        rec["seconds"] = round(time.perf_counter() - t0, 2)
+        scenarios.append(rec)
+        d = rec["detail"]
+        print(f"[{'PASS' if rec['passed'] else 'FAIL'}] "
+              f"raceguard_corroboration "
+              f"(mapped={d.get('mapped_sites')} "
+              f"witnessed={d.get('witnessed_sites')} "
+              f"unexercised={d.get('unexercised')} "
+              f"unmapped={d.get('unmapped')})", flush=True)
 
     report = {
         "platform": platform,
