@@ -54,6 +54,19 @@ carries per-class goodput and deadline-hit-rate, and the controlled
 record adds the shed breakdown by reason/class, preemption and
 brownout counts.
 
+``--workload paged`` runs the paged-vs-dense KV comparison
+(docs/serving.md "Paged KV"): the same mixed short/long-prompt burst is
+pushed through a DENSE engine and through a PAGED engine provisioned
+with exactly the same KV positions (``num_pages * page_size ==
+dense_slots * Tmax``) but many more slots — the dense engine's
+concurrency is capped by worst-case rows, the paged engine's by live
+tokens.  It emits ``serving_paged_dense`` (the baseline) and
+``serving_paged`` (``vs_baseline`` is the tokens/s speedup; the record
+carries ``max_concurrent`` per arm and ``concurrency_ratio`` — the
+headline: max sustainable concurrency at fixed KV memory, the number
+paging exists to win — plus page-pool occupancy/fault/sharing stats).
+Greedy outputs are asserted token-identical between the arms.
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -524,13 +537,145 @@ def bench_overload(n_waves: int = 20, trials: int = 3):
              brownouts=ov["brownouts"]))
 
 
+def _build_paged_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        name = "gpt2_124m"
+        short_lens, long_lens = (64, 96, 128), (1024, 1536)
+        seq_buckets = (64, 128, 256, 512, 1024, 2048)
+        page_size, max_new, dense_slots = 128, 64, 4
+    else:   # CPU sanity: the comparison is about CAPACITY (how many
+        # requests fit a fixed KV budget), not raw compute — a small
+        # model keeps the burst short while the page accounting is
+        # identical to the TPU shape
+        name = "gpt2_124m"
+        cfg = dict(vocab_size=512, units=128, num_layers=2, num_heads=4,
+                   max_length=64, dropout=0.0)
+        short_lens, long_lens = (8, 10, 12), (40, 48)
+        seq_buckets = (8, 16)
+        page_size, max_new, dense_slots = 8, 8, 2
+    net = get_gpt2(name, **cfg)
+    net.initialize()
+    return (net, short_lens, long_lens, seq_buckets, page_size, max_new,
+            dense_slots)
+
+
+def bench_paged(n_requests: int = 16, trials: int = 3):
+    """Paged vs dense at FIXED KV memory: a mixed short/long burst.
+
+    Both arms hold exactly ``dense_slots * Tmax`` KV positions; the
+    dense arm can run ``dense_slots`` requests at once no matter how
+    short they are, the paged arm runs as many as their LIVE tokens
+    fit.  Per trial (fresh engines — concurrency highwater and page
+    counters are per-engine-lifetime): submit the whole burst, wait it
+    out, score tokens/s and ``active_highwater``.  Outputs are asserted
+    token-identical between the arms (greedy parity is a correctness
+    gate of this bench, not just a test)."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    (net, short_lens, long_lens, seq_buckets, page_size, max_new,
+     dense_slots) = _build_paged_net(on_tpu)
+    rs = onp.random.RandomState(11)
+    # ~1 in 4 requests is LONG — the worst case the dense layout
+    # provisions every slot for
+    lens = [long_lens[i % len(long_lens)] if i % 4 == 3
+            else short_lens[i % len(short_lens)]
+            for i in range(n_requests)]
+    prompts = [rs.randint(0, net.vocab_size, (l,)).astype("int32")
+               for l in lens]
+    tmax = net.max_length
+    n_logical = tmax // page_size
+    kv_positions = dense_slots * tmax          # the fixed memory budget
+    num_pages = dense_slots * n_logical        # same bytes, paged
+    # the paged arm may lease as many slots as pages could ever cover
+    # at the SHORTEST live footprint; bounded for sane bucket lattices
+    paged_slots = min(n_requests, max(
+        dense_slots + 1,
+        num_pages // max(1, (min(short_lens) + max_new + page_size - 1)
+                         // page_size)))
+
+    def one_trial(layout):
+        from mxnet_tpu.observability import flatten
+        kw = dict(num_slots=dense_slots, prefix_pool_rows=0)
+        if layout == "paged":
+            kw = dict(num_slots=paged_slots, kv_layout="paged",
+                      page_size=page_size, num_pages=num_pages)
+        eng = InferenceEngine(
+            net, max_batch=kw["num_slots"], seq_buckets=seq_buckets,
+            queue_depth=4 * n_requests, default_max_new_tokens=max_new,
+            name=f"serving_paged_{layout}", **kw)
+        eng.warmup()
+        with eng:
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            outs = [f.result(timeout=1800) for f in futs]
+            dt = time.perf_counter() - t0
+            s = eng.stats()
+            # snapshot the registry while THIS engine is alive (it is
+            # a weakref-bound collector: a dead engine prunes itself
+            # from the scrape, so main()'s final snapshot would be
+            # empty)
+            s["registry"] = flatten(prefix="mxtpu_serving")
+        toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return toks / dt, s, outs
+
+    dense_vals, paged_vals = [], []
+    dense_cc, paged_cc = [], []
+    last_dense = last_paged = None
+    for _ in range(max(1, trials)):
+        tps, s, outs_d = one_trial("dense")
+        dense_vals.append(tps)
+        dense_cc.append(s["slots"]["active_highwater"])
+        last_dense = s
+        tps, s, outs_p = one_trial("paged")
+        paged_vals.append(tps)
+        paged_cc.append(s["slots"]["active_highwater"])
+        last_paged = s
+        for d, p in zip(outs_d, outs_p):      # correctness gate
+            if not onp.array_equal(d, p):
+                raise AssertionError(
+                    "paged/dense greedy outputs diverged — the bench "
+                    "numbers would be comparing different work")
+    speedup = round(statistics.median(paged_vals) /
+                    statistics.median(dense_vals), 4)
+    cc_dense = statistics.median(dense_cc)
+    cc_paged = statistics.median(paged_cc)
+    base = {"n_requests": n_requests, "max_new_tokens": max_new,
+            "prompt_lens": lens, "kv_positions": kv_positions,
+            "page_size": page_size}
+    yield _record(
+        "serving_paged_dense", dense_vals, "tokens/sec", None,
+        dict(base, num_slots=dense_slots, max_concurrent=cc_dense,
+             concurrency_per_1k_kv=round(1000.0 * cc_dense /
+                                         kv_positions, 3),
+             slots=last_dense["slots"],
+             registry_live=last_dense["registry"]))
+    yield _record(
+        "serving_paged", paged_vals, "tokens/sec", speedup,
+        dict(base, num_slots=paged_slots, num_pages=num_pages,
+             max_concurrent=cc_paged,
+             concurrency_per_1k_kv=round(1000.0 * cc_paged /
+                                         kv_positions, 3),
+             concurrency_ratio=round(cc_paged / cc_dense, 4),
+             slots=last_paged["slots"],
+             registry_live=last_paged["registry"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
-                    choices=("decode", "prefix", "fleet", "overload"),
+                    choices=("decode", "prefix", "fleet", "overload",
+                             "paged"),
                     default="decode")
     args = ap.parse_args()
 
@@ -546,6 +691,8 @@ def main():
         recs = bench_fleet(trials=args.trials)
     elif args.workload == "overload":
         recs = bench_overload(trials=args.trials)
+    elif args.workload == "paged":
+        recs = bench_paged(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -156,7 +156,24 @@ class Parameter:
             if self.grad_req != "null":
                 self._attach_grad()
         else:
-            self._data._rebind(jnp.asarray(data.jax, dtype=self.dtype))
+            new = jnp.asarray(data.jax, dtype=self.dtype)
+            old = self._data.jax
+            if getattr(new, "_committed", False) and \
+                    not getattr(old, "_committed", True):
+                # the replacement payload must inherit the OLD
+                # payload's placement: initialize() leaves params
+                # UNCOMMITTED (default placement), and jax's jit cache
+                # keys on committed-ness — a committed replacement
+                # (e.g. nd.array(host_data) routed through device_put)
+                # silently re-specializes EVERY executable that traced
+                # over the old payload, one hidden recompile per
+                # program on its next dispatch.  A serving engine
+                # sharing this net then stalls on traffic after
+                # warmup() with its compile counter unmoved — the
+                # compile-freeze contract violated from outside.
+                # reset_ctx is the API for intentional placement moves.
+                new = jnp.asarray(onp.asarray(new), dtype=self.dtype)
+            self._data._rebind(new)
 
     def zero_grad(self):
         d = self._data

@@ -97,8 +97,18 @@ class _GPT2Decoding:
         _dense_blocks_only(self)
         return self.init_cache(num_slots, max_length, dtype)
 
+    def init_page_cache(self, num_pages, page_size, dtype=None):
+        """Persistent PAGED serving cache (docs/serving.md "Paged KV"):
+        per-layer (N, ps, H, D) where each of the N fixed-size pages
+        holds ``page_size`` positions of whichever slot's page table
+        currently maps it (the engine reserves the last page as
+        scratch).  Structurally this is :meth:`init_cache` with pages
+        as the batch dim and the page as the sequence."""
+        _dense_blocks_only(self)
+        return self.init_cache(num_pages, page_size, dtype)
+
     def prefill_slots(self, tokens_nd, lens, caches, slot_idx,
-                      offset=None):
+                      offset=None, page_table=None):
         """Admission prefill for a bucketed batch of prompts: tokens
         (B, Tb) int32 right-PADDED to the bucket length, ``lens`` (B,)
         true lengths, ``slot_idx`` (B,) destination rows of the (R,...)
@@ -117,7 +127,12 @@ class _GPT2Decoding:
         attention runs against the full cache row (see
         ``MultiHeadAttention.forward_prefill_slots``).  Logits are
         still at each row's last real CHUNK position ``lens[i]-1`` —
-        only the final chunk's logits are meaningful."""
+        only the final chunk's logits are meaningful.
+
+        With ``page_table`` (S+1, P) int32 given the caches are PAGED
+        — per-layer (N+1, ps, H, D) from :meth:`init_page_cache` — and
+        every K/V write/read routes through the table (docs/serving.md
+        "Paged KV"); everything else is identical."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
@@ -135,7 +150,8 @@ class _GPT2Decoding:
         x = self.wte(tokens_nd) + self.wpe(pos)
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
-            x, c = blk.forward_prefill_slots(x, cache, slot_idx, offset)
+            x, c = blk.forward_prefill_slots(x, cache, slot_idx, offset,
+                                             page_table)
             new_caches.append(c)
         x = self.ln_f(x)
         last = NDArray(x.jax[jnp.arange(b), lens - 1])      # (B, U)
@@ -144,7 +160,7 @@ class _GPT2Decoding:
                                   flatten=False)
         return logits, new_caches
 
-    def decode_step(self, tok, caches, pos):
+    def decode_step(self, tok, caches, pos, page_table=None):
         """One continuous-batching decode step over EVERY slot: tok (S,)
         int32 NDArray of last tokens, ``pos`` (S,) int32 jax array of
         their (per-slot) positions → (logits (S, vocab), new caches).
@@ -154,7 +170,11 @@ class _GPT2Decoding:
         DROPS — an in-range dummy position would clobber real K/V, e.g.
         a prefix-cache copy at position 0 of a mid-prefill row.  The
         caches may carry more rows than ``S`` (scratch + prefix pool);
-        rows past S are never written or attended here.  Inference mode
+        rows past S are never written or attended here.  With
+        ``page_table`` (S, P) int32 the caches are PAGED (parked rows'
+        writes route out of bounds and drop, and unassigned table
+        entries read the never-written zero page — see
+        ``MultiHeadAttention.forward_step_slots``).  Inference mode
         assumed."""
         from ..ndarray import NDArray
 
@@ -163,7 +183,7 @@ class _GPT2Decoding:
         x = self.wte(tok2) + self.wpe(NDArray(pos.reshape((s, 1))))
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
-            x, c = blk.forward_step_slots(x, cache, pos)
+            x, c = blk.forward_step_slots(x, cache, pos, page_table)
             new_caches.append(c)
         x = self.ln_f(x)
         logits = F.FullyConnected(x, self.wte.weight.data(), None,

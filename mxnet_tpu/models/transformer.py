@@ -162,7 +162,7 @@ class MultiHeadAttention(HybridBlock):
         out = self.out_proj(out.reshape((b, t, h * d)))
         return out, {"k": kc, "v": vc}
 
-    def forward_step_slots(self, x, cache, pos):
+    def forward_step_slots(self, x, cache, pos, page_table=None):
         """Continuous-batching decode: x (S,1,U) where row s is an
         independent request parked in SLOT s of the persistent cache
         {'k','v': (R,Tmax,H,D)}, at its OWN position ``pos`` (S,) int32.
@@ -172,7 +172,18 @@ class MultiHeadAttention(HybridBlock):
         only rows [0, S) are written or attended; an out-of-range
         ``pos`` (the engine parks idle rows at Tmax) makes the write an
         out-of-bounds scatter, which jax DROPS, so idle rows never
-        clobber cache state.  Inference only."""
+        clobber cache state.  Inference only.
+
+        PAGED variant (``page_table`` (S, P) int32 given — docs/
+        serving.md "Paged KV"): the cache is {'k','v': (N+1, ps, H, D)}
+        pages instead of rows; row s's write routes through its table
+        entry ``page_table[s, pos[s]//ps]`` (parked rows and writes
+        into unassigned table entries route OUT OF BOUNDS, which jax
+        drops — page N is the never-written ZERO page that unassigned
+        entries READ), and attention gathers the row's pages back into
+        a contiguous (S, P*ps, H, D) view so the masked attention
+        below is shared verbatim with the dense layout — identical
+        shapes, identical masked values, bit-identical tokens."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
@@ -182,17 +193,42 @@ class MultiHeadAttention(HybridBlock):
         q = self.q_proj(x).reshape((s, 1, h, d))
         k_new = self.k_proj(x).reshape((s, h, d))
         v_new = self.v_proj(x).reshape((s, h, d))
-        rows = jnp.arange(s)
-        kc = cache["k"].at[rows, pos].set(
-            k_new.jax.astype(cache["k"].dtype))
-        vc = cache["v"].at[rows, pos].set(
-            v_new.jax.astype(cache["v"].dtype))
-        out = _attention_step_slots(q.jax, kc[:s], vc[:s], pos,
+        if page_table is None:
+            rows = jnp.arange(s)
+            kc = cache["k"].at[rows, pos].set(
+                k_new.jax.astype(cache["k"].dtype))
+            vc = cache["v"].at[rows, pos].set(
+                v_new.jax.astype(cache["v"].dtype))
+            krow, vrow = kc[:s], vc[:s]
+        else:
+            ps = cache["k"].shape[1]
+            tmax = page_table.shape[1] * ps
+            zero_page = cache["k"].shape[0] - 1
+            lp = jnp.minimum(pos // ps, page_table.shape[1] - 1)
+            mapped = page_table[jnp.arange(s), lp]
+            # a write with no real target — a parked row (pos >= Tmax)
+            # or an unassigned table entry (zero page) — routes OUT OF
+            # BOUNDS so jax DROPS it.  Nothing may ever write the zero
+            # page: unassigned logical pages of every live slot read
+            # it, so one row's NaN landing there would poison every
+            # other row through the 0·NaN=NaN value einsum (the dense
+            # layout isolates rows; paging must too)
+            phys = jnp.where((pos < tmax) & (mapped != zero_page),
+                             mapped, zero_page + 1)
+            off = pos % ps
+            kc = cache["k"].at[phys, off].set(
+                k_new.jax.astype(cache["k"].dtype))
+            vc = cache["v"].at[phys, off].set(
+                v_new.jax.astype(cache["v"].dtype))
+            krow = _paged_rows(kc, page_table)
+            vrow = _paged_rows(vc, page_table)
+        out = _attention_step_slots(q.jax, krow, vrow, pos,
                                     1.0 / (d ** 0.5))
         out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
         return out, {"k": kc, "v": vc}
 
-    def forward_prefill_slots(self, x, cache, slot_idx, offset=None):
+    def forward_prefill_slots(self, x, cache, slot_idx, offset=None,
+                              page_table=None):
         """Bucketed admission prefill: x (B,Tb,U) is a batch of PADDED
         prompts; row i's K/V for positions [0, Tb) land in cache row
         ``slot_idx[i]`` of the persistent (R,Tmax,H,D) cache.  Causal
@@ -212,7 +248,19 @@ class MultiHeadAttention(HybridBlock):
         is gathered back for the attention (the data dependency through
         the scatter keeps XLA honest about ordering).  Writes landing at
         positions >= Tmax (padding columns of a final chunk) are
-        out-of-bounds scatters, which jax drops."""
+        out-of-bounds scatters, which jax drops.
+
+        PAGED variant (``page_table`` (S+1, P) int32 given): the cache
+        is {'k','v': (N+1, ps, H, D)} pages; row i's K/V scatter through
+        ITS table row ``page_table[slot_idx[i]]`` — position p lands in
+        page ``table[p//ps]`` at in-page offset ``p%ps``; writes with
+        no real target (positions past Tmax, columns spilling into an
+        unassigned logical page, the scratch slot-row's padding rows)
+        route OUT OF BOUNDS and are dropped — page N is the
+        never-written ZERO page unassigned entries read.  The offset
+        path gathers each row's pages back into a contiguous
+        (B, Tmax, H, D) view so :func:`_attention_chunk` is shared
+        verbatim with the dense layout."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
@@ -223,16 +271,44 @@ class MultiHeadAttention(HybridBlock):
         q = self.q_proj(x).reshape((b, t, h, d))
         k = self.k_proj(x).reshape((b, t, h, d))
         v = self.v_proj(x).reshape((b, t, h, d))
-        ridx = slot_idx[:, None]
         cidx = jnp.arange(t)[None, :] if offset is None \
             else offset[:, None] + jnp.arange(t)[None, :]
-        kc = cache["k"].at[ridx, cidx].set(k.jax.astype(cache["k"].dtype))
-        vc = cache["v"].at[ridx, cidx].set(v.jax.astype(cache["v"].dtype))
+        if page_table is None:
+            ridx = slot_idx[:, None]
+            kc = cache["k"].at[ridx, cidx].set(
+                k.jax.astype(cache["k"].dtype))
+            vc = cache["v"].at[ridx, cidx].set(
+                v.jax.astype(cache["v"].dtype))
+        else:
+            ps = cache["k"].shape[1]
+            tmax = page_table.shape[1] * ps
+            zero_page = cache["k"].shape[0] - 1
+            trows = page_table[slot_idx]                     # (B, P)
+            lp = jnp.minimum(cidx // ps, page_table.shape[1] - 1)
+            mapped = jnp.take_along_axis(trows, lp, axis=1)  # (B, Tb)
+            # padding columns past Tmax, columns spilling into a
+            # logical page the row never claimed (mixed-offset chunk
+            # batches pad every row to the LONGEST take), and the
+            # scratch slot-row's padding rows all route OUT OF BOUNDS
+            # (dropped) — the zero page must never be written, every
+            # live slot reads it through its unassigned table entries
+            phys = jnp.where((cidx < tmax) & (mapped != zero_page),
+                             mapped, zero_page + 1)
+            off = cidx % ps
+            kc = cache["k"].at[phys, off].set(
+                k.jax.astype(cache["k"].dtype))
+            vc = cache["v"].at[phys, off].set(
+                v.jax.astype(cache["v"].dtype))
         if offset is None:
             out = dot_product_attention(q, k, v, causal=True)
-        else:
+        elif page_table is None:
             krow = kc[slot_idx]          # (B, Tmax, H, D)
             vrow = vc[slot_idx]
+            out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
+                                           1.0 / (d ** 0.5)))
+        else:
+            krow = _paged_rows(kc, page_table[slot_idx])
+            vrow = _paged_rows(vc, page_table[slot_idx])
             out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
                                            1.0 / (d ** 0.5)))
         out = self.out_proj(out.reshape((b, t, h * d)))
@@ -253,6 +329,29 @@ def _attention_step(q, k_cache, v_cache, idx, scale):
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
                       v_cache)
+
+
+def _paged_rows(pages, table_rows):
+    """Gather per-slot pages back into contiguous rows: ``pages``
+    (N+1, ps, H, D) physical KV pages, ``table_rows`` (B, P) int32 page
+    tables → (B, P*ps, H, D), i.e. exactly the dense (B, Tmax, H, D)
+    row view, so the masked attentions are shared verbatim between the
+    two layouts (token parity by construction: every attended position
+    holds identical values, every masked position is selected out
+    BEFORE the softmax).  Unassigned logical pages point at the ZERO
+    page — pristine zeros, NEVER written (targetless writes route out
+    of bounds and drop): that matters because a masked-out lane is
+    only harmless if its VALUE is finite — probs underflow to exactly
+    0.0 but 0·NaN = NaN in the value einsum, so scratch-page NaN from
+    one poisoned row would otherwise fail every live request at once
+    (the dense layout isolates rows; paging must too).  The gather
+    materializes a (B, Tmax) working set transiently — the HBM win of
+    paging is in the PERSISTENT allocation (live tokens, not
+    Tmax*slots); a fused kernel that skips the materialization is the
+    TPU follow-up, same as the flash chunk-attention note below."""
+    b, p = table_rows.shape
+    g = pages[table_rows]                    # (B, P, ps, H, D)
+    return g.reshape(b, p * g.shape[2], g.shape[3], g.shape[4])
 
 
 def _attention_chunk(q, k_rows, v_rows, qpos, scale):
@@ -524,20 +623,24 @@ class TransformerBlock(HybridBlock):
         x = x + self.ffn(self.ln2(x))
         return x, cache
 
-    def forward_step_slots(self, x, cache, pos):
+    def forward_step_slots(self, x, cache, pos, page_table=None):
         """Continuous-batching decode through the block (see
-        MultiHeadAttention.forward_step_slots)."""
-        a, cache = self.attn.forward_step_slots(self.ln1(x), cache, pos)
+        MultiHeadAttention.forward_step_slots; ``page_table`` selects
+        the paged-KV layout)."""
+        a, cache = self.attn.forward_step_slots(self.ln1(x), cache, pos,
+                                                page_table)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache
 
-    def forward_prefill_slots(self, x, cache, slot_idx, offset=None):
+    def forward_prefill_slots(self, x, cache, slot_idx, offset=None,
+                              page_table=None):
         """Bucketed admission prefill through the block (see
         MultiHeadAttention.forward_prefill_slots; ``offset`` selects the
-        chunked/offset variant)."""
+        chunked/offset variant, ``page_table`` the paged-KV layout)."""
         a, cache = self.attn.forward_prefill_slots(self.ln1(x), cache,
-                                                   slot_idx, offset)
+                                                   slot_idx, offset,
+                                                   page_table)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache

@@ -168,6 +168,11 @@ register_site("serving.decode_step", "batched decode-step dispatch")
 register_site("serving.forward", "batched forward-mode dispatch")
 register_site("serving.prefix_lookup", "prefix-cache host radix-tree ops")
 register_site("serving.prefix_copy", "prefix-cache compiled row copy")
+register_site("serving.page_alloc",
+              "paged-KV page allocation (degrades to an alloc retry)")
+register_site("serving.page_copy",
+              "paged-KV compiled partial-tail-page copy (degrades to "
+              "whole-page sharing + longer suffix prefill)")
 # overload control (docs/overload.md) — degrades, never fails a request
 register_site("overload.admission", "priority/deadline admission gate")
 register_site("overload.preempt", "slot-preemption attempt")

@@ -28,6 +28,7 @@ from .errors import (DeadlineExceededError, DeadlineInfeasibleError,
                      NoHealthyReplicaError, NonFiniteOutputError,
                      QueueFullError, RequestCancelledError,
                      RequestTimeoutError, ServingError)
+from .kv_pages import PagedPrefixCache, PagedPrefixEntry, PagePool
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
 from .overload import (PRIORITIES, CircuitBreaker, OverloadController,
@@ -38,6 +39,7 @@ __all__ = [
     "InferenceEngine", "InferenceFuture", "Request",
     "BucketLattice", "DynamicBatcher",
     "SlotAllocator", "SlotState",
+    "PagePool", "PagedPrefixCache", "PagedPrefixEntry",
     "PrefixCache", "PrefixEntry",
     "LatencyHistogram", "ServingMetrics",
     "PRIORITIES", "OverloadController", "RetryBudget", "CircuitBreaker",

@@ -46,6 +46,26 @@ to resume by prefix hit, so preemption wastes almost no work.
 shed/served counts and the bucket-hit/compile counters; scheduler
 batches are wrapped in :mod:`~mxnet_tpu.profiler` annotations.
 
+Paged KV memory (docs/serving.md "Paged KV"): with
+``kv_layout='paged'`` the dense per-slot ``(Tmax, H, D)`` rows are
+replaced by a process-wide pool of fixed-size KV PAGES plus a per-slot
+page table (:mod:`.kv_pages` — the PagedAttention design), decoupling
+concurrency from ``Tmax``: a slot claims pages lazily as its position
+advances, so HBM is bounded by LIVE TOKENS and ``num_slots`` can far
+exceed what dense rows would fit.  Admission blocks on PAGE
+availability (requests wait queued, never fail, until the pool — free
+pages plus evictable prefix claims — covers their prompt); pool
+exhaustion mid-flight is a PAGE FAULT handled by evicting zero-reader
+prefix entries, then parking the youngest lowest-class slot by
+reference (its pages become an evictable prefix entry, its request
+requeues to resume by prefix hit — no copy).  The prefix cache becomes
+shared read-only pages: a whole-page hit is a page-table write + a
+refcount bump (the dense engine's compiled masked row copy disappears;
+only a partial tail page still pays one compiled page copy), and
+scrub-on-NaN zeroes exactly the pages the victim's release freed.
+Everything else — the bucket lattice, chunked prefill, ``warmup()``
+compile freeze, greedy token parity — composes unchanged.
+
 Prefix reuse (docs/serving.md): with ``prefix_pool_rows > 0`` a
 host-side radix tree (:mod:`.prefix_cache`) maps admitted prompt
 prefixes to a reserved pool of KV cache rows; a request whose prompt
@@ -97,6 +117,7 @@ from .errors import (DeadlineInfeasibleError, EngineCrashedError,
                      NonFiniteOutputError, QueueFullError,
                      RequestCancelledError, RequestTimeoutError,
                      ServingError)
+from .kv_pages import PagedPrefixCache, PagePool
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import ServingMetrics
 from .overload import (OverloadController, PRIORITY_BATCH,
@@ -301,6 +322,24 @@ class InferenceEngine:
         inserts, and only at the floor sheds ``best_effort`` arrivals;
         recovers automatically.  ``overload_controller`` swaps in a
         pre-tuned controller instance.
+    kv_layout : ``'dense'`` (default) | ``'paged'`` — the KV memory
+        layout (docs/serving.md "Paged KV").  Dense reserves a full
+        ``(Tmax, H, D)`` row per slot; paged carves the cache into
+        fixed-size pages with per-slot page tables, so HBM is bounded
+        by live tokens and ``num_slots`` decouples from the worst-case
+        request.  Greedy decode is token-identical between the two.
+    page_size : positions per KV page (paged layout; must divide
+        ``max_length``).  Smaller pages waste less tail capacity but
+        grow the page table; 16 is the vLLM-ish default.
+    num_pages : physical KV pages in the pool (paged layout).  Default
+        ``num_slots * max_length / page_size`` — the dense-equivalent
+        footprint; provision FEWER to serve the same concurrency in
+        less memory (the paged-vs-dense bench's working point).  Must
+        cover at least one worst-case request
+        (``max_length / page_size``).  In the paged layout the prefix
+        cache reserves nothing (``prefix_pool_rows`` is ignored):
+        cached prefixes are evictable refcount claims on this same
+        pool, so it is always enabled.
     name : base name for this engine's metrics identity.  The claimed
         name (``self.name``) is uniquified against every other live
         engine (``serving``, ``serving-2``, …) so fleet replicas export
@@ -335,6 +374,9 @@ class InferenceEngine:
                  deadline_min_history: int = 8,
                  brownout: bool = True,
                  overload_controller: Optional[OverloadController] = None,
+                 kv_layout: str = "dense",
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -358,6 +400,14 @@ class InferenceEngine:
         # collect().  A dead (collected) engine releases its name.
         self.name = _claim_engine_name(str(name), self)
         self.metrics = ServingMetrics(self.name)
+        if kv_layout not in ("dense", "paged"):
+            raise ServingError(f"kv_layout must be 'dense'|'paged', got "
+                               f"{kv_layout!r}")
+        if kv_layout == "paged" and mode != "decode":
+            raise ServingError("kv_layout='paged' is a decode-mode layout "
+                               "(forward mode has no KV cache to page)")
+        self.kv_layout = kv_layout
+        self._paged = self.kv_layout == "paged"
 
         if mode == "decode":
             self.max_length = int(max_length or net.max_length)
@@ -389,10 +439,46 @@ class InferenceEngine:
             self.prefill_chunk = min(self.prefill_chunk,
                                      self.lattice.max_seq)
             self.prefix_min_tokens = max(1, int(prefix_min_tokens))
-            self._prefix = PrefixCache(
-                self.prefix_pool_rows, row_base=self.num_slots + 1,
-                min_tokens=self.prefix_min_tokens) \
-                if self.prefix_pool_rows else None
+            if self._paged:
+                self.page_size = int(page_size)
+                if self.page_size < 1 or self.max_length % self.page_size:
+                    raise ServingError(
+                        f"page_size={page_size} must be >= 1 and divide "
+                        f"max_length={self.max_length} (fixed-shape page "
+                        "tables need a whole number of logical pages)")
+                self._n_logical = self.max_length // self.page_size
+                self.num_pages = int(num_pages) if num_pages is not None \
+                    else self.num_slots * self._n_logical
+                if self.num_pages < self._n_logical:
+                    raise ServingError(
+                        f"num_pages={self.num_pages} cannot hold even one "
+                        f"worst-case request ({self._n_logical} pages of "
+                        f"{self.page_size}); a request could be admitted "
+                        "that no amount of eviction/preemption can serve")
+                self._pool = PagePool(self.num_pages, self.page_size)
+                # host-authoritative page table (scheduler-thread-only,
+                # like the allocator): row = slot (+ the scratch row),
+                # entries init to the scratch page id.  Shipped to the
+                # device as a traced argument per compiled call.
+                self._page_table = onp.full(
+                    (self.num_slots + 1, self._n_logical),
+                    self._pool.scratch, "int32")
+                self._table_dev = None
+                # paged prefix cache reserves NOTHING (entries are
+                # evictable refcount claims on the shared pool), so it
+                # is always on; prefix_pool_rows is a dense-only knob
+                self.prefix_pool_rows = 0
+                self._prefix = PagedPrefixCache(
+                    self._pool, min_tokens=self.prefix_min_tokens)
+            else:
+                self.page_size = None
+                self.num_pages = 0
+                self._pool = None
+                self._page_table = None
+                self._prefix = PrefixCache(
+                    self.prefix_pool_rows, row_base=self.num_slots + 1,
+                    min_tokens=self.prefix_min_tokens) \
+                    if self.prefix_pool_rows else None
         else:
             self.max_length = None
             self.num_slots = 0
@@ -403,6 +489,10 @@ class InferenceEngine:
             self.prefill_chunk = None
             self.prefix_min_tokens = int(prefix_min_tokens)
             self._prefix = None
+            self.page_size = None
+            self.num_pages = 0
+            self._pool = None
+            self._page_table = None
         self.prefix_fault_limit = int(prefix_fault_limit)
         # consecutive-fault streaks, PER SITE: a clean host lookup runs
         # right before every device copy, so a shared counter could
@@ -493,6 +583,20 @@ class InferenceEngine:
                   help="live prefix-cache radix-tree entries",
                   fn=bound(lambda e: len(e._prefix)
                            if e._prefix is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_kv_pages_total",
+                  help="paged-KV page pool capacity (0 = dense layout)",
+                  fn=bound(lambda e: e._pool.num_pages
+                           if e._pool is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_kv_pages_free",
+                  help="paged-KV pages on the free list",
+                  fn=bound(lambda e: e._pool.free_count
+                           if e._pool is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_kv_pages_shared",
+                  help="paged-KV pages with >= 2 readers (prefix "
+                       "sharing / park-by-reference — each would be a "
+                       "duplicated row under the dense layout)",
+                  fn=bound(lambda e: e._pool.shared_count
+                           if e._pool is not None else 0), **lbl)
         reg.gauge("mxtpu_serving_overload_factor",
                   help="brownout degradation factor (1.0 = normal; "
                        "lower = non-interactive token budgets capped "
@@ -535,26 +639,46 @@ class InferenceEngine:
                 axes = tuple(range(1, logits_jax.ndim))
                 return jnp.all(jnp.isfinite(logits_jax), axis=axes)
 
-            def chunk(toks, lens, caches, sidx, off):
-                logits, c = net.prefill_slots(NDArray(toks), lens, caches,
-                                              sidx, offset=off)
+            def post(logits, c):
+                # ONE guard/argmax post-processing body shared by every
+                # prefill/chunk/step closure in both layouts — greedy
+                # parity cannot diverge between them
                 ok = row_ok(logits.jax) if guard else \
                     jnp.ones((logits.jax.shape[0],), jnp.bool_)
                 return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
                         ok, c)
 
-            def prefill(toks, lens, caches, sidx):
-                # full prefill IS the offset=None case — one body, so the
-                # guard/argmax post-processing can never diverge between
-                # the two prefill programs (greedy parity depends on it)
-                return chunk(toks, lens, caches, sidx, None)
+            if self._paged:
+                # the paged programs take the page table as ONE extra
+                # traced argument
+                def chunk(toks, lens, caches, sidx, off, table):
+                    return post(*net.prefill_slots(
+                        NDArray(toks), lens, caches, sidx, offset=off,
+                        page_table=table))
 
-            def step(tok, caches, pos):
-                logits, c = net.decode_step(NDArray(tok), caches, pos)
-                ok = row_ok(logits.jax) if guard else \
-                    jnp.ones((logits.jax.shape[0],), jnp.bool_)
-                return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
-                        ok, c)
+                def prefill(toks, lens, caches, sidx, table):
+                    return chunk(toks, lens, caches, sidx, None, table)
+
+                def step(tok, caches, pos, table):
+                    return post(*net.decode_step(NDArray(tok), caches,
+                                                 pos, page_table=table))
+            else:
+                # dense closures call the PRE-PAGING decode surface —
+                # no page_table kwarg, so any net implementing the
+                # documented duck-typed contract (prefill_slots(tokens,
+                # lens, caches, slot_idx, offset=)/decode_step) keeps
+                # serving under the default layout
+                def chunk(toks, lens, caches, sidx, off):
+                    return post(*net.prefill_slots(
+                        NDArray(toks), lens, caches, sidx, offset=off))
+
+                def prefill(toks, lens, caches, sidx):
+                    # full prefill IS the offset=None case
+                    return chunk(toks, lens, caches, sidx, None)
+
+                def step(tok, caches, pos):
+                    return post(*net.decode_step(NDArray(tok), caches,
+                                                 pos))
 
             def copy_rows(caches, src, dst, length):
                 # masked row-to-row K/V copy for the prefix cache:
@@ -565,6 +689,9 @@ class InferenceEngine:
                 # mask is not optional hygiene: unmasked row garbage
                 # beyond `length` could carry NaN from a scrubbed
                 # neighbour epoch, and NaN survives additive masking.
+                # Under the PAGED layout axis 1 is the page dim, so the
+                # SAME program is the partial-tail-page copy (positions
+                # [0, length) of page `src` into page `dst`).
                 import jax as _jax
 
                 def cp(a):
@@ -1182,9 +1309,15 @@ class InferenceEngine:
                 self._ensure_caches()
                 s1 = self.num_slots + 1
                 zeros = jnp.zeros((s1,), jnp.int32)
+                # the paged programs take the page table as one extra
+                # traced arg — its SHAPE is fixed at construction, so
+                # the lattice (and the compile freeze) is untouched:
+                # one program per (bucket, page-table) point where the
+                # page-table side has exactly one point
+                tbl = (self._table_arg(),) if self._paged else ()
                 _, _ok, self._caches = self._counted(
                     ("decode",), self._jit_step, params, zeros,
-                    self._caches, zeros)
+                    self._caches, zeros, *tbl)
                 scratch = self._alloc.scratch
                 for bb, tb in self.lattice.prefill_points(
                         self.prefill_chunk):
@@ -1193,13 +1326,17 @@ class InferenceEngine:
                     sidx = jnp.full((bb,), scratch, jnp.int32)
                     _, _ok, self._caches = self._counted(
                         ("prefill", bb, tb), self._jit_prefill, params,
-                        toks, lens, self._caches, sidx)
+                        toks, lens, self._caches, sidx, *tbl)
                     off = jnp.zeros((bb,), jnp.int32)
                     _, _ok, self._caches = self._counted(
                         ("chunk", bb, tb), self._jit_chunk, params,
-                        toks, lens, self._caches, sidx, off)
+                        toks, lens, self._caches, sidx, off, *tbl)
                 if self._prefix is not None:
-                    scr = jnp.asarray(scratch, jnp.int32)
+                    # dense: row-to-row prefix copy; paged: the same
+                    # program IS the partial-tail-page copy (scratch
+                    # page onto itself, length 0 — a no-op trace)
+                    scr = jnp.asarray(self._pool.scratch if self._paged
+                                      else scratch, jnp.int32)
                     self._caches = self._counted(
                         ("prefix_copy",), self._jit_copy, self._caches,
                         scr, scr, jnp.asarray(0, jnp.int32))
@@ -1239,6 +1376,25 @@ class InferenceEngine:
             "default_priority": priority_name(self.default_priority),
             "preemption": self.preemption,
             "deadline_admission": self.deadline_admission,
+        }
+        # KV capacity accounting (docs/serving.md "Paged KV"): slot
+        # occupancy always; page-pool occupancy under the paged layout
+        c = self.metrics.counters
+        s["slots"] = {
+            "kv_layout": self.kv_layout,
+            "num_slots": self.num_slots,
+            "active": self._alloc.active_count if self._alloc else 0,
+            "active_highwater": self._alloc.active_highwater
+            if self._alloc else 0,
+            "page_size": self.page_size,
+            "pages_total": self._pool.num_pages
+            if self._pool is not None else 0,
+            "pages_free": self._pool.free_count
+            if self._pool is not None else 0,
+            "pages_shared": self._pool.shared_count
+            if self._pool is not None else 0,
+            "page_faults": c["page_faults"],
+            "pages_scrubbed": c["pages_scrubbed"],
         }
         # overlay the live controller state on the metrics' per-class
         # shed/served accounting (docs/overload.md)
@@ -1353,6 +1509,15 @@ class InferenceEngine:
             self._caches = None
             if self._prefix is not None:
                 self._prefix.reset()
+            if self._paged:
+                # every page's K/V died with the buffers: rebuild the
+                # pool accounting from zero (reset AFTER the tree
+                # forgot its claims — unref'ing into a reset pool
+                # would double-free) and point every table entry back
+                # at scratch
+                self._pool.reset()
+                self._page_table[:] = self._pool.scratch
+                self._table_dirty()
 
     def _complete(self, st: SlotState):
         req = st.request
@@ -1385,21 +1550,41 @@ class InferenceEngine:
     # ------------------------------------------------------------ decode path
     def _ensure_caches(self):  # guarded-by: _step_lock
         if self._caches is None:
-            # slots + scratch + prefix pool share one array per layer so
-            # row-to-row copies and slot reads stay in a single buffer
-            self._caches = self.net.init_slot_cache(
-                self.num_slots + 1 + self.prefix_pool_rows,
-                self.max_length)
+            if self._paged:
+                # pool + scratch page share one array per layer so
+                # page copies and gathers stay in a single buffer
+                self._caches = self.net.init_page_cache(
+                    self.num_pages + 1, self.page_size)
+            else:
+                # slots + scratch + prefix pool share one array per
+                # layer so row-to-row copies and slot reads stay in a
+                # single buffer
+                self._caches = self.net.init_slot_cache(
+                    self.num_slots + 1 + self.prefix_pool_rows,
+                    self.max_length)
 
-    def _release(self, slot: int) -> SlotState:
+    def _release(self, slot: int):  # guarded-by: _step_lock
         """End a slot lease, dropping any prefix-cache read pin the
-        (possibly unfinished) prefill still holds."""
+        (possibly unfinished) prefill still holds.  Paged layout: drop
+        the slot's claim on every page it mapped and reset its table
+        row to scratch; returns the page ids that actually FREED (last
+        reader gone) — the scrub-on-NaN path zeroes exactly those.
+        Pages still referenced (shared prefix pages, parked entries)
+        survive untouched."""
         st = self._alloc.free(slot)
         if st.pinned is not None:
             if self._prefix is not None:
                 self._prefix.unpin(st.pinned)
             st.pinned = None
-        return st
+        freed = []
+        if self._paged:
+            freed = [pid for pid in st.pages if self._pool.unref(pid)]
+            st.pages = []
+            st.pages_shared = 0
+            st.waiting = False
+            self._page_table[slot, :] = self._pool.scratch
+            self._table_dirty()
+        return freed
 
     def _decode_cycle(self):
         alloc = self._alloc
@@ -1427,7 +1612,13 @@ class InferenceEngine:
                 min(free, self.lattice.max_batch), wait_us, wait=False)
             self._admit(self._filter_expired(reqs))
         self._prefill_cycle()
-        if any(not st.prefilling for _s, st in alloc.items()):
+        if self._paged:
+            # the page covering each decoding slot's write position
+            # must exist before the step (page faults park victims by
+            # reference — see docs/serving.md "Paged KV")
+            self._grow_pages()
+        if any(not st.prefilling and not st.waiting
+               for _s, st in alloc.items()):
             self._decode_step()
 
     def _overload_tick(self, now: float):
@@ -1528,13 +1719,16 @@ class InferenceEngine:
     def _preempt(self, slot: int, st: SlotState):
         req = st.request
         seq = onp.concatenate([req.payload,
-                               onp.asarray(st.generated, "int32")])
-        # the slot's K/V rows are populated for [0, pos) — everything
-        # up to (not including) the last generated token, whose K/V the
-        # next decode step would have written
-        park = st.pos
+                               onp.asarray(st.generated, "int32")]) \
+            if st.generated else req.payload
+        # a DECODING victim's K/V rows are populated for [0, pos) —
+        # everything up to (not including) the last generated token,
+        # whose K/V the next decode step would have written; a
+        # PREFILLING victim (paged page-fault parking) has exactly its
+        # completed chunks [0, filled)
+        park = st.filled if st.prefilling else st.pos
         if self._prefix_usable() and park >= self.prefix_min_tokens:
-            self._pool_insert(seq[:park], slot, park)
+            self._pool_insert(seq[:park], slot, park, st)
         self._release(slot)
         cont = Request("decode", seq,
                        st.max_new_tokens - len(st.generated),
@@ -1584,8 +1778,9 @@ class InferenceEngine:
 
     def _prefix_admit(self, st: SlotState, slot: int):  # guarded-by: _step_lock
         """Lease-time prefix reuse: longest-prefix lookup, pin, and the
-        device row copy.  On success ``st.filled`` skips the matched
-        region; on any contained fault the request prefills in full."""
+        device row copy (dense) or page-table sharing (paged).  On
+        success ``st.filled`` skips the matched region; on any
+        contained fault the request prefills in full."""
         req = st.request
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
@@ -1609,6 +1804,9 @@ class InferenceEngine:
         match = min(match, st.prompt_len - 1)
         if match < self.prefix_min_tokens:
             self.metrics.count("prefix_misses")
+            return
+        if self._paged:
+            self._prefix_admit_paged(st, slot, entry, match)
             return
         self._prefix.pin(entry)
         t0 = time.monotonic() if tr is not None else 0.0
@@ -1643,6 +1841,74 @@ class InferenceEngine:
         self.metrics.count("prefix_hits")
         self.metrics.count("prefix_tokens_saved", match)
 
+    def _prefix_admit_paged(self, st, slot, entry, match):  # guarded-by: _step_lock
+        """Paged prefix hit (docs/serving.md "Paged KV"): every WHOLE
+        matched page is shared by reference — a page-table write plus a
+        refcount bump, no device work at all (this is where the dense
+        engine's compiled masked row copy disappears).  A partial tail
+        page still pays one compiled page copy into a fresh page (the
+        slot will write its own K/V behind the matched region inside
+        that page, and shared pages are read-only to sharers).  A fault
+        at ``serving.page_copy`` degrades to whole-page sharing only —
+        the suffix prefill just starts a little earlier."""
+        ps = self.page_size
+        match = min(match, entry.length)
+        n_full = match // ps
+        for i in range(n_full):
+            pid = entry.pages[i]
+            self._pool.ref(pid)
+            st.pages.append(pid)
+            self._page_table[slot, i] = pid
+            self._table_dirty()
+        st.pages_shared = n_full
+        filled = n_full * ps
+        rem = match - filled
+        if rem:
+            self._prefix.pin(entry)   # tail-copy source must survive
+            newp = self._claim_pages(1)
+            if newp is not None:
+                try:
+                    import jax.numpy as jnp
+                    self._ensure_caches()
+                    # riders=() as in the dense copy: an optional
+                    # optimization must never spend the request's own
+                    # retry budget
+                    self._caches = self._run_step(
+                        "serving.page_copy", ("prefix_copy",),
+                        self._jit_copy,
+                        (self._caches,
+                         jnp.asarray(entry.pages[n_full], jnp.int32),
+                         jnp.asarray(newp[0], jnp.int32),
+                         jnp.asarray(rem, jnp.int32)), ())
+                except Exception:
+                    self._pool.unref(newp[0])
+                    self._prefix.unpin(entry)
+                    self._prefix_fault("copy")
+                else:
+                    self._prefix_faults["copy"] = 0
+                    st.pages.append(newp[0])
+                    self._page_table[slot, n_full] = newp[0]
+                    self._table_dirty()
+                    filled += rem
+                    st.pinned = entry
+            else:
+                self.metrics.count("page_faults")
+                self._prefix.unpin(entry)
+        if filled < self.prefix_min_tokens:
+            # nothing usable shared (sub-page match whose copy failed):
+            # release the claims and treat as a plain miss
+            for pid in st.pages:
+                self._pool.unref(pid)
+            st.pages = []
+            st.pages_shared = 0
+            self._page_table[slot, :] = self._pool.scratch
+            self._table_dirty()
+            self.metrics.count("prefix_misses")
+            return
+        st.filled = filled
+        self.metrics.count("prefix_hits")
+        self.metrics.count("prefix_tokens_saved", filled)
+
     def _prefix_insert(self, st: SlotState, slot: int):
         """After a request's prefill completes, cache its full prompt:
         reserve a pool row (LRU-evicting zero-reader entries under
@@ -1659,24 +1925,38 @@ class InferenceEngine:
         if self._overload.pause_inserts:
             self.metrics.count("prefix_inserts_paused")
             return
-        self._pool_insert(st.tokens, slot, st.prompt_len)
+        self._pool_insert(st.tokens, slot, st.prompt_len, st)
 
-    def _pool_insert(self, tokens, slot: int, length: int):  # guarded-by: _step_lock
-        """Shared slot→pool insert body: radix-tree insert + the
-        compiled row copy of K/V ``[0, length)`` from ``slot`` into
-        the reserved pool row, with the usual per-site fault
-        containment."""
+    def _pool_insert(self, tokens, slot, length, st=None):  # guarded-by: _step_lock
+        """Shared slot→pool insert body.  Dense: radix-tree insert +
+        the compiled row copy of K/V ``[0, length)`` from ``slot``
+        into the reserved pool row.  Paged (``st`` given): the entry
+        simply takes refcounts on the slot's pages covering
+        ``[0, length)`` — park/insert by REFERENCE, zero device work
+        (a partial tail page is shared too: the donor only ever writes
+        positions ``>= length`` inside it, which no reader reads).
+        Usual per-site fault containment either way."""
         try:
             _inject("serving.prefix_lookup")
-            ev0 = self._prefix.evictions
-            entry = self._prefix.insert(tokens)
-            self.metrics.count("prefix_evictions",
-                               self._prefix.evictions - ev0)
+            if self._paged:
+                npages = self._pool.pages_for(length)
+                if npages > len(st.pages):
+                    return           # cannot promise K/V it doesn't hold
+                entry = self._prefix.insert(tokens, st.pages[:npages],
+                                            length)
+            else:
+                ev0 = self._prefix.evictions
+                entry = self._prefix.insert(tokens)
+                self.metrics.count("prefix_evictions",
+                                   self._prefix.evictions - ev0)
         except Exception:           # incl. RetryableFault, as in lookup
             self._prefix_fault("lookup")
             return
         self._prefix_faults["lookup"] = 0
         if entry is None:
+            return
+        if self._paged:
+            self.metrics.count("prefix_inserts")
             return
         try:
             import jax.numpy as jnp
@@ -1692,6 +1972,178 @@ class InferenceEngine:
         self._prefix_faults["copy"] = 0
         self.metrics.count("prefix_inserts")
 
+    # ---------------------------------------------------------- paged pages
+    def _table_arg(self):  # guarded-by: _step_lock
+        """Device copy of the page table, re-uploaded only after a
+        mutation: steady-state decode (no admissions, no page growth)
+        reuses one cached array across thousands of steps instead of
+        paying a host-to-device transfer per dispatch.  Every writer of
+        ``_page_table`` must call :meth:`_table_dirty`."""
+        if self._table_dev is None:
+            import jax.numpy as jnp
+            self._table_dev = jnp.asarray(self._page_table)
+        return self._table_dev
+
+    def _table_dirty(self):  # guarded-by: _step_lock
+        self._table_dev = None
+
+    def _evict_hook(self):  # guarded-by: _step_lock
+        """Allocation-pressure reclaim hook for :meth:`PagePool.alloc`:
+        evict zero-reader prefix entries (LRU) until the shortfall is
+        covered, counting the evictions like the dense LRU path."""
+        if not self._prefix_usable():
+            return None
+        cache, metrics = self._prefix, self.metrics
+
+        def reclaim(k):
+            ev0 = cache.evictions
+            freed = cache.evict_pages(k)
+            metrics.count("prefix_evictions", cache.evictions - ev0)
+            return freed
+        return reclaim
+
+    def _claim_pages(self, n: int):  # guarded-by: _step_lock
+        """Allocate ``n`` pages (with the eviction reclaim hook),
+        scrubbing any that a non-finite victim dirtied while another
+        reader kept them alive past its release — stale NaN must never
+        reach the new tenant (0·NaN = NaN through the value einsum
+        survives the select mask)."""
+        pages = self._pool.alloc(n, self._evict_hook())
+        if pages and self._pool.dirty:
+            tainted = [p for p in pages if p in self._pool.dirty]
+            if tainted:
+                self._scrub_pages(tainted)
+                self._pool.dirty.difference_update(tainted)
+        return pages
+
+    def _pages_available(self) -> int:  # guarded-by: _step_lock
+        """Pages an admission could obtain RIGHT NOW: the free list
+        plus what evicting every zero-reader prefix entry would free —
+        cached prefixes never block live work."""
+        avail = self._pool.free_count
+        if self._prefix_usable():
+            avail += self._prefix.evictable_pages()
+        return avail
+
+    def _page_need(self, req: Request) -> int:  # guarded-by: _step_lock
+        """Pages an admission must be able to cover: the prompt plus
+        the first decode page.  Conservative — a prefix hit will claim
+        fewer."""
+        return self._pool.pages_for(min(req.prompt_len + 1,
+                                        self.max_length))
+
+    def _page_admissible(self, need, budget) -> bool:  # guarded-by: _step_lock
+        """Paged admission gate (docs/serving.md "Paged KV"): admit
+        only while the batch's running page BUDGET covers the request
+        (``_admit`` computes the pool's availability ONCE and deducts
+        per admission — without the reservation, every request in one
+        batch would pass against the same free pages and the
+        just-admitted slots would immediately thrash each other out by
+        mutual page-fault preemption).  A blocked request WAITS queued
+        (alloc retry next cycle) instead of failing: admission blocks
+        on page availability, not slot count.  A fault at
+        ``serving.page_alloc`` degrades the same way."""
+        try:
+            _inject("serving.page_alloc", scope=self.name)
+        except Exception:
+            self.metrics.count("page_faults")
+            return False
+        if budget >= need:
+            return True
+        self.metrics.count("page_faults")
+        return False
+
+    def _ensure_pages(self, slot, st, upto) -> str:  # guarded-by: _step_lock
+        """Grow ``slot``'s page table to cover positions ``[0, upto)``.
+        Returns ``"ok"`` (covered), ``"retry"`` (transient — injected
+        alloc fault or pool pressure relieved by parking a victim is
+        still in flight; the slot sits out THIS cycle and retries), or
+        ``"full"`` (pool exhausted and no other victim exists — the
+        caller parks this slot itself).  A page fault (pool dry) first
+        evicts zero-reader prefix entries, then parks the youngest
+        lowest-class OTHER slot by reference — its pages become an
+        evictable entry, so the retry inside the loop reclaims them."""
+        need = self._pool.pages_for(upto) - len(st.pages)
+        if need <= 0:
+            st.waiting = False
+            return "ok"
+        try:
+            _inject("serving.page_alloc", scope=self.name)
+        except Exception:
+            # contained: degrade to an alloc retry next cycle — the
+            # slot keeps its lease and its progress, it just waits
+            self.metrics.count("page_faults")
+            st.waiting = True
+            return "retry"
+        pages = self._claim_pages(need)
+        while pages is None:
+            self.metrics.count("page_faults")
+            self.metrics.mark("page_fault")
+            victim = self._page_victim(slot, st.request.priority)
+            if victim is None:
+                st.waiting = True
+                return "full"
+            self._preempt(*victim)
+            pages = self._claim_pages(need)
+        base = len(st.pages)
+        st.pages.extend(pages)
+        self._page_table[slot, base:base + need] = pages
+        self._table_dirty()
+        st.waiting = False
+        return "ok"
+
+    def _page_victim(self, exclude, floor):  # guarded-by: _step_lock
+        """Pick the slot whose parking relieves page pressure at the
+        least cost: lowest priority class first, youngest admission
+        within a class — never ``exclude`` (the slot being grown; the
+        OLDEST work keeps running, which guarantees forward progress:
+        admission capped every request at ``num_pages``, so the last
+        runner standing always fits alone) and never a class ABOVE the
+        grower's (``floor``): a best_effort page fault must not park an
+        interactive request — same semantics as overload preemption,
+        which only ever victims downward.  With no eligible victim the
+        grower parks ITSELF."""
+        cands = [(slot, st) for slot, st in self._alloc.items()
+                 if slot != exclude and st.pages
+                 and st.request.priority >= floor]
+        if not cands:
+            return None
+        cands.sort(key=lambda it: (it[1].request.priority,
+                                   it[1].request.t_schedule))
+        return cands[-1]
+
+    def _grow_pages(self):  # guarded-by: _step_lock
+        """Decode-time page growth, oldest slot first: before the step
+        writes K/V at ``st.pos``, the page covering it must exist.  A
+        slot that cannot get one even after victim parking parks
+        ITSELF by reference (progress becomes an evictable prefix
+        entry; the continuation resumes by prefix hit when pages
+        free)."""
+        decoding = [(slot, st) for slot, st in self._alloc.items()
+                    if not st.prefilling]
+        decoding.sort(key=lambda it: it[1].request.t_schedule)
+        for slot, st in decoding:
+            if slot not in self._alloc:
+                continue               # parked as a victim already
+            if self._ensure_pages(slot, st, st.pos + 1) == "full":
+                self._preempt(slot, st)
+
+    def _scrub_pages(self, freed):  # guarded-by: _step_lock
+        """Zero freed pages after a non-finite failure: NaN K/V written
+        by the victim survives ADDITIVE masking (flash-kernel style),
+        so a later tenant of the page must never see it.  Pages still
+        referenced are untouched — a shared prefix page was written
+        only by clean prefill, and its readers' copies must not be
+        zeroed under them."""
+        if not freed or self._caches is None:
+            return
+        import jax
+        import jax.numpy as jnp
+        pids = jnp.asarray(freed, jnp.int32)
+        self._caches = jax.tree_util.tree_map(
+            lambda a: a.at[pids].set(0), self._caches)
+        self.metrics.count("pages_scrubbed", len(freed))
+
     # ------------------------------------------------------------ admission
     def _admit(self, live):
         """Lease a slot per request; prefix-cache hits copy their
@@ -1700,11 +2152,30 @@ class InferenceEngine:
         now = time.monotonic()
         tr = _trace_active()
         n_prompt = 0
-        for req in live:
+        admitted = 0
+        # one availability snapshot per batch, deducted per admission:
+        # the batch must not over-admit against the same free pages
+        budget = self._pages_available() if self._paged else 0
+        for i, req in enumerate(live):
+            need = self._page_need(req) if self._paged else 0
+            if self._paged and not self._page_admissible(need, budget):
+                # admission blocks on PAGE availability, not slot
+                # count: park this and everything behind it back at
+                # the FRONT of their classes (reversed, so the
+                # original order survives the appendleft) and retry
+                # next cycle once decode frees pages
+                for r in reversed(live[i:]):
+                    try:
+                        self._batcher.requeue(r)
+                    except EngineStoppedError as e:
+                        self._fail(r, e)
+                break
+            budget -= need
             st = SlotState(req, req.prompt_len, req.max_new_tokens,
                            tokens=req.payload)
             slot = alloc.alloc(st)
             req.t_schedule = now
+            admitted += 1
             if req.preempted:
                 # a preemption victim re-admitted: its parked prefix
                 # should hit in _prefix_admit below (resume ≈ one row
@@ -1716,10 +2187,10 @@ class InferenceEngine:
             n_prompt += req.prompt_len
             if self._prefix_usable() and req.prompt_len > 1:
                 self._prefix_admit(st, slot)
-        if live:
-            self.metrics.count("admitted", len(live))
+        if admitted:
+            self.metrics.count("admitted", admitted)
             self.metrics.count("prompt_tokens", n_prompt)
-            self.metrics.mark("admit", len(live))
+            self.metrics.mark("admit", admitted)
 
     # -------------------------------------------------------------- prefill
     def _prefill_cycle(self):
@@ -1728,10 +2199,27 @@ class InferenceEngine:
         batch (suffixes behind a prefix hit, and long prompts) so a
         giant prompt never starves the decode step more than one
         chunk's worth per cycle."""
-        full, chunked = {}, []
+        ready = []
         for slot, st in self._alloc.items():
-            if not st.prefilling:
-                continue
+            if slot not in self._alloc or not st.prefilling:
+                continue               # a victim parked by an earlier
+            if self._paged:            # _ensure_pages in this loop
+                # the pages a chunk will write must exist BEFORE the
+                # compiled call; a slot that cannot get them parks
+                # (by reference — resumes by prefix hit) or sits this
+                # cycle out (transient alloc fault)
+                take = min(st.prompt_len - st.filled, self.prefill_chunk)
+                got = self._ensure_pages(slot, st, st.filled + take)
+                if got == "full":
+                    self._preempt(slot, st)
+                    continue
+                if got == "retry":
+                    continue
+            ready.append((slot, st))
+        full, chunked = {}, []
+        for slot, st in ready:
+            if slot not in self._alloc:
+                continue               # parked as a later slot's victim
             if st.filled == 0 and st.prompt_len <= self.prefill_chunk:
                 full.setdefault(self.lattice.seq(st.prompt_len),
                                 []).append((slot, st))
@@ -1763,12 +2251,13 @@ class InferenceEngine:
         self.metrics.count("padded_tokens", bb * tb - n_real)
         self.metrics.count("prefill_batches")
         self._ensure_caches()
+        tbl = (self._table_arg(),) if self._paged else ()
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
-             self._caches, jnp.asarray(sidx)),
+             self._caches, jnp.asarray(sidx)) + tbl,
             [st.request for _s, st in rows])
         if tr is not None:
             # ONE span for the batched device call, carrying every
@@ -1814,12 +2303,13 @@ class InferenceEngine:
         self.metrics.count("padded_tokens", bb * tb - sum(take))
         self.metrics.count("prefill_chunks")
         self._ensure_caches()
+        tbl = (self._table_arg(),) if self._paged else ()
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("chunk", bb, tb), self._jit_chunk,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
-             self._caches, jnp.asarray(sidx), jnp.asarray(off)),
+             self._caches, jnp.asarray(sidx), jnp.asarray(off)) + tbl,
             [st.request for _s, st in rows])
         if tr is not None:
             tr.record_span(
@@ -1867,9 +2357,23 @@ class InferenceEngine:
         garbage a normal free leaves (which the causal mask renders
         harmless), NaN survives additive masking — ``-inf + NaN`` is
         NaN — so a later tenant of the row would be poisoned through
-        positions it never wrote."""
-        self._release(slot)
-        if self._caches is not None:
+        positions it never wrote.
+
+        Paged layout: the victim's release frees its pages (last-reader
+        drop) and exactly those are scrubbed — shared prefix pages it
+        was merely READING hold only clean prefill K/V and stay.  A
+        page the victim WROTE that another reader still pins (an entry
+        parked over its tail page) cannot be scrubbed now: it is marked
+        dirty and scrubbed at its next claim, whichever path frees it."""
+        written = list(st.pages[st.pages_shared:]) if self._paged else ()
+        freed = self._release(slot)
+        if self._paged:
+            self._scrub_pages(freed)
+            # only pages the victim could have WRITTEN (everything past
+            # the shared-in whole prefix pages, which are read-only to
+            # it) can carry its NaN; taint the still-referenced ones
+            self._pool.mark_dirty(set(written) - set(freed))
+        elif self._caches is not None:
             import jax
             self._caches = jax.tree_util.tree_map(
                 lambda a: a.at[slot].set(0), self._caches)
@@ -1899,18 +2403,19 @@ class InferenceEngine:
         pos = onp.full((s1,), self.max_length, "int32")
         riders = []
         for slot, st in alloc.items():
-            if st.prefilling:
-                continue
+            if st.prefilling or st.waiting:
+                continue             # waiting = page allocation deferred
             tok[slot] = st.last_token
             pos[slot] = st.pos
             riders.append(st.request)
         self.metrics.count("decode_steps")
+        tbl = (self._table_arg(),) if self._paged else ()
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
         nxt, ok, self._caches = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
-             jnp.asarray(pos)), riders)
+             jnp.asarray(pos)) + tbl, riders)
         if tr is not None:
             tr.record_span(
                 "serving.decode_step", t0, time.monotonic(),
@@ -1920,7 +2425,7 @@ class InferenceEngine:
         nxt = onp.asarray(nxt)
         ok = onp.asarray(ok)
         for slot, st in alloc.items():
-            if st.prefilling:
+            if st.prefilling or st.waiting:
                 continue
             if self.guard_nonfinite and not ok[slot]:
                 self._fail_nonfinite(slot, st, "decode")

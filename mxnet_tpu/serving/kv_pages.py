@@ -1,0 +1,274 @@
+"""Paged KV memory: a process-wide pool of fixed-size KV pages plus the
+page-granular prefix cache (docs/serving.md "Paged KV").
+
+The dense layout (kv_slots.py) reserves a full ``(Tmax, H, D)`` row per
+slot, so a 30-token request strands the same HBM as a 1024-token one and
+max concurrency is capped by the worst case.  Here the per-layer cache
+is instead ``(num_pages + 1, page_size, H, D)`` — a pool of fixed-size
+PAGES, the last one being SCRATCH — and each slot holds a PAGE TABLE
+mapping its logical pages ``[0, Tmax/page_size)`` to physical page ids.
+A request only ever claims pages covering the positions it has actually
+written, so concurrency is bounded by live tokens, not by ``Tmax``
+(the PagedAttention design, vLLM).  The compiled programs stay
+fixed-shape: the table is a ``(S+1, Tmax/page_size)`` int32 array passed
+as a traced argument, writes scatter through it, attention gathers a
+slot's pages back into a contiguous ``(Tmax, H, D)`` row — one XLA
+program per bucket, exactly like the dense engine.
+
+:class:`PagePool` is the allocator: free-list + per-page REFCOUNTS.
+Refcounts make prefix sharing first-class: a whole-page prefix hit is a
+page-table write plus a refcount bump (the dense engine's compiled
+masked row copy disappears), and preemption parks a victim's pages by
+reference instead of copying slot→pool.  A page returns to the free
+list only when its last reader drops it.
+
+:class:`PagedPrefixCache` reuses the radix tree of
+:mod:`.prefix_cache` but maps prefixes to page LISTS claimed from the
+shared pool rather than to reserved rows — entries reserve nothing
+until pressure arrives, and LRU eviction (zero-reader entries only)
+is the pool's reclaim path when allocation runs dry.
+
+Like :class:`~.kv_slots.SlotAllocator`, both objects are
+scheduler-thread-only (no locks) — the engine serializes all access;
+the registry gauges that read occupancy cross-thread tolerate a stale
+integer for one scrape.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from .errors import ServingError
+from .prefix_cache import PrefixCache, PrefixEntry, _Node
+
+__all__ = ["PagePool", "PagedPrefixCache", "PagedPrefixEntry"]
+
+
+class PagePool:
+    """Free-list + refcount allocator over ``num_pages`` physical KV
+    pages of ``page_size`` positions each.  Page id ``num_pages``
+    (``scratch``) is the ZERO page: never allocated and NEVER WRITTEN —
+    unassigned page-table entries point at it, so every live slot
+    READS it through its unclaimed logical pages, and the attention
+    math depends on those lanes holding finite values (0·NaN = NaN
+    survives the select mask through the value einsum).  Writes with
+    no real target are routed out of bounds and dropped instead.  The
+    device cache carries ``num_pages + 1`` pages per layer."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1:
+            raise ServingError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ServingError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.scratch = self.num_pages
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._refs: List[int] = [0] * self.num_pages
+        # pages a non-finite victim WROTE but could not scrub at
+        # release time because another reader (a prefix entry parked
+        # over the victim's tail page) still held them: the engine
+        # scrubs these lazily when they are next CLAIMED, so stale NaN
+        # can never reach a later tenant no matter which path (entry
+        # eviction, remove) finally freed the page
+        self.dirty: set = set()
+
+    # ------------------------------------------------------------- queries
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        """Pages with >= 2 readers — the prefix-sharing win made
+        visible (each would be a duplicated row in the dense layout)."""
+        return sum(1 for r in self._refs if r >= 2)
+
+    def refs(self, pid: int) -> int:
+        return self._refs[pid]
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` positions."""
+        return (int(n_tokens) + self.page_size - 1) // self.page_size
+
+    # ---------------------------------------------------------- allocation
+    def alloc(self, n: int,
+              reclaim: Optional[Callable[[int], int]] = None
+              ) -> Optional[List[int]]:
+        """Claim ``n`` pages (refcount 1 each), or ``None`` if the pool
+        cannot cover them.  ``reclaim(k)`` is the eviction hook (the
+        paged prefix cache's zero-reader LRU sweep): called once with
+        the shortfall before giving up — allocation pressure is what
+        turns cached prefixes back into capacity."""
+        if len(self._free) < n and reclaim is not None:
+            reclaim(n - len(self._free))
+        if len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for pid in out:
+            self._refs[pid] = 1
+        return out
+
+    def ref(self, pid: int) -> int:
+        """Add a reader (prefix sharing / park-by-reference)."""
+        if not 0 <= pid < self.num_pages:
+            raise ServingError(f"ref of non-pool page {pid}")
+        if self._refs[pid] <= 0:
+            raise ServingError(f"ref of free page {pid}")
+        self._refs[pid] += 1
+        return self._refs[pid]
+
+    def unref(self, pid: int) -> bool:
+        """Drop a reader; returns True iff this freed the page (last
+        reader gone — only then may the caller scrub/reuse it)."""
+        if not 0 <= pid < self.num_pages or self._refs[pid] <= 0:
+            raise ServingError(f"unref of unreferenced page {pid}")
+        self._refs[pid] -= 1
+        if self._refs[pid] == 0:
+            self._free.append(pid)
+            return True
+        return False
+
+    def mark_dirty(self, pids):
+        """Record pages that hold non-finite K/V but are still
+        referenced (the scrub-on-NaN path could not zero them); the
+        engine scrubs them at their next claim."""
+        self.dirty.update(int(p) for p in pids)
+
+    def reset(self):
+        """Forget everything — paired with the engine dropping the
+        device cache buffers (every page's K/V died with them)."""
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._refs = [0] * self.num_pages
+        self.dirty = set()
+
+    def __repr__(self):
+        return (f"PagePool(pages={self.num_pages}, "
+                f"page_size={self.page_size}, free={len(self._free)}, "
+                f"shared={self.shared_count})")
+
+
+class PagedPrefixEntry(PrefixEntry):
+    """One cached prefix mapped to PAGES: ``pages[i]`` holds positions
+    ``[i*page_size, (i+1)*page_size)``; the last page may be partially
+    valid (``length`` positions total).  ``row`` is kept at -1 — the
+    dense engine's row-copy path never sees these entries."""
+
+    __slots__ = ("pages",)
+
+    def __init__(self, pages: Tuple[int, ...], length: int, node: _Node):
+        super().__init__(-1, length, node)
+        self.pages = tuple(pages)
+
+    def __repr__(self):
+        return (f"PagedPrefixEntry(pages={list(self.pages)}, "
+                f"len={self.length}, refs={self.refs})")
+
+
+class PagedPrefixCache(PrefixCache):
+    """Radix tree over prompt prefixes mapping to shared PAGES.
+
+    Unlike the dense :class:`~.prefix_cache.PrefixCache`, no rows are
+    reserved: an entry's pages are extra refcounts on pages some slot
+    already filled, so insertion costs no copy and no memory beyond the
+    host tree.  Eviction (zero-reader entries, LRU order) is driven by
+    :meth:`PagePool.alloc` pressure via :meth:`evict_pages` rather than
+    by insert — a cached prefix survives exactly until a live request
+    needs its pages more.  ``pin``/``unpin`` (inherited) still guard a
+    hitting slot's tail-copy source while its prefill is in flight."""
+
+    def __init__(self, pool: PagePool, min_tokens: int = 1):
+        # no reserved-row segment to carve up: init only the shared
+        # radix-tree/LRU state (super().__init__ requires rows)
+        self.pool = pool
+        self.pool_rows = 0
+        self.row_base = -1
+        self._free: List[int] = []
+        self._init_tree(min_tokens)
+
+    @property
+    def free_rows(self) -> int:          # rows are not a paged concept
+        return 0
+
+    def evictable_pages(self) -> int:
+        """Pages an eviction CASCADE could free right now: pages whose
+        every remaining reader is a zero-reader entry (a page shared by
+        TWO evictable entries frees once both are evicted, so it must
+        count — an undercount would park an admissible request forever
+        on an idle engine).  The admission gate counts these as
+        available — a cached prefix never blocks live work."""
+        claims: dict = {}
+        for e in self._entries:
+            if e.refs == 0:
+                for pid in e.pages:
+                    claims[pid] = claims.get(pid, 0) + 1
+        return sum(1 for pid, n in claims.items()
+                   if self.pool.refs(pid) == n)
+
+    # -------------------------------------------------------------- insert
+    def insert(self, tokens, pages=None, length: Optional[int] = None):
+        """Register ``tokens`` as a cached prefix backed by ``pages``
+        (the donor slot's page list covering ``[0, len(tokens))``).
+        The cache takes its OWN refcount on every page — the donor's
+        release later drops only the donor's claim.  Returns the new
+        entry, or ``None`` when the exact sequence is already cached
+        (touched instead) or too short.  Zero device work: THIS is the
+        copy the dense layout paid per insert."""
+        if pages is None:
+            raise ServingError("PagedPrefixCache.insert needs the donor's "
+                               "page list")
+        if len(tokens) < self.min_tokens:
+            return None
+        node = self._insert_node(tokens)
+        if node.entry is not None:
+            self._touch(node.entry)
+            return None
+        entry = PagedPrefixEntry(
+            pages, len(tokens) if length is None else int(length), node)
+        for pid in entry.pages:
+            self.pool.ref(pid)
+        node.entry = entry
+        self._entries.append(entry)
+        self._touch(entry)
+        return entry
+
+    # ------------------------------------------------------------ eviction
+    def evict_pages(self, k: int) -> int:
+        """Free >= ``k`` pages by evicting zero-reader entries in LRU
+        order; returns the number actually freed (an entry whose pages
+        are still shared with live slots frees fewer than it holds —
+        SHARED PAGES ARE NEVER FREED WHILE REFERENCED, only the
+        entry's own claim drops)."""
+        freed = 0
+        while freed < k:
+            victim = self._lru_victim()
+            if victim is None:
+                break
+            for pid in victim.pages:
+                if self.pool.unref(pid):
+                    freed += 1
+            self._detach(victim)
+            self.evictions += 1
+        return freed
+
+    def remove(self, entry):
+        """Drop an entry, releasing its page claims (the engine's
+        failed-insert path)."""
+        for pid in entry.pages:
+            self.pool.unref(pid)
+        self._detach(entry)
+
+    def reset(self):
+        """Forget every mapping WITHOUT touching pool refcounts: the
+        engine only calls this alongside :meth:`PagePool.reset` (the
+        device buffers are gone, so per-page accounting is rebuilt from
+        zero — unref'ing into a reset pool would double-free)."""
+        self._root = _Node((), None)
+        self._entries = []
+
+    def __repr__(self):
+        return (f"PagedPrefixCache(entries={len(self._entries)}, "
+                f"evictions={self.evictions})")

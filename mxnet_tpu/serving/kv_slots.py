@@ -39,7 +39,8 @@ class SlotState:
     reassign a row a retried copy might still read."""
 
     __slots__ = ("request", "prompt_len", "pos", "last_token", "generated",
-                 "max_new_tokens", "tokens", "filled", "pinned", "t_first")
+                 "max_new_tokens", "tokens", "filled", "pinned", "t_first",
+                 "pages", "pages_shared", "waiting")
 
     def __init__(self, request, prompt_len: int, max_new_tokens: int,
                  tokens=None):
@@ -56,6 +57,17 @@ class SlotState:
         self.filled = 0               # populated K/V positions [0, filled)
         self.pinned = None            # PrefixEntry read-pinned while prefilling
         self.t_first: Optional[float] = None   # first-token wall time (TTFT)
+        # paged KV layout only (docs/serving.md "Paged KV"): the slot's
+        # claimed physical pages in logical order (page i covers
+        # positions [i*page_size, (i+1)*page_size)); the first
+        # ``pages_shared`` of them were shared-in whole from a prefix
+        # entry and are READ-ONLY to this slot (scrub-on-NaN must know
+        # which pages the slot could have written); and a transient
+        # flag set when a page allocation was deferred this cycle (the
+        # slot sits out prefill/decode until pages arrive)
+        self.pages: List[int] = []
+        self.pages_shared = 0
+        self.waiting = False
 
     @property
     def done(self) -> bool:
@@ -83,6 +95,9 @@ class SlotAllocator:
         self.scratch = num_slots           # row S of the (S+1, ...) cache
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
         self._active: Dict[int, SlotState] = {}
+        # deepest concurrency ever reached — the paged-vs-dense bench's
+        # headline (max sustainable concurrency at fixed KV memory)
+        self.active_highwater = 0
 
     @property
     def free_count(self) -> int:
@@ -100,6 +115,8 @@ class SlotAllocator:
                                "must admit <= free_count)")
         slot = self._free.pop()
         self._active[slot] = state
+        if len(self._active) > self.active_highwater:
+            self.active_highwater = len(self._active)
         return slot
 
     def free(self, slot: int) -> SlotState:

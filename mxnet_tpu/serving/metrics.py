@@ -152,7 +152,14 @@ class ServingMetrics:
                  # preempted segments count toward tokens_generated
                  # throughput but their wall time never reaches the
                  # histogram, so they must not dilute per-token cost)
-                 "decode_tokens_observed")
+                 "decode_tokens_observed",
+                 # paged KV layout (docs/serving.md "Paged KV"):
+                 # page-pool exhaustion / contained page_alloc-fault
+                 # events (each degrades to an alloc retry or a
+                 # park-by-reference, never a failed request) and pages
+                 # zeroed by scrub-on-NaN when their last reader freed
+                 # them
+                 "page_faults", "pages_scrubbed")
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name

@@ -81,10 +81,15 @@ class PrefixCache:
             raise ServingError(f"pool_rows must be >= 1, got {pool_rows}")
         self.pool_rows = int(pool_rows)
         self.row_base = int(row_base)
-        self.min_tokens = max(1, int(min_tokens))
-        self.evictions = 0      # lifetime counter (engine snapshots deltas)
+        self._init_tree(min_tokens)
         self._free: List[int] = list(
             range(self.row_base + self.pool_rows - 1, self.row_base - 1, -1))
+
+    def _init_tree(self, min_tokens: int):
+        """The radix-tree + LRU state shared with the row-less
+        :class:`~.kv_pages.PagedPrefixCache` — one place to grow it."""
+        self.min_tokens = max(1, int(min_tokens))
+        self.evictions = 0      # lifetime counter (engine snapshots deltas)
         self._root = _Node((), None)
         self._entries: List[PrefixEntry] = []
         self._tick = 0
@@ -211,14 +216,21 @@ class PrefixCache:
             node, i = mid, i + m
         return node
 
-    def _alloc_row(self) -> Optional[int]:
-        if self._free:
-            return self._free.pop()
+    def _lru_victim(self) -> Optional[PrefixEntry]:
+        """Least-recently-used ZERO-reader entry (pinned entries are
+        never victims), or ``None`` — the one eviction policy shared
+        by the dense row allocator and the paged reclaim sweep."""
         victim = None
         for e in self._entries:
             if e.refs == 0 and (victim is None
                                 or e.last_used < victim.last_used):
                 victim = e
+        return victim
+
+    def _alloc_row(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = self._lru_victim()
         if victim is None:          # every entry pinned by a reader
             return None
         row = victim.row

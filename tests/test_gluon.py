@@ -28,6 +28,26 @@ def test_dense_shapes_and_deferred_init():
     assert y2.shape == (2, 5, 8)
 
 
+def test_set_data_preserves_payload_placement():
+    """A set_data replacement must inherit the old payload's jax
+    placement (committed-ness): jax's jit cache keys on it, so a
+    committed replacement for an uncommitted initialize() payload
+    silently re-specializes every executable that traced over the
+    param — one hidden recompile per program on its next dispatch,
+    stalling a serving engine on traffic after warmup() with its
+    compile counter unmoved."""
+    d = nn.Dense(4, in_units=3)
+    d.initialize()
+    old = d.weight.data().jax
+    assert getattr(old, "_committed", False) is False
+    # nd.array routes host data through device_put -> committed
+    d.weight.set_data(nd.array(onp.ones((4, 3), "float32")))
+    new = d.weight.data().jax
+    assert getattr(new, "_committed", False) is False
+    assert_almost_equal(d.weight.data().asnumpy(),
+                        onp.ones((4, 3), "float32"))
+
+
 def test_explicit_in_units_no_deferred():
     net = nn.Dense(4, in_units=3)
     net.initialize()

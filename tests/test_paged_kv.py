@@ -1,0 +1,425 @@
+"""Paged KV memory (docs/serving.md "Paged KV").
+
+Contracts under test: the paged engine's greedy decode is
+TOKEN-IDENTICAL to both per-request ``net.generate`` and the dense
+engine — across buckets, through chunked prefill, under prefix sharing,
+and under page-pool thrash; page refcounts never free a shared page
+while referenced; park/resume round-trips preserve tokens; the compile
+counter freezes after ``warmup()`` at every (bucket, page-table) point;
+faults at ``serving.page_alloc``/``serving.page_copy`` degrade
+(alloc retry / whole-page-only sharing) without failing a request;
+scrub-on-NaN zeroes exactly the freed pages.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import (InferenceEngine, NonFiniteOutputError,
+                               PagedPrefixCache, PagePool, ServingError)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 97, (l,)).astype("int32") for l in lens]
+
+
+def _refs(net, prompts, max_new):
+    return [net.generate(mx.nd.array(p[None], dtype="int32"), max_new,
+                         temperature=0).asnumpy()[0] for p in prompts]
+
+
+def _paged(net, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    return InferenceEngine(net, **kw)
+
+
+# ----------------------------------------------------------- pool unit tests
+
+def test_page_pool_alloc_refcount_free():
+    pool = PagePool(4, page_size=8)
+    assert pool.free_count == 4 and pool.pages_for(17) == 3
+    a = pool.alloc(2)
+    assert len(a) == 2 and pool.free_count == 2
+    # sharing: second reader keeps the page alive through the first free
+    pool.ref(a[0])
+    assert pool.shared_count == 1
+    assert pool.unref(a[0]) is False          # reader left, page LIVE
+    assert pool.free_count == 2
+    assert pool.unref(a[0]) is True           # last reader frees
+    assert pool.free_count == 3
+    assert pool.alloc(4) is None              # over-ask fails whole
+    assert pool.free_count == 3               # ... and leaks nothing
+    with pytest.raises(ServingError):
+        pool.unref(a[0])                      # double free is a bug
+    with pytest.raises(ServingError):
+        pool.ref(a[0])                        # resurrect-by-ref too
+
+
+def test_page_pool_reclaim_hook_runs_on_pressure():
+    pool = PagePool(2, page_size=4)
+    held = pool.alloc(2)
+    calls = []
+
+    def reclaim(k):
+        calls.append(k)
+        for pid in held:
+            pool.unref(pid)
+        held.clear()
+    got = pool.alloc(1, reclaim)
+    assert calls == [1] and got is not None
+
+
+def test_paged_prefix_cache_shared_pages_survive_eviction():
+    """Eviction frees an entry's CLAIM, never a page another reader
+    still maps: the slot-side refcount keeps it out of the free list."""
+    pool = PagePool(4, page_size=4)
+    cache = PagedPrefixCache(pool, min_tokens=2)
+    pages = pool.alloc(2)
+    entry = cache.insert(list(range(8)), pages, 8)
+    assert entry is not None and pool.refs(pages[0]) == 2
+    # a "slot" drops its claim on page 1 only: page 1 now entry-only
+    pool.unref(pages[1])
+    freed = cache.evict_pages(2)
+    # page 0 still held by the donor -> only page 1 actually freed
+    assert freed == 1 and pool.free_count == 3
+    assert pool.refs(pages[0]) == 1           # donor's claim intact
+    assert len(cache) == 0                    # the ENTRY is gone though
+
+
+def test_paged_prefix_cache_pinned_entry_not_evicted():
+    pool = PagePool(2, page_size=4)
+    cache = PagedPrefixCache(pool, min_tokens=2)
+    pages = pool.alloc(1)
+    entry = cache.insert([1, 2, 3, 4], pages, 4)
+    pool.unref(pages[0])                      # donor slot released
+    cache.pin(entry)
+    assert cache.evict_pages(1) == 0          # zero-reader entries only
+    assert pool.free_count == 1
+    cache.unpin(entry)
+    assert cache.evict_pages(1) == 1          # eviction at zero readers
+    assert pool.free_count == 2
+
+
+def test_evictable_pages_counts_cascaded_shares():
+    """A page shared by TWO zero-reader entries frees once both are
+    evicted, so the admission gate's availability count must include
+    it — an undercount would park an admissible request forever on an
+    otherwise idle engine."""
+    pool = PagePool(8, page_size=4)
+    cache = PagedPrefixCache(pool, min_tokens=2)
+    a_pages = pool.alloc(4)                    # donor 1: positions 0-15
+    cache.insert(list(range(16)), a_pages, 16)
+    for pid in a_pages:                        # donor 2 shares them ...
+        pool.ref(pid)
+    more = pool.alloc(4)                       # ... and extends to 0-31
+    cache.insert(list(range(32)), a_pages + more, 32)
+    for pid in a_pages:                        # both donors release
+        pool.unref(pid)
+        pool.unref(pid)
+    for pid in more:
+        pool.unref(pid)
+    assert pool.free_count == 0
+    # a_pages are held by BOTH entries (refs 2 each) — still evictable
+    # via the cascade; the naive refs==1 count would say 4
+    assert cache.evictable_pages() == 8
+    assert cache.evict_pages(8) == 8
+    assert pool.free_count == 8
+
+
+# ------------------------------------------------------------------- parity
+
+def test_paged_greedy_parity_and_compile_freeze(net):
+    """The acceptance contract: mixed-length traffic through the PAGED
+    engine is token-identical to net.generate, and after warmup no
+    (bucket, page-table) point ever compiles on traffic."""
+    prompts = _prompts((3, 5, 9, 12, 5, 7, 16, 2))
+    refs = _refs(net, prompts, 8)
+    eng = _paged(net)
+    n_warm = eng.warmup()
+    # same lattice bound as dense: full+chunk lattices, decode, tail copy
+    assert n_warm <= 2 * len(eng.lattice) + 2
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["slots"]["kv_layout"] == "paged"
+    assert s["slots"]["pages_total"] == eng.num_pages
+    # all leases ended: every non-prefix-claimed page back on the free list
+    assert s["slots"]["pages_free"] + s["slots"]["pages_shared"] <= \
+        s["slots"]["pages_total"]
+
+
+def test_paged_matches_dense_engine_exactly(net):
+    """Paged vs DENSE engine on identical traffic: same tokens, same
+    request accounting — the layouts must be observably identical to a
+    caller."""
+    prompts = _prompts((4, 11, 6, 13), seed=7)
+    outs = {}
+    for layout in ("dense", "paged"):
+        eng = InferenceEngine(net, num_slots=2, max_batch=2,
+                              seq_buckets=(8, 16),
+                              default_max_new_tokens=6, kv_layout=layout,
+                              page_size=8)
+        eng.warmup()
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs[layout] = [f.result(timeout=120) for f in futs]
+    for d, p in zip(outs["dense"], outs["paged"]):
+        onp.testing.assert_array_equal(d, p)
+
+
+def test_paged_chunked_prefill_long_prompt_parity(net):
+    """A prompt longer than the largest seq bucket crosses the
+    chunked/offset prefill path with pages allocated chunk by chunk."""
+    p = _prompts((40,), seed=9)[0]
+    ref = _refs(net, [p], 5)[0]
+    eng = _paged(net, num_slots=2, max_batch=2)
+    eng.warmup()
+    with eng:
+        out = eng.infer(p, max_new_tokens=5)
+    onp.testing.assert_array_equal(ref, out)
+    assert eng.stats()["batches"]["prefill_chunks"] >= 2
+
+
+def test_paged_prefix_sharing_whole_page_hit(net):
+    """Requests sharing a long prefix: the follower's whole matched
+    pages are shared by REFERENCE (pages_shared > 0, tokens saved at
+    page granularity with no compiled copy beyond the tail), tokens
+    identical."""
+    rs = onp.random.RandomState(3)
+    shared = rs.randint(0, 97, (24,)).astype("int32")
+    prompts = [onp.concatenate([shared,
+                                rs.randint(0, 97, (4,)).astype("int32")])
+               for _ in range(3)]
+    refs = _refs(net, prompts, 4)
+    eng = _paged(net, num_slots=2, max_batch=2, prefix_min_tokens=8)
+    eng.warmup()
+    with eng:
+        for p, ref in zip(prompts, refs):
+            out = eng.infer(p, max_new_tokens=4)
+            onp.testing.assert_array_equal(ref, out)
+            shared_now = eng.stats()["slots"]["pages_shared"]
+        s = eng.stats()
+    assert s["prefix_cache"]["prefix_hits"] >= 2
+    # 24 shared tokens = 3 whole pages of 8; each hit saves >= 24 tokens
+    assert s["prefix_cache"]["prefix_tokens_saved"] >= 2 * 24
+    assert shared_now >= 1
+
+
+def test_paged_park_resume_roundtrip_preemption(net):
+    """Overload preemption under the paged layout: the victim's pages
+    park BY REFERENCE (an evictable prefix entry, no copy), the
+    continuation resumes by prefix hit, tokens identical."""
+    import time as _t
+    prompts = _prompts((6, 7), seed=11)
+    refs = _refs(net, prompts, 16)
+    ia = _prompts((5,), seed=12)[0]
+    ia_ref = _refs(net, [ia], 3)[0]
+    eng = _paged(net, num_slots=2, max_batch=2, seq_buckets=(8,),
+                 prefix_min_tokens=2)
+    eng.warmup()
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=16, priority="best_effort")
+                for p in prompts]
+        deadline = _t.monotonic() + 30   # both victims must be decoding
+        while eng.metrics.counters["decode_steps"] < 2:
+            assert _t.monotonic() < deadline
+            _t.sleep(0.005)
+        fi = eng.submit(ia, max_new_tokens=3, priority="interactive")
+        onp.testing.assert_array_equal(ia_ref, fi.result(timeout=120))
+        for p, f in zip(refs, futs):
+            onp.testing.assert_array_equal(p, f.result(timeout=120))
+        s = eng.stats()
+    assert s["overload"]["preemptions"] >= 1
+    assert s["overload"]["preempt_resumes"] >= 1
+    # the resume came back through SHARED pages, not a full prefill
+    assert s["prefix_cache"]["prefix_hits"] >= 1
+
+
+def test_paged_pool_thrash_parity_and_faults(net):
+    """1-page-headroom pool: decode-time page faults must park victims
+    by reference and every request still completes token-identical
+    (the chaos_sweep paged_storm invariant, minus the injected
+    faults)."""
+    prompts = _prompts((12, 16, 9, 14, 20, 11), seed=2)
+    refs = _refs(net, prompts, 10)
+    eng = _paged(net, num_pages=9)      # worst case needs 8; headroom 1
+    n_warm = eng.warmup()
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = [f.result(timeout=300) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["slots"]["page_faults"] >= 1
+    # park/resume churn under thrash must not compile anything new
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["requests"]["completed"] == len(prompts)
+
+
+def test_page_victim_respects_priority_floor(net):
+    """A page fault never parks a HIGHER class than the faulting
+    slot: a best_effort grower must park itself before touching an
+    interactive request (same downward-only semantics as overload
+    preemption)."""
+    from mxnet_tpu.serving import Request
+    from mxnet_tpu.serving.kv_slots import SlotState
+
+    eng = _paged(net, num_slots=3, max_batch=3)
+    slots = {}
+    for pr, t in (("interactive", 1.0), ("batch", 2.0),
+                  ("best_effort", 3.0)):
+        req = Request("decode", onp.ones(4, "int32"), 4,
+                      priority={"interactive": 0, "batch": 1,
+                                "best_effort": 2}[pr])
+        st = SlotState(req, 4, 4)
+        st.pages = [0]
+        slot = eng._alloc.alloc(st)
+        req.t_schedule = t
+        slots[pr] = slot
+    # a best_effort grower (floor 2) may only park the OTHER
+    # best_effort-class work — here there is none besides itself
+    assert eng._page_victim(slots["best_effort"], 2) is None
+    # a batch grower may park best_effort (lowest eligible), never
+    # the interactive slot
+    v = eng._page_victim(slots["batch"], 1)
+    assert v is not None and v[0] == slots["best_effort"]
+    # an interactive grower parks the lowest class available
+    v = eng._page_victim(slots["interactive"], 0)
+    assert v is not None and v[0] == slots["best_effort"]
+    eng.stop()
+
+
+# ------------------------------------------------------------- fault sites
+
+def test_page_alloc_fault_degrades_to_retry(net):
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((6, 9, 5), seed=4)
+    refs = _refs(net, prompts, 6)
+    plan = (FaultPlan().raise_at("serving.page_alloc", at=1)
+            .raise_at("serving.page_alloc", at=4))
+    eng = _paged(net)
+    eng.warmup()
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert plan.fired("serving.page_alloc") == 2
+    assert eng.stats()["slots"]["page_faults"] >= 2
+
+
+def test_page_copy_fault_degrades_to_whole_page_sharing(net):
+    """A faulted tail-page copy loses only the PARTIAL page: whole
+    matched pages still share, the request prefills a slightly longer
+    suffix, tokens identical."""
+    from mxnet_tpu.resilience import FaultPlan
+    rs = onp.random.RandomState(6)
+    shared = rs.randint(0, 97, (20,)).astype("int32")   # 2.5 pages
+    prompts = [onp.concatenate([shared,
+                                rs.randint(0, 97, (4,)).astype("int32")])
+               for _ in range(2)]
+    refs = _refs(net, prompts, 4)
+    plan = FaultPlan().raise_at("serving.page_copy", at=1)
+    eng = _paged(net, num_slots=2, max_batch=2, prefix_min_tokens=8)
+    eng.warmup()
+    with plan:
+        with eng:
+            for p, ref in zip(prompts, refs):
+                onp.testing.assert_array_equal(
+                    ref, eng.infer(p, max_new_tokens=4))
+            s = eng.stats()
+    assert plan.fired("serving.page_copy") == 1
+    assert s["prefix_cache"]["prefix_faults"] == 1
+    # the hit still counted: 2 whole pages (16 tokens) shared by table
+    assert s["prefix_cache"]["prefix_hits"] >= 1
+    assert s["prefix_cache"]["prefix_tokens_saved"] >= 16
+
+
+def test_paged_nonfinite_scrubs_freed_pages(net):
+    """Scrub-on-NaN under paging: the victim request fails typed, the
+    pages its release freed are ZEROED (NaN must not survive into the
+    next tenant), shared clean pages survive, and the engine keeps
+    serving."""
+    import jax.numpy as jnp
+
+    wpe = [p for _n, p in net.collect_params().items()
+           if p.shape == (64, 32)][0]
+    orig = wpe.data().asnumpy().copy()
+    w = orig.copy()
+    w[12, :] = onp.nan                # poison POSITION 12 only
+    try:
+        eng = _paged(net, num_slots=2, max_batch=2, seq_buckets=(8,))
+        eng.warmup()
+        wpe.set_data(mx.nd.array(w))
+        with eng:
+            out = eng.infer(onp.array([1, 2], "int32"), max_new_tokens=2)
+            assert len(out) == 4      # stays < pos 12
+            with pytest.raises(NonFiniteOutputError):
+                eng.infer(onp.array([1, 2, 3], "int32"),
+                          max_new_tokens=12)          # crosses pos 12
+            wpe.set_data(mx.nd.array(orig))
+            # next tenant of the scrubbed pages decodes clean
+            out2 = eng.infer(onp.array([3, 4], "int32"), max_new_tokens=2)
+            assert len(out2) == 4 and eng.health()["live"]
+            s = eng.stats()
+            # every real page (scratch excluded — it is garbage by
+            # design) is NaN-free after the scrub
+            pool_pages = eng.num_pages
+            for layer in eng._caches:
+                for a in layer.values():
+                    assert bool(jnp.isfinite(a[:pool_pages]).all())
+                    # the ZERO page is never written — not even by the
+                    # NaN request's padding columns (targetless writes
+                    # route out of bounds): one row's NaN landing
+                    # there would fail EVERY live request through the
+                    # 0*NaN value einsum
+                    assert bool((a[pool_pages] == 0).all())
+        assert s["slots"]["pages_scrubbed"] >= 1
+        assert s["resilience"]["nonfinite_outputs"] == 1
+    finally:
+        wpe.set_data(mx.nd.array(orig))
+
+
+# ---------------------------------------------------------- config + gauges
+
+def test_paged_config_validation(net):
+    with pytest.raises(ServingError):
+        _paged(net, page_size=7)              # 64 % 7 != 0
+    with pytest.raises(ServingError):
+        _paged(net, num_pages=7)              # < one worst-case request
+    with pytest.raises(ServingError):
+        InferenceEngine(net, kv_layout="sparse")
+
+
+def test_paged_gauges_in_registry(net):
+    from mxnet_tpu.observability import default_registry
+    eng = _paged(net, name="paged_gauges")
+    flat = {}
+    for s in default_registry().collect()["samples"]:
+        if s["labels"].get("engine") == eng.name:
+            flat[s["name"]] = s.get("value")
+    assert flat.get("mxtpu_serving_kv_pages_total") == eng.num_pages
+    assert flat.get("mxtpu_serving_kv_pages_free") == eng.num_pages
+    assert "mxtpu_serving_kv_pages_shared" in flat
+    assert "mxtpu_serving_page_faults_total" in flat
+    eng.stop()

@@ -160,6 +160,7 @@ def serving_scenarios(net):
         ("sigterm_drain", lambda: _serving_scenario(
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
         ("prefix_storm", lambda: serving_prefix_storm(net)),
+        ("paged_storm", lambda: serving_paged_storm(net)),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
         ("replica_kill", lambda: fleet_replica_kill(net)),
         ("rolling_restart", lambda: fleet_rolling_restart(net)),
@@ -428,6 +429,109 @@ def serving_prefix_storm(net):
                    "prefix": s["prefix_cache"],
                    "faults_fired": plan.fired(),
                    "prefix_disabled": s["engine"]["prefix_disabled"]},
+    }
+
+
+def serving_paged_storm(net):
+    """Paged-KV chaos (docs/serving.md "Paged KV"): a page pool at
+    ONE page of headroom over the worst-case request, thrashed by
+    shared-prefix prompts of mixed lengths through more slots than the
+    pool can hold at once, while faults land on the page allocator and
+    mid-tail-page-copy AND a poisoned position embedding drives one
+    long request non-finite mid-decode.  Invariants: ZERO lost
+    requests (everything resolves — the poisoned one with a typed
+    NonFiniteOutputError, the rest token-identical to fault-free
+    ``net.generate``), page faults actually fired (the park-by-
+    reference relief valve ran), scrub-on-NaN SCRUBBED pages (counter
+    moved, and no NaN survives anywhere in the page pool afterwards),
+    and the storm compiled NOTHING after warmup."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.resilience import FaultPlan
+    from mxnet_tpu.serving import NonFiniteOutputError
+
+    rs = onp.random.RandomState(6)
+    shared = rs.randint(0, 61, (10,)).astype("int32")
+    prompts = [onp.concatenate([shared[:7 + (i % 4)],
+                                rs.randint(0, 61, (3,)).astype("int32")])
+               for i in range(8)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    nan_prompt = rs.randint(0, 61, (6,)).astype("int32")
+    plan = (FaultPlan()
+            .raise_at("serving.page_copy", at=1)
+            .raise_at("serving.page_alloc", at=3)
+            .raise_at("serving.page_alloc", at=9, retryable=True))
+    # worst case needs 32/8 = 4 pages; the pool holds 5 — every burst
+    # of 3 slots must fault, evict, and park to make progress
+    eng = _engine(net, num_slots=3, max_batch=3, kv_layout="paged",
+                  page_size=8, num_pages=5, prefix_min_tokens=2)
+    n_warm = eng.warmup()
+    wpe = [p for _n, p in net.collect_params().items()
+           if p.shape == (32, 16)][0]
+    orig = wpe.data().asnumpy().copy()
+    w = orig.copy()
+    w[20, :] = onp.nan              # poison POSITION 20 only: every
+    mismatched = stranded = 0       # parity request stays below it
+    nan_typed = False
+    try:
+        wpe.set_data(nd.array(w))
+        with plan:
+            eng.start()
+            futs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+            # crosses position 20 mid-decode -> NaN -> typed failure
+            nan_fut = eng.submit(nan_prompt, max_new_tokens=20)
+            for ref, f in zip(refs, futs):
+                try:
+                    out = f.result(timeout=60)
+                    if not onp.array_equal(out, ref):
+                        mismatched += 1
+                except Exception:
+                    stranded += 1
+            try:
+                nan_fut.result(timeout=60)
+            except NonFiniteOutputError:
+                nan_typed = True
+            except Exception:
+                stranded += 1
+            s = eng.stats()
+            # scrub proof: no NaN survives anywhere in the page pool,
+            # and the never-written ZERO page is still pristine (one
+            # row's NaN landing there would fail EVERY live request
+            # through the 0*NaN value einsum)
+            pool_clean = all(
+                bool(onp.isfinite(onp.asarray(a[:eng.num_pages])).all())
+                and bool((onp.asarray(a[eng.num_pages]) == 0).all())
+                for layer in eng._caches for a in layer.values())
+            try:
+                eng.stop(timeout=15)
+            except Exception:
+                pass
+    finally:
+        wpe.set_data(nd.array(orig))
+    _join_zombies()
+    passed = (mismatched == 0 and stranded == 0 and nan_typed
+              and pool_clean
+              and s["slots"]["page_faults"] >= 2
+              and s["slots"]["pages_scrubbed"] >= 1
+              and s["prefix_cache"]["prefix_faults"] >= 1
+              and s["compile_cache"]["compiles"] == n_warm
+              and plan.fired("serving.page_copy") >= 1
+              and plan.fired("serving.page_alloc") >= 2)
+    return {
+        "name": "serving/paged_storm",
+        "passed": bool(passed),
+        "detail": {"requests": len(prompts) + 1, "mismatched": mismatched,
+                   "stranded": stranded, "nan_typed": nan_typed,
+                   "pool_clean_after_scrub": pool_clean,
+                   "slots": s["slots"],
+                   "prefix": s["prefix_cache"],
+                   "compiles_warmup": n_warm,
+                   "compiles_total": s["compile_cache"]["compiles"],
+                   "preemptions": s["overload"]["preemptions"],
+                   "faults_fired": plan.fired()},
     }
 
 
