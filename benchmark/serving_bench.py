@@ -67,6 +67,18 @@ headline: max sustainable concurrency at fixed KV memory, the number
 paging exists to win — plus page-pool occupancy/fault/sharing stats).
 Greedy outputs are asserted token-identical between the arms.
 
+``--workload speculative`` runs the speculative-vs-plain decode
+comparison (docs/serving.md "Speculative decode"): the same mixed
+greedy/sampled concurrent burst at IDENTICAL per-request sampling
+params through a plain engine and through one with ``spec_tokens=k``
+(early-exit drafter + one batched verify forward per cycle).  Output
+streams are asserted identical between the arms every trial —
+speculation's contract is same tokens, fewer weight-streaming passes —
+and it emits ``serving_speculative_plain`` (baseline) and
+``serving_speculative`` (``vs_baseline`` is the tokens/s speedup; the
+record carries the measured acceptance rate, the spec counters, and
+the live registry snapshot).
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -668,6 +680,133 @@ def bench_paged(n_requests: int = 16, trials: int = 3):
              registry_live=last_paged["registry"]))
 
 
+def _build_spec_net(on_tpu: bool):
+    """A net whose early-exit drafter TRACKS the full model — the
+    regime speculation targets.  A trained LM's residual stream is
+    dominated by the embedding/early layers for easy tokens; a randomly
+    initialized full-scale stack has no such structure (every layer
+    scrambles the stream, so layer-1 logits vs layer-L logits are a
+    coin flip and acceptance measures nothing).  Scaling each block's
+    residual-out projections down reproduces the trained-model property
+    — later blocks refine rather than rewrite — without needing a
+    trained checkpoint in the bench."""
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        prompt_lens = (64, 96, 128)
+        seq_buckets = (64, 128, 256)
+        max_new, spec_tokens, draft_layers = 64, 3, 3
+    else:   # CPU sanity: per-token decode must be dominated by the
+        # per-call costs a verify window AMORTIZES (weight-streaming
+        # matmul passes, program launch) rather than by per-token
+        # attention flops — the same regime TPU decode lives in, where
+        # a (k+1)-token verify reads the weights from HBM once while
+        # k+1 decode steps read them k+1 times.  That regime needs
+        # units large enough that streaming the weight matrices
+        # dominates a one-token GEMM; measured on this host at
+        # units=384 a (k+1=6)-token verify costs ~1.4x one decode
+        # step, so speculation wins from ~2 accepted tokens/cycle.
+        cfg = dict(vocab_size=512, units=384, num_layers=4,
+                   num_heads=4, max_length=256, dropout=0.0)
+        prompt_lens = (8, 12, 16)
+        seq_buckets = (8, 16, 32)
+        max_new, spec_tokens, draft_layers = 32, 5, 1
+    net = get_gpt2("gpt2_124m", **cfg)
+    net.initialize()
+    for blk in net.blocks:
+        for p in (blk.attn.out_proj.weight, blk.ffn.fc2.weight):
+            p.set_data(p.data() * 0.03)
+    return net, prompt_lens, seq_buckets, max_new, spec_tokens, \
+        draft_layers
+
+
+def bench_speculative(concurrency: int = 8, trials: int = 3):
+    """Speculative vs plain decode on the same mixed greedy/sampled
+    burst at IDENTICAL sampling params.  Output streams are asserted
+    identical between the arms every trial (speculation's correctness
+    contract: same tokens, fewer dispatches) — greedy rows doubly so,
+    being also generate-parity-pinned by the test suite.  Reports
+    tokens/s medians, the measured acceptance rate, and the live
+    registry snapshot."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    (net, prompt_lens, seq_buckets, max_new, spec_tokens,
+     draft_layers) = _build_spec_net(on_tpu)
+    rs = onp.random.RandomState(0)
+    prompts = [rs.randint(0, net.vocab_size,
+                          (prompt_lens[i % len(prompt_lens)],))
+               .astype("int32") for i in range(concurrency)]
+    # identical sampling params both arms: half greedy (the parity
+    # anchor), half seeded sampled (temperature + top-k) — streams are
+    # identical between the arms at ANY setting; the temperature only
+    # moves the acceptance rate (noisier targets are harder to draft)
+    samp = [dict() if i % 2 == 0
+            else dict(temperature=1.0, top_k=20, seed=100 + i)
+            for i in range(concurrency)]
+    total_tokens = concurrency * max_new
+
+    def build(spec):
+        kw = dict(spec_tokens=spec_tokens, draft_layers=draft_layers) \
+            if spec else {}
+        eng = InferenceEngine(
+            net, num_slots=concurrency, max_batch=concurrency,
+            seq_buckets=seq_buckets, queue_depth=4 * concurrency,
+            default_max_new_tokens=max_new,
+            name=f"serving_spec_{'on' if spec else 'off'}", **kw)
+        eng.warmup()             # pays every compile up front (decode-
+        return eng               # bench pattern: one engine, N trials)
+
+    def one_trial(eng):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=max_new, **k)
+                for p, k in zip(prompts, samp)]
+        outs = [f.result(timeout=1800) for f in futs]
+        return total_tokens / (time.perf_counter() - t0), outs
+
+    plain_vals, spec_vals = [], []
+    plain_eng, spec_eng = build(False), build(True)
+    with plain_eng, spec_eng:
+        # one untimed priming burst per arm: first-burst host warmth
+        # (allocator, page cache, lazy jax runtime state) is not a
+        # property of either arm and must not land in trial 1
+        one_trial(plain_eng)
+        one_trial(spec_eng)
+        for _ in range(max(1, trials)):
+            tps, outs_p = one_trial(plain_eng)
+            plain_vals.append(tps)
+            tps, outs_s = one_trial(spec_eng)
+            spec_vals.append(tps)
+            for a, b in zip(outs_p, outs_s):     # correctness gate,
+                if not onp.array_equal(a, b):    # every trial
+                    raise AssertionError(
+                        "speculative/plain output streams diverged — "
+                        "the bench numbers would be comparing "
+                        "different work")
+        last_spec = spec_eng.stats()
+        from mxnet_tpu.observability import flatten
+        last_spec["registry"] = flatten(prefix="mxtpu_serving")
+    speedup = round(statistics.median(spec_vals) /
+                    statistics.median(plain_vals), 4)
+    sp = last_spec["speculative"]
+    base = {"concurrency": concurrency, "max_new_tokens": max_new,
+            "spec_tokens": spec_tokens, "draft_layers": draft_layers}
+    yield _record("serving_speculative_plain", plain_vals, "tokens/sec",
+                  None, dict(base, spec_tokens=0))
+    yield _record(
+        "serving_speculative", spec_vals, "tokens/sec", speedup,
+        dict(base, acceptance_rate=sp["acceptance_rate"],
+             spec_cycles=sp["spec_cycles"],
+             spec_tokens_proposed=sp["spec_tokens_proposed"],
+             spec_tokens_accepted=sp["spec_tokens_accepted"],
+             spec_faults=sp["spec_faults"],
+             registry_live=last_spec["registry"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
@@ -675,7 +814,7 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
-                             "paged"),
+                             "paged", "speculative"),
                     default="decode")
     args = ap.parse_args()
 
@@ -693,6 +832,8 @@ def main():
         recs = bench_overload(trials=args.trials)
     elif args.workload == "paged":
         recs = bench_paged(trials=args.trials)
+    elif args.workload == "speculative":
+        recs = bench_speculative(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -95,10 +95,10 @@ class _FleetRequest:
     resubmit the request to another replica on failover."""
 
     __slots__ = ("payload", "kind", "max_new_tokens", "eos_id", "deadline",
-                 "failovers_left", "priority")
+                 "failovers_left", "priority", "sampling")
 
     def __init__(self, payload, kind, max_new_tokens, eos_id, deadline,
-                 failovers, priority=None):
+                 failovers, priority=None, sampling=None):
         self.payload = payload
         self.kind = kind
         self.max_new_tokens = max_new_tokens
@@ -106,6 +106,11 @@ class _FleetRequest:
         self.deadline = deadline          # absolute monotonic, never reset
         self.failovers_left = failovers   # never refreshed
         self.priority = priority          # QoS class, carried on failover
+        # per-request sampling params (docs/serving.md), carried on
+        # every failover/hedge attempt: draws fold the request seed
+        # with ABSOLUTE token positions, so a resubmitted request
+        # reproduces the same stream on any replica
+        self.sampling = sampling or {}
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:
@@ -874,7 +879,8 @@ class FleetRouter:
                 fut = h.engine.submit(req.payload, req.max_new_tokens,
                                       timeout=req.remaining(),
                                       eos_id=req.eos_id,
-                                      priority=req.priority)
+                                      priority=req.priority,
+                                      **req.sampling)
             except DeadlineInfeasibleError as e:
                 # the deadline is the REQUEST's own constraint — a
                 # less-loaded candidate may still make it; the breaker
@@ -994,13 +1000,19 @@ class FleetRouter:
     def submit(self, x, max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
                eos_id: Optional[int] = None,
-               priority: Optional[str] = None) -> FleetFuture:
+               priority: Optional[str] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> FleetFuture:
         """Enqueue one request on the fleet; same contract as
         ``InferenceEngine.submit`` with replica placement on top.
         ``timeout`` is the request's fleet-wide server deadline —
         failover resubmissions inherit the REMAINING time, never a
         fresh window.  ``priority`` (docs/overload.md) rides every
-        attempt: a failed-over request keeps its class."""
+        attempt: a failed-over request keeps its class, and the
+        sampling params (``temperature``/``top_k``/``top_p``/``seed``,
+        docs/serving.md) ride too — seeded draws fold with absolute
+        positions, so a failover or hedge reproduces the SAME stream
+        on whichever replica wins."""
         if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise EngineStoppedError("fleet router is stopped")
         if self.mode == "decode":
@@ -1011,16 +1023,25 @@ class FleetRouter:
         else:
             payload = onp.asarray(getattr(x, "asnumpy", lambda: x)())
         deadline = time.monotonic() + timeout if timeout else None
+        # sampling params ride EVERY attempt unconditionally: the
+        # replica engine owns validating them (a forward-mode engine
+        # rejects non-defaults typed), so fleet and bare engine keep
+        # one contract instead of the router silently dropping them
         req = _FleetRequest(payload, self.mode, max_new_tokens, eos_id,
                             deadline, self.max_failovers,
-                            priority=priority)
+                            priority=priority,
+                            sampling=dict(temperature=temperature,
+                                          top_k=top_k, top_p=top_p,
+                                          seed=seed))
         handle, inner = self._submit_once(req)
         return FleetFuture(self, req, handle, inner)
 
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
               eos_id: Optional[int] = None,
-              priority: Optional[str] = None):
+              priority: Optional[str] = None,
+              temperature: float = 0.0, top_k: int = 0,
+              top_p: float = 1.0, seed: int = 0):
         """Synchronous ``submit()`` + wait (unbounded client wait — the
         fleet resolves every future with a result or a typed error,
         same as the engine)."""
@@ -1028,7 +1049,9 @@ class FleetRouter:
             raise ServingError("router not started — call start() or use "
                                "the context manager")
         return self.submit(x, max_new_tokens, timeout, eos_id,
-                           priority).result(None)
+                           priority, temperature=temperature,
+                           top_k=top_k, top_p=top_p,
+                           seed=seed).result(None)
 
     # -------------------------------------------------------------- stats
     def _count(self, key: str, n: int = 1):

@@ -191,6 +191,120 @@ class _GPT2Decoding:
                                   flatten=False)
         return logits.reshape((s, self.vocab_size)), new_caches
 
+    def verify_slots(self, tokens_nd, caches, pos, page_table=None):
+        """Speculative VERIFY forward (docs/serving.md "Speculative
+        decode"): the decode step generalized from one token per slot to
+        a (S, W) window — structurally :meth:`prefill_slots` with
+        ``offset=pos`` and ``slot_idx=arange(S)``, but with logits kept
+        at EVERY window position instead of only the last real one.
+
+        Row s consumes window tokens at absolute positions
+        ``[pos[s], pos[s]+W)``, writes every layer's K/V there through
+        the standard slot/page scatter (parked rows at ``pos >= Tmax``
+        route out of bounds and drop, exactly like :meth:`decode_step`),
+        attends causally over the full cache row, and returns logits
+        (S, W, vocab) — logits[s, i] is the next-token distribution
+        after consuming window token i, which is what the engine's
+        rejection rule samples from.  Inference only."""
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+
+        s, t = tokens_nd.shape
+        apos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+        # clamp the embedding lookup only (parked rows / windows running
+        # past Tmax): their K/V writes are OOB scatters (dropped) and
+        # their logits are never accepted
+        x = self.wte(tokens_nd) + \
+            self.wpe(NDArray(jnp.minimum(apos, self.max_length - 1)))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            # slot_idx=None = "row i IS slot i": the cache row read
+            # lowers to a slice, not an identity-permutation gather
+            x, c = blk.forward_prefill_slots(x, cache, None, pos,
+                                             page_table)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        logits = F.FullyConnected(x, self.wte.weight.data(), None,
+                                  num_hidden=self.vocab_size, no_bias=True,
+                                  flatten=False)
+        return logits.reshape((s, t, self.vocab_size)), new_caches
+
+    def draft_slots(self, tok, caches, pos, n_tokens, draft_layers,
+                    temperature, top_k, top_p, keys, poison=None,
+                    page_table=None):
+        """Self-speculative DRAFTER: propose ``n_tokens`` tokens per
+        slot by early-exiting through the first ``draft_layers``
+        transformer blocks (then ``ln_f`` + the tied LM head) — no
+        second model, and the slot caches' leading layers ARE the
+        drafter's KV state.  The whole k-step loop runs inside ONE
+        compiled call (``lax.fori_loop``), so a speculation cycle costs
+        two dispatches (draft + verify) instead of k+1.
+
+        STRICTLY READ-ONLY on ``caches``: speculated K/V live in
+        per-layer window buffers carried through the loop
+        (``forward_step_window``), so an abandoned draft — verify
+        fault, rejected tokens, poisoned head — cannot leave stale or
+        non-finite state anywhere shared; nothing is returned but the
+        proposed tokens (S, n_tokens) int32.
+
+        Each step samples with the SAME per-request seeded rule the
+        verify forward uses (``sample_tokens`` folded at the consumed
+        token's absolute position), so a drafter whose early-exit
+        logits track the full model proposes exactly the token the
+        verifier will sample — acceptance degrades gracefully with
+        drafter quality and correctness never depends on it.
+        ``poison`` (traced f32 scalar, normally 0.0) is added to the
+        draft logits — the ``serving.draft_logits`` fault site's NaN
+        splice rides it without recompiling."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+        from ..serving.sampling import sample_tokens
+
+        _dense_blocks_only(self)
+        if not 1 <= int(draft_layers) <= len(self.blocks):
+            raise ValueError(
+                f"draft_layers={draft_layers} must be in "
+                f"[1, {len(self.blocks)}]")
+        blocks = self.blocks[:int(draft_layers)]
+        s = tok.shape[0]
+        blk0 = self.blocks[0]
+        h, d = blk0.attn._num_heads, blk0.attn._head_dim
+        dt = caches[0]["k"].dtype
+        wins = tuple((jnp.zeros((s, n_tokens, h, d), dt),
+                      jnp.zeros((s, n_tokens, h, d), dt))
+                     for _ in blocks)
+        tok_j = tok.jax if isinstance(tok, NDArray) else tok
+
+        def body(i, carry):
+            cur, wins, out = carry
+            p = pos + i
+            x = self.wte(NDArray(cur.reshape((s, 1)))) + self.wpe(
+                NDArray(jnp.minimum(p, self.max_length - 1)
+                        .reshape((s, 1))))
+            new_wins = []
+            for blk, (wk, wv), cache in zip(blocks, wins, caches):
+                x, wk, wv = blk.forward_step_window(
+                    x, cache, pos, wk, wv, i, page_table)
+                new_wins.append((wk, wv))
+            x = self.ln_f(x)
+            logits = F.FullyConnected(
+                x, self.wte.weight.data(), None,
+                num_hidden=self.vocab_size, no_bias=True, flatten=False)
+            lg = logits.reshape((s, self.vocab_size)).jax
+            if poison is not None:
+                lg = lg + poison
+            nxt = sample_tokens(lg, temperature, top_k, top_p, keys, p)
+            return nxt, tuple(new_wins), out.at[:, i].set(nxt)
+
+        _, _, out = jax.lax.fori_loop(
+            0, int(n_tokens), body,
+            (tok_j.astype(jnp.int32), wins,
+             jnp.zeros((s, int(n_tokens)), jnp.int32)))
+        return out
+
     def generate(self, prompt, max_new_tokens, temperature=1.0, top_k=0,
                  seed=0):
         """Autoregressive generation with a KV cache, as ONE jitted XLA

@@ -227,6 +227,45 @@ class MultiHeadAttention(HybridBlock):
         out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
         return out, {"k": kc, "v": vc}
 
+    def forward_step_window(self, x, cache, pos, win_k, win_v, i,
+                            page_table=None):
+        """READ-ONLY draft decode step (docs/serving.md "Speculative
+        decode"): like :meth:`forward_step_slots`, but the new K/V land
+        in per-layer WINDOW buffers ``win_k``/``win_v`` (S, W, H, D) at
+        column ``i`` instead of the shared cache — the cache is never
+        written, so a drafter that is aborted (verify fault, rejected
+        proposals, NaN-poisoned draft head) leaves NO trace in shared
+        state and degrading to a plain decode step is always safe.
+
+        Row s is drafting token ``i`` of its window: it consumes a
+        token at absolute position ``pos[s] + i``, where the cache row
+        holds valid K/V for positions ``< pos[s]`` (strictly — the
+        consumed token's own K/V lives in window column 0) and window
+        columns ``0..i`` hold the speculated positions
+        ``pos[s]..pos[s]+i``.  Attention runs over the concatenation
+        [cache row (keys < pos), window (cols <= i)].  Returns
+        ``(out, new win_k, new win_v)``.  Inference only."""
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+
+        s = x.shape[0]
+        h, d = self._num_heads, self._head_dim
+        q = self.q_proj(x).reshape((s, 1, h, d))
+        k_new = self.k_proj(x).reshape((s, h, d))
+        v_new = self.v_proj(x).reshape((s, h, d))
+        wk = win_k.at[:, i].set(k_new.jax.astype(win_k.dtype))
+        wv = win_v.at[:, i].set(v_new.jax.astype(win_v.dtype))
+        if page_table is None:
+            krow, vrow = cache["k"][:s], cache["v"][:s]
+        else:
+            krow = _paged_rows(cache["k"], page_table)
+            vrow = _paged_rows(cache["v"], page_table)
+        out = _attention_step_window(q.jax, krow, vrow, wk, wv, pos, i,
+                                     1.0 / (d ** 0.5))
+        out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
+        return out, wk, wv
+
     def forward_prefill_slots(self, x, cache, slot_idx, offset=None,
                               page_table=None):
         """Bucketed admission prefill: x (B,Tb,U) is a batch of PADDED
@@ -273,8 +312,15 @@ class MultiHeadAttention(HybridBlock):
         v = self.v_proj(x).reshape((b, t, h, d))
         cidx = jnp.arange(t)[None, :] if offset is None \
             else offset[:, None] + jnp.arange(t)[None, :]
+        # slot_idx=None means "row i IS slot i" (the speculative verify
+        # window, whose batch dim spans every slot): the row read below
+        # becomes a SLICE instead of a gather — an identity-permutation
+        # gather copies the whole (B, Tmax, H, D) cut per layer, which
+        # XLA cannot see through and which would dominate a small
+        # verify window's cost
         if page_table is None:
-            ridx = slot_idx[:, None]
+            ridx = jnp.arange(b)[:, None] if slot_idx is None \
+                else slot_idx[:, None]
             kc = cache["k"].at[ridx, cidx].set(
                 k.jax.astype(cache["k"].dtype))
             vc = cache["v"].at[ridx, cidx].set(
@@ -283,7 +329,8 @@ class MultiHeadAttention(HybridBlock):
             ps = cache["k"].shape[1]
             tmax = page_table.shape[1] * ps
             zero_page = cache["k"].shape[0] - 1
-            trows = page_table[slot_idx]                     # (B, P)
+            trows = page_table[:b] if slot_idx is None \
+                else page_table[slot_idx]                    # (B, P)
             lp = jnp.minimum(cidx // ps, page_table.shape[1] - 1)
             mapped = jnp.take_along_axis(trows, lp, axis=1)  # (B, Tb)
             # padding columns past Tmax, columns spilling into a
@@ -302,13 +349,18 @@ class MultiHeadAttention(HybridBlock):
         if offset is None:
             out = dot_product_attention(q, k, v, causal=True)
         elif page_table is None:
-            krow = kc[slot_idx]          # (B, Tmax, H, D)
-            vrow = vc[slot_idx]
+            if slot_idx is None:
+                krow, vrow = kc[:b], vc[:b]      # slice, not gather
+            else:
+                krow = kc[slot_idx]          # (B, Tmax, H, D)
+                vrow = vc[slot_idx]
             out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
                                            1.0 / (d ** 0.5)))
         else:
-            krow = _paged_rows(kc, page_table[slot_idx])
-            vrow = _paged_rows(vc, page_table[slot_idx])
+            trows = page_table[:b] if slot_idx is None \
+                else page_table[slot_idx]
+            krow = _paged_rows(kc, trows)
+            vrow = _paged_rows(vc, trows)
             out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
                                            1.0 / (d ** 0.5)))
         out = self.out_proj(out.reshape((b, t, h * d)))
@@ -375,6 +427,34 @@ def _attention_chunk(q, k_rows, v_rows, qpos, scale):
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_rows.dtype),
                       v_rows)
+
+
+def _attention_step_window(q, k_cache, v_cache, k_win, v_win, pos, i,
+                           scale):
+    """Draft-step attention over [cache row, speculation window]: row s
+    attends cache keys at positions ``< pos[s]`` (strictly — unlike
+    :func:`_attention_step_slots`'s ``<= pos``, because the draft never
+    writes the cache: the consumed token's K/V sits in window column 0)
+    plus window columns ``<= i`` (absolute positions
+    ``pos[s]..pos[s]+i``).  Same masked-select-before-softmax math as
+    every other attention here, so masked lanes only need FINITE
+    values, which both sources guarantee."""
+    import jax.numpy as jnp
+
+    lc = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                    preferred_element_type=jnp.float32) * scale
+    keys = jnp.arange(k_cache.shape[1])
+    lc = jnp.where(keys[None, None, None, :] < pos[:, None, None, None],
+                   lc, -1e30)
+    lw = jnp.einsum("bqhd,bkhd->bhqk", q, k_win,
+                    preferred_element_type=jnp.float32) * scale
+    cols = jnp.arange(k_win.shape[1])
+    lw = jnp.where(cols[None, None, None, :] <= i, lw, -1e30)
+    logits = jnp.concatenate([lc, lw], axis=-1)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    vals = jnp.concatenate([v_cache, v_win], axis=1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vals.dtype), vals)
 
 
 def _attention_step_slots(q, k_cache, v_cache, pos, scale):
@@ -644,6 +724,18 @@ class TransformerBlock(HybridBlock):
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache
+
+    def forward_step_window(self, x, cache, pos, win_k, win_v, i,
+                            page_table=None):
+        """Read-only draft decode through the block (see
+        MultiHeadAttention.forward_step_window; the cache is never
+        written — new K/V ride the window buffers)."""
+        a, wk, wv = self.attn.forward_step_window(self.ln1(x), cache,
+                                                  pos, win_k, win_v, i,
+                                                  page_table)
+        x = x + a
+        x = x + self.ffn(self.ln2(x))
+        return x, wk, wv
 
 
 class TransformerEncoderLayer(TransformerBlock):

@@ -173,6 +173,15 @@ register_site("serving.page_alloc",
 register_site("serving.page_copy",
               "paged-KV compiled partial-tail-page copy (degrades to "
               "whole-page sharing + longer suffix prefill)")
+register_site("serving.draft", "speculative draft dispatch (degrades "
+              "that cycle to plain one-token decode)")
+register_site("serving.verify", "speculative verify dispatch (degrades "
+              "that cycle to plain one-token decode — the read-only "
+              "drafter left nothing to clean up)")
+register_site("serving.draft_logits",
+              "poison: NaN/Inf splice into the draft head's logits "
+              "(proposals go garbage; verify rejects them — tokens "
+              "stay correct, only speed degrades)")
 # overload control (docs/overload.md) — degrades, never fails a request
 register_site("overload.admission", "priority/deadline admission gate")
 register_site("overload.preempt", "slot-preemption attempt")

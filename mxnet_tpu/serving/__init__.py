@@ -9,7 +9,12 @@ persistent KV cache, a radix-tree PREFIX cache (shared prompt prefixes
 prefill once; later requests copy the cached K/V rows and prefill only
 their suffix), chunked prefill (long prompts interleave with decode),
 bounded-queue load shedding, per-request deadlines and phase-split
-latency/TTFT metrics.  See docs/serving.md.
+latency/TTFT metrics.  ``submit(temperature=, top_k=, top_p=, seed=)``
+opens the sampling workload (per-request seeded PRNG, deterministic
+streams, one compiled program per bucket), and ``spec_tokens=k`` turns
+on speculative multi-token decode: a self-drafting early-exit proposer
+plus one batched verify forward per cycle, token-identical to the
+non-speculative engine at any sampling setting.  See docs/serving.md.
 
 Quick start::
 
@@ -34,6 +39,7 @@ from .metrics import LatencyHistogram, ServingMetrics
 from .overload import (PRIORITIES, CircuitBreaker, OverloadController,
                        RetryBudget, priority_name, priority_ordinal)
 from .prefix_cache import PrefixCache, PrefixEntry
+from .sampling import request_key, sample_tokens
 
 __all__ = [
     "InferenceEngine", "InferenceFuture", "Request",
@@ -42,6 +48,7 @@ __all__ = [
     "PagePool", "PagedPrefixCache", "PagedPrefixEntry",
     "PrefixCache", "PrefixEntry",
     "LatencyHistogram", "ServingMetrics",
+    "sample_tokens", "request_key",
     "PRIORITIES", "OverloadController", "RetryBudget", "CircuitBreaker",
     "priority_name", "priority_ordinal",
     "ServingError", "QueueFullError", "RequestTimeoutError",

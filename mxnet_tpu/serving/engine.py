@@ -66,6 +66,26 @@ scrub-on-NaN zeroes exactly the pages the victim's release freed.
 Everything else — the bucket lattice, chunked prefill, ``warmup()``
 compile freeze, greedy token parity — composes unchanged.
 
+Sampling & speculative decode (docs/serving.md "Speculative decode"):
+``submit(temperature=, top_k=, top_p=, seed=)`` opens the sampling
+workload — per-request seeded PRNG keys ride the batched programs as
+traced arguments (``fold_in(key, position)`` per draw), so mixed
+greedy/sampled batches share one compiled program per bucket and every
+request's stream is deterministic regardless of batch composition.
+With ``spec_tokens=k`` the engine amortizes per-token dispatch: a cheap
+DRAFTER (early exit through the first ``draft_layers`` blocks, reusing
+the slot caches' leading layers) proposes ``k`` tokens per slot in one
+compiled call, and ONE batched VERIFY forward — the decode step
+generalized to ``(S, k+1)`` tokens, structurally the chunked-prefill
+path with logits kept at every position — accepts each slot's longest
+draft prefix matching the per-position seeded samples, plus one
+correction/bonus token.  Accepted tokens are exactly the
+non-speculative stream (greedy: longest argmax match); rejected tokens
+rewind by bookkeeping (dense) or by releasing over-claimed pages back
+to the pool (paged).  Faults at ``serving.draft``/``serving.verify``
+degrade that cycle to plain one-token decode — speculation can slow
+down, never fail or corrupt, a request.
+
 Prefix reuse (docs/serving.md): with ``prefix_pool_rows > 0`` a
 host-side radix tree (:mod:`.prefix_cache`) maps admitted prompt
 prefixes to a reserved pool of KV cache rows; a request whose prompt
@@ -110,7 +130,8 @@ from ..analysis.lockwitness import (named_condition as _named_condition,
                                     named_lock as _named_lock,
                                     note_blocking as _note_blocking)
 from ..observability.trace import active as _trace_active
-from ..resilience.faults import RetryableFault, inject as _inject
+from ..resilience.faults import (RetryableFault, inject as _inject,
+                                 poison as _poison)
 from .batcher import BucketLattice, DynamicBatcher
 from .errors import (DeadlineInfeasibleError, EngineCrashedError,
                      EngineStoppedError, InvalidRequestError,
@@ -124,6 +145,7 @@ from .overload import (OverloadController, PRIORITY_BATCH,
                        PRIORITY_BEST_EFFORT, PRIORITY_INTERACTIVE,
                        priority_name, priority_ordinal)
 from .prefix_cache import PrefixCache
+from .sampling import request_key, sample_tokens
 
 __all__ = ["InferenceEngine", "InferenceFuture", "Request"]
 
@@ -209,12 +231,14 @@ class Request:
     __slots__ = ("id", "kind", "payload", "prompt_len", "max_new_tokens",
                  "eos_id", "deadline", "future", "t_submit", "t_enqueue",
                  "t_schedule", "shape_key", "retries_left", "trace_id",
-                 "priority", "preempted")
+                 "priority", "preempted", "temperature", "top_k", "top_p",
+                 "seed", "key")
 
     _ids = itertools.count()
 
     def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
-                 deadline=None, priority=PRIORITY_BATCH):
+                 deadline=None, priority=PRIORITY_BATCH,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
         self.retries_left = 0     # engine grants the budget at submit
         # trace-id propagation crosses the scheduler thread boundary BY
         # VALUE on the request itself (no thread-locals to lose)
@@ -228,6 +252,16 @@ class Request:
         self.deadline = deadline
         self.priority = priority       # ordinal into overload.PRIORITIES
         self.preempted = 0             # times preempted (slot reclaimed)
+        # per-request sampling (docs/serving.md): temperature <= 0 is
+        # exact greedy; key is the seeded PRNG key every draw for this
+        # request folds with its absolute position — deterministic per
+        # request, batch-composition-independent, and preemption/
+        # speculation re-sample positions with the same key
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.key = request_key(self.seed)
         self.future = InferenceFuture()
         self.t_submit = time.monotonic()
         self.t_enqueue = self.t_submit
@@ -340,6 +374,20 @@ class InferenceEngine:
         cache reserves nothing (``prefix_pool_rows`` is ignored):
         cached prefixes are evictable refcount claims on this same
         pool, so it is always enabled.
+    spec_tokens : speculative decode depth ``k`` (decode mode;
+        0 = off, the exact pre-speculation engine).  Each cycle a cheap
+        drafter (early exit through the first ``draft_layers`` blocks,
+        reusing the slot caches' leading layers — no second model)
+        proposes ``k`` tokens per slot in ONE compiled call, and one
+        batched VERIFY forward — the decode step generalized to
+        ``(S, k+1)`` tokens — accepts the longest prefix that matches
+        what the per-request seeded sampler draws at each position, so
+        output streams are token-identical to the non-speculative
+        engine (greedy AND sampled) and only speed varies with drafter
+        quality.  See docs/serving.md "Speculative decode".
+    draft_layers : transformer blocks the drafter runs before its
+        early-exit LM head (must be < the model's layer count — the
+        drafter has to be cheaper than the verify forward it feeds).
     name : base name for this engine's metrics identity.  The claimed
         name (``self.name``) is uniquified against every other live
         engine (``serving``, ``serving-2``, …) so fleet replicas export
@@ -377,6 +425,8 @@ class InferenceEngine:
                  kv_layout: str = "dense",
                  page_size: int = 16,
                  num_pages: Optional[int] = None,
+                 spec_tokens: int = 0,
+                 draft_layers: int = 1,
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -479,6 +529,33 @@ class InferenceEngine:
                     self.prefix_pool_rows, row_base=self.num_slots + 1,
                     min_tokens=self.prefix_min_tokens) \
                     if self.prefix_pool_rows else None
+            # speculative decode (docs/serving.md "Speculative decode")
+            self.spec_tokens = int(spec_tokens)
+            self.draft_layers = int(draft_layers)
+            if self.spec_tokens < 0:
+                raise ServingError(f"spec_tokens must be >= 0, got "
+                                   f"{self.spec_tokens}")
+            if self.spec_tokens:
+                if not hasattr(net, "draft_slots") or \
+                        not hasattr(net, "verify_slots"):
+                    raise ServingError(
+                        f"{type(net).__name__} lacks the speculative "
+                        "decode surface (draft_slots/verify_slots) — "
+                        "set spec_tokens=0 to serve it")
+                if self.spec_tokens + 1 > self.max_length:
+                    raise ServingError(
+                        f"spec_tokens={self.spec_tokens} leaves no room "
+                        f"for the verify window in max_length="
+                        f"{self.max_length}")
+                n_blocks = len(getattr(net, "blocks", ()) or ())
+                if self.draft_layers < 1 or \
+                        (n_blocks and self.draft_layers >= n_blocks):
+                    raise ServingError(
+                        f"draft_layers={self.draft_layers} must be >= 1 "
+                        f"and < the model's layer count"
+                        f"{f' ({n_blocks})' if n_blocks else ''} — the "
+                        "drafter must be cheaper than the verify "
+                        "forward")
         else:
             self.max_length = None
             self.num_slots = 0
@@ -493,6 +570,12 @@ class InferenceEngine:
             self.num_pages = 0
             self._pool = None
             self._page_table = None
+            if int(spec_tokens):
+                raise ServingError("spec_tokens is a decode-mode knob "
+                                   "(forward mode has no decode loop to "
+                                   "speculate)")
+            self.spec_tokens = 0
+            self.draft_layers = int(draft_layers)
         self.prefix_fault_limit = int(prefix_fault_limit)
         # consecutive-fault streaks, PER SITE: a clean host lookup runs
         # right before every device copy, so a shared counter could
@@ -533,6 +616,11 @@ class InferenceEngine:
         self._prev_handlers = None
         self._stopping = False
         self._caches = None
+        # paged layout: whether every decoding slot got page coverage
+        # for the full speculation window this cycle (scheduler-owned;
+        # under page pressure speculation degrades to plain decode
+        # instead of parking victims for an optimization)
+        self._spec_pages_ok = True
         self._shape_seen = set()
         self._fwd_single = None
         self._exporter = None
@@ -607,6 +695,20 @@ class InferenceEngine:
                   fn=bound(lambda e: 1 if e._overload.brownout else 0),
                   **lbl)
 
+        def accept_rate(e):
+            c = e.metrics.counters
+            p = c["spec_tokens_proposed"]
+            return c["spec_tokens_accepted"] / p if p else 0.0
+
+        reg.gauge("mxtpu_serving_spec_draft_tokens",
+                  help="speculative draft depth k (0 = speculation off)",
+                  fn=bound(lambda e: e.spec_tokens), **lbl)
+        reg.gauge("mxtpu_serving_spec_acceptance_rate",
+                  help="accepted / proposed draft tokens — the "
+                       "drafter-quality signal (the per-cycle bonus "
+                       "token is not counted as proposed)",
+                  fn=bound(accept_rate), **lbl)
+
     # ------------------------------------------------------------- exporter
     def attach_exporter(self, exporter) -> "InferenceEngine":
         """Tie a :class:`~mxnet_tpu.observability.BackgroundExporter`
@@ -639,46 +741,117 @@ class InferenceEngine:
                 axes = tuple(range(1, logits_jax.ndim))
                 return jnp.all(jnp.isfinite(logits_jax), axis=axes)
 
-            def post(logits, c):
-                # ONE guard/argmax post-processing body shared by every
-                # prefill/chunk/step closure in both layouts — greedy
-                # parity cannot diverge between them
+            def post(logits, c, temp, topk, topp, keys, fpos):
+                # ONE guard/sampling post-processing body shared by
+                # every prefill/chunk/step closure in both layouts —
+                # parity cannot diverge between them.  fpos is the
+                # absolute position of the token each row just consumed
+                # (the sampler's per-request fold constant); greedy
+                # rows (temperature <= 0) take the exact argmax branch,
+                # bit-identical to the pre-sampling engine.
                 ok = row_ok(logits.jax) if guard else \
                     jnp.ones((logits.jax.shape[0],), jnp.bool_)
-                return (jnp.argmax(logits.jax, -1).astype(jnp.int32),
-                        ok, c)
+                return (sample_tokens(logits.jax, temp, topk, topp,
+                                      keys, fpos), ok, c)
+
+            spec_k = self.spec_tokens
+            spec_layers = self.draft_layers
+
+            def verify_post(logits, c, pos, temp, topk, topp, keys):
+                # verify keeps logits at EVERY window position: column
+                # i samples with the fold position pos + i — exactly
+                # the (key, position) the non-speculative engine would
+                # use when it reached that token, which is what makes
+                # longest-match acceptance stream-identical.  All
+                # columns sample in ONE flattened (S*W, V) call —
+                # sample_tokens is row-independent, and a per-column
+                # unroll would trace W copies of its two full-vocab
+                # sorts into the hot verify program
+                lj = logits.jax
+                s, w, v = lj.shape
+                ok = row_ok(lj) if guard else \
+                    jnp.ones((s,), jnp.bool_)
+                fpos = (pos[:, None]
+                        + jnp.arange(w, dtype=jnp.int32)[None, :]
+                        ).reshape(-1)
+                toks = sample_tokens(
+                    lj.reshape(s * w, v),
+                    jnp.repeat(temp, w, axis=0),
+                    jnp.repeat(topk, w, axis=0),
+                    jnp.repeat(topp, w, axis=0),
+                    jnp.repeat(keys, w, axis=0), fpos)
+                return toks.reshape(s, w), ok, c
 
             if self._paged:
                 # the paged programs take the page table as ONE extra
                 # traced argument
-                def chunk(toks, lens, caches, sidx, off, table):
-                    return post(*net.prefill_slots(
+                def chunk(toks, lens, caches, sidx, off, temp, topk,
+                          topp, keys, table):
+                    logits, c = net.prefill_slots(
                         NDArray(toks), lens, caches, sidx, offset=off,
-                        page_table=table))
+                        page_table=table)
+                    fpos = lens - 1 if off is None else off + lens - 1
+                    return post(logits, c, temp, topk, topp, keys, fpos)
 
-                def prefill(toks, lens, caches, sidx, table):
-                    return chunk(toks, lens, caches, sidx, None, table)
+                def prefill(toks, lens, caches, sidx, temp, topk, topp,
+                            keys, table):
+                    return chunk(toks, lens, caches, sidx, None, temp,
+                                 topk, topp, keys, table)
 
-                def step(tok, caches, pos, table):
-                    return post(*net.decode_step(NDArray(tok), caches,
-                                                 pos, page_table=table))
+                def step(tok, caches, pos, temp, topk, topp, keys,
+                         table):
+                    logits, c = net.decode_step(NDArray(tok), caches,
+                                                pos, page_table=table)
+                    return post(logits, c, temp, topk, topp, keys, pos)
+
+                def verify(toks, caches, pos, temp, topk, topp, keys,
+                           table):
+                    logits, c = net.verify_slots(NDArray(toks), caches,
+                                                 pos, page_table=table)
+                    return verify_post(logits, c, pos, temp, topk,
+                                       topp, keys)
+
+                def draft(tok, caches, pos, temp, topk, topp, keys,
+                          pois, table):
+                    return net.draft_slots(
+                        NDArray(tok), caches, pos, spec_k, spec_layers,
+                        temp, topk, topp, keys, poison=pois,
+                        page_table=table)
             else:
                 # dense closures call the PRE-PAGING decode surface —
                 # no page_table kwarg, so any net implementing the
                 # documented duck-typed contract (prefill_slots(tokens,
                 # lens, caches, slot_idx, offset=)/decode_step) keeps
                 # serving under the default layout
-                def chunk(toks, lens, caches, sidx, off):
-                    return post(*net.prefill_slots(
-                        NDArray(toks), lens, caches, sidx, offset=off))
+                def chunk(toks, lens, caches, sidx, off, temp, topk,
+                          topp, keys):
+                    logits, c = net.prefill_slots(
+                        NDArray(toks), lens, caches, sidx, offset=off)
+                    fpos = lens - 1 if off is None else off + lens - 1
+                    return post(logits, c, temp, topk, topp, keys, fpos)
 
-                def prefill(toks, lens, caches, sidx):
+                def prefill(toks, lens, caches, sidx, temp, topk, topp,
+                            keys):
                     # full prefill IS the offset=None case
-                    return chunk(toks, lens, caches, sidx, None)
+                    return chunk(toks, lens, caches, sidx, None, temp,
+                                 topk, topp, keys)
 
-                def step(tok, caches, pos):
-                    return post(*net.decode_step(NDArray(tok), caches,
-                                                 pos))
+                def step(tok, caches, pos, temp, topk, topp, keys):
+                    logits, c = net.decode_step(NDArray(tok), caches,
+                                                pos)
+                    return post(logits, c, temp, topk, topp, keys, pos)
+
+                def verify(toks, caches, pos, temp, topk, topp, keys):
+                    logits, c = net.verify_slots(NDArray(toks), caches,
+                                                 pos)
+                    return verify_post(logits, c, pos, temp, topk,
+                                       topp, keys)
+
+                def draft(tok, caches, pos, temp, topk, topp, keys,
+                          pois):
+                    return net.draft_slots(
+                        NDArray(tok), caches, pos, spec_k, spec_layers,
+                        temp, topk, topp, keys, poison=pois)
 
             def copy_rows(caches, src, dst, length):
                 # masked row-to-row K/V copy for the prefix cache:
@@ -703,19 +876,32 @@ class InferenceEngine:
             self._items, pure_prefill = make_pure_fn(net, prefill)
             _, pure_step = make_pure_fn(net, step)
             _, pure_chunk = make_pure_fn(net, chunk)
+            pure_verify = pure_draft = None
+            if spec_k:
+                _, pure_verify = make_pure_fn(net, verify)
+                _, pure_draft = make_pure_fn(net, draft)
             # donate the cache buffers on TPU (in-place update, no copy of
-            # the S×Tmax×H×D arrays per step); CPU jax warns on donation
+            # the S×Tmax×H×D arrays per step); CPU jax warns on donation.
+            # The DRAFT never donates: it only reads the caches (its
+            # speculated K/V live in window registers) and the same
+            # buffers go into the verify right after.
             if jax.default_backend() == "tpu":
                 self._jit_prefill = jax.jit(pure_prefill,
                                             donate_argnums=(3,))
                 self._jit_step = jax.jit(pure_step, donate_argnums=(2,))
                 self._jit_chunk = jax.jit(pure_chunk, donate_argnums=(3,))
                 self._jit_copy = jax.jit(copy_rows, donate_argnums=(0,))
+                self._jit_verify = jax.jit(pure_verify,
+                                           donate_argnums=(2,)) \
+                    if spec_k else None
             else:
                 self._jit_prefill = jax.jit(pure_prefill)
                 self._jit_step = jax.jit(pure_step)
                 self._jit_chunk = jax.jit(pure_chunk)
                 self._jit_copy = jax.jit(copy_rows)
+                self._jit_verify = jax.jit(pure_verify) if spec_k \
+                    else None
+            self._jit_draft = jax.jit(pure_draft) if spec_k else None
         else:
             def forward(xs):
                 out = net(NDArray(xs))
@@ -1118,8 +1304,23 @@ class InferenceEngine:
     def submit(self, x, max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
                eos_id: Optional[int] = None,
-               priority: Optional[str] = None) -> InferenceFuture:
+               priority: Optional[str] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> InferenceFuture:
         """Enqueue one request; returns its future.
+
+        ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` are the
+        request's sampling workload (decode mode; docs/serving.md):
+        ``temperature <= 0`` (the default) is exact greedy argmax;
+        otherwise the request samples from its
+        temperature-scaled, top-k- then nucleus-filtered distribution
+        with a PER-REQUEST seeded PRNG — every draw folds ``seed``'s
+        key with the absolute token position, so a request's stream is
+        deterministic no matter what shares its batches, and identical
+        with speculation on or off.  All four ride the compiled
+        programs as traced arguments: mixed greedy/sampled batches
+        share one program per bucket and ``warmup()``'s compile freeze
+        is untouched.
 
         decode mode: ``x`` is a 1-D int prompt (list/np/NDArray); the
         result is the full sequence (prompt + generated) as np.int32.
@@ -1161,6 +1362,16 @@ class InferenceEngine:
         now = time.monotonic()
         deadline = now + timeout if timeout else None
         if self.mode == "decode":
+            import math as _math
+            if not (_math.isfinite(float(temperature))
+                    and float(temperature) >= 0.0) \
+                    or int(top_k) < 0 \
+                    or not (0.0 < float(top_p) <= 1.0):
+                self._reject("invalid", InvalidRequestError(
+                    f"bad sampling params: need temperature >= 0 "
+                    f"(finite), top_k >= 0, 0 < top_p <= 1 — got "
+                    f"temperature={temperature}, top_k={top_k}, "
+                    f"top_p={top_p}"), priority=priority_name(pr))
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)(),
                               dtype="int32")
             if arr.ndim == 2 and arr.shape[0] == 1:
@@ -1201,8 +1412,16 @@ class InferenceEngine:
                 self._feasible_or_reject(pr, mnt, deadline, now)
             req = Request("decode", arr, mnt,
                           self.eos_id if eos_id is None else eos_id,
-                          deadline, priority=pr)
+                          deadline, priority=pr,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, seed=seed)
         else:
+            if temperature or top_k or top_p != 1.0 or seed:
+                self._reject("invalid", InvalidRequestError(
+                    "sampling parameters (temperature/top_k/top_p/"
+                    "seed) are a decode-mode surface — a forward "
+                    "request has no token distribution to sample"),
+                    priority=priority_name(pr))
             arr = onp.asarray(getattr(x, "asnumpy", lambda: x)())
             self.metrics.count("submitted")
             self._brownout_shed_or_admit(pr, now)
@@ -1268,7 +1487,9 @@ class InferenceEngine:
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
               eos_id: Optional[int] = None,
-              priority: Optional[str] = None):
+              priority: Optional[str] = None,
+              temperature: float = 0.0, top_k: int = 0,
+              top_p: float = 1.0, seed: int = 0):
         """Synchronous ``submit()`` + wait.  ``timeout`` is the SERVER
         deadline; the wait itself is unbounded — the scheduler resolves
         every future (result, typed timeout, or engine error), so a
@@ -1281,8 +1502,39 @@ class InferenceEngine:
                                "the context manager (submit() alone may "
                                "queue pre-start, but a sync infer() would "
                                "block forever)")
-        fut = self.submit(x, max_new_tokens, timeout, eos_id, priority)
+        fut = self.submit(x, max_new_tokens, timeout, eos_id, priority,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, seed=seed)
         return fut.result(None)
+
+    # ---------------------------------------------------------------- sampling
+    def _zero_samp(self, n: int):
+        """Greedy-default per-row sampling args (temperature, top_k,
+        top_p, keys) — what warmup traces with and what padding rows
+        carry.  Dtypes must match the live-traffic arrays exactly or
+        the jit cache would miss on the first real batch."""
+        import jax.numpy as jnp
+        return (jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.ones((n,), jnp.float32),
+                jnp.zeros((n, 2), jnp.uint32))
+
+    @staticmethod
+    def _samp_rows(reqs, n):
+        """Host-side per-row sampling arrays for a batch of ``n`` rows
+        whose first ``len(reqs)`` carry the given requests (the rest
+        are padding at greedy defaults).  Returned as numpy; callers
+        convert once per dispatch."""
+        temp = onp.zeros((n,), "float32")
+        topk = onp.zeros((n,), "int32")
+        topp = onp.ones((n,), "float32")
+        keys = onp.zeros((n, 2), "uint32")
+        for i, r in enumerate(reqs):
+            temp[i] = r.temperature
+            topk[i] = r.top_k
+            topp[i] = r.top_p
+            keys[i] = r.key
+        return temp, topk, topp, keys
 
     # ------------------------------------------------------------------ warmup
     def warmup(self, example_shape: Optional[Sequence[int]] = None,
@@ -1313,11 +1565,29 @@ class InferenceEngine:
                 # traced arg — its SHAPE is fixed at construction, so
                 # the lattice (and the compile freeze) is untouched:
                 # one program per (bucket, page-table) point where the
-                # page-table side has exactly one point
+                # page-table side has exactly one point.  The sampling
+                # params (temp/top-k/top-p/key per row) are traced
+                # args shaped by the batch bucket — same story.
                 tbl = (self._table_arg(),) if self._paged else ()
                 _, _ok, self._caches = self._counted(
                     ("decode",), self._jit_step, params, zeros,
-                    self._caches, zeros, *tbl)
+                    self._caches, zeros, *self._zero_samp(s1), *tbl)
+                if self.spec_tokens:
+                    # the (bucket, k) lattice's k-side points: ONE
+                    # draft and ONE verify program at the fixed
+                    # (S+1, k) / (S+1, k+1) shapes — after this the
+                    # compile counter must stay frozen through any mix
+                    # of speculative and plain cycles
+                    self._counted(
+                        ("draft",), self._jit_draft, params, zeros,
+                        self._caches, zeros, *self._zero_samp(s1),
+                        jnp.asarray(0.0, jnp.float32), *tbl)
+                    toks2 = jnp.zeros((s1, self.spec_tokens + 1),
+                                      jnp.int32)
+                    _vt, _ok, self._caches = self._counted(
+                        ("verify",), self._jit_verify, params, toks2,
+                        self._caches, zeros, *self._zero_samp(s1),
+                        *tbl)
                 scratch = self._alloc.scratch
                 for bb, tb in self.lattice.prefill_points(
                         self.prefill_chunk):
@@ -1326,11 +1596,13 @@ class InferenceEngine:
                     sidx = jnp.full((bb,), scratch, jnp.int32)
                     _, _ok, self._caches = self._counted(
                         ("prefill", bb, tb), self._jit_prefill, params,
-                        toks, lens, self._caches, sidx, *tbl)
+                        toks, lens, self._caches, sidx,
+                        *self._zero_samp(bb), *tbl)
                     off = jnp.zeros((bb,), jnp.int32)
                     _, _ok, self._caches = self._counted(
                         ("chunk", bb, tb), self._jit_chunk, params,
-                        toks, lens, self._caches, sidx, off, *tbl)
+                        toks, lens, self._caches, sidx, off,
+                        *self._zero_samp(bb), *tbl)
                 if self._prefix is not None:
                     # dense: row-to-row prefix copy; paged: the same
                     # program IS the partial-tail-page copy (scratch
@@ -1376,6 +1648,8 @@ class InferenceEngine:
             "default_priority": priority_name(self.default_priority),
             "preemption": self.preemption,
             "deadline_admission": self.deadline_admission,
+            "spec_tokens": self.spec_tokens,
+            "draft_layers": self.draft_layers,
         }
         # KV capacity accounting (docs/serving.md "Paged KV"): slot
         # occupancy always; page-pool occupancy under the paged layout
@@ -1578,7 +1852,7 @@ class InferenceEngine:
             st.pinned = None
         freed = []
         if self._paged:
-            freed = [pid for pid in st.pages if self._pool.unref(pid)]
+            freed = self._pool.release(st.pages)
             st.pages = []
             st.pages_shared = 0
             st.waiting = False
@@ -1586,7 +1860,7 @@ class InferenceEngine:
             self._table_dirty()
         return freed
 
-    def _decode_cycle(self):
+    def _decode_cycle(self):  # guarded-by: _step_lock
         alloc = self._alloc
         now = time.monotonic()
         self._sweep_cancelled()
@@ -1619,7 +1893,10 @@ class InferenceEngine:
             self._grow_pages()
         if any(not st.prefilling and not st.waiting
                for _s, st in alloc.items()):
-            self._decode_step()
+            if self.spec_tokens and self._spec_pages_ok:
+                self._spec_step()
+            else:
+                self._decode_step()
 
     def _overload_tick(self, now: float):
         """One AIMD controller tick (docs/overload.md): pressure =
@@ -1732,7 +2009,9 @@ class InferenceEngine:
         self._release(slot)
         cont = Request("decode", seq,
                        st.max_new_tokens - len(st.generated),
-                       req.eos_id, req.deadline, priority=req.priority)
+                       req.eos_id, req.deadline, priority=req.priority,
+                       temperature=req.temperature, top_k=req.top_k,
+                       top_p=req.top_p, seed=req.seed)
         # the continuation IS the original request: same future, same
         # submit time (latency metrics span the whole request), same
         # trace id, same remaining retry budget
@@ -2002,13 +2281,18 @@ class InferenceEngine:
             return freed
         return reclaim
 
-    def _claim_pages(self, n: int):  # guarded-by: _step_lock
+    def _claim_pages(self, n: int, reclaim: bool = True):  # guarded-by: _step_lock
         """Allocate ``n`` pages (with the eviction reclaim hook),
         scrubbing any that a non-finite victim dirtied while another
         reader kept them alive past its release — stale NaN must never
         reach the new tenant (0·NaN = NaN through the value einsum
-        survives the select mask)."""
-        pages = self._pool.alloc(n, self._evict_hook())
+        survives the select mask).  ``reclaim=False`` allocates from
+        the free list only — the speculation window's SOFT claim must
+        not evict cached prefixes (a TTFT asset of future requests) to
+        fund an optimization, least of all one that then fails to
+        run."""
+        pages = self._pool.alloc(n, self._evict_hook() if reclaim
+                                 else None)
         if pages and self._pool.dirty:
             tainted = [p for p in pages if p in self._pool.dirty]
             if tainted:
@@ -2118,7 +2402,16 @@ class InferenceEngine:
         slot that cannot get one even after victim parking parks
         ITSELF by reference (progress becomes an evictable prefix
         entry; the continuation resumes by prefix hit when pages
-        free)."""
+        free).
+
+        With speculation on, each decoding slot additionally wants
+        coverage for the whole verify window ``[pos, pos+k]`` — but as
+        a SOFT claim: speculation is an optimization, so a shortfall
+        here never parks a victim and never makes a slot wait, it just
+        degrades the cycle to plain one-token decode
+        (``_spec_pages_ok``); rejected speculation returns over-claimed
+        pages via :meth:`_rewind_pages`."""
+        self._spec_pages_ok = True
         decoding = [(slot, st) for slot, st in self._alloc.items()
                     if not st.prefilling]
         decoding.sort(key=lambda it: it[1].request.t_schedule)
@@ -2127,14 +2420,36 @@ class InferenceEngine:
                 continue               # parked as a victim already
             if self._ensure_pages(slot, st, st.pos + 1) == "full":
                 self._preempt(slot, st)
+                continue
+            if not self.spec_tokens or st.waiting or \
+                    slot not in self._alloc:
+                continue
+            # soft window claim, capped at the cache end (a slot close
+            # to Tmax simply stops speculating that far).  Free-list
+            # only (reclaim=False): the soft claim may not evict prefix
+            # entries — eviction pressure is reserved for real work
+            upto = min(st.pos + 1 + self.spec_tokens, self.max_length)
+            need = self._pool.pages_for(upto) - len(st.pages)
+            if need <= 0:
+                continue
+            pages = self._claim_pages(need, reclaim=False)
+            if pages is None:
+                self._spec_pages_ok = False
+                continue
+            base = len(st.pages)
+            st.pages.extend(pages)
+            self._page_table[slot, base:base + need] = pages
+            self._table_dirty()
 
-    def _scrub_pages(self, freed):  # guarded-by: _step_lock
+    def _scrub_pages(self, freed, count: bool = True):  # guarded-by: _step_lock
         """Zero freed pages after a non-finite failure: NaN K/V written
         by the victim survives ADDITIVE masking (flash-kernel style),
         so a later tenant of the page must never see it.  Pages still
         referenced are untouched — a shared prefix page was written
         only by clean prefill, and its readers' copies must not be
-        zeroed under them."""
+        zeroed under them.  ``count=False`` callers (the speculative
+        rewind) keep their own counter — ``pages_scrubbed`` stays the
+        NaN-hygiene signal."""
         if not freed or self._caches is None:
             return
         import jax
@@ -2142,7 +2457,8 @@ class InferenceEngine:
         pids = jnp.asarray(freed, jnp.int32)
         self._caches = jax.tree_util.tree_map(
             lambda a: a.at[pids].set(0), self._caches)
-        self.metrics.count("pages_scrubbed", len(freed))
+        if count:
+            self.metrics.count("pages_scrubbed", len(freed))
 
     # ------------------------------------------------------------ admission
     def _admit(self, live):
@@ -2252,12 +2568,14 @@ class InferenceEngine:
         self.metrics.count("prefill_batches")
         self._ensure_caches()
         tbl = (self._table_arg(),) if self._paged else ()
+        samp = tuple(jnp.asarray(a) for a in self._samp_rows(
+            [st.request for _s, st in rows], bb))
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
-             self._caches, jnp.asarray(sidx)) + tbl,
+             self._caches, jnp.asarray(sidx)) + samp + tbl,
             [st.request for _s, st in rows])
         if tr is not None:
             # ONE span for the batched device call, carrying every
@@ -2304,12 +2622,15 @@ class InferenceEngine:
         self.metrics.count("prefill_chunks")
         self._ensure_caches()
         tbl = (self._table_arg(),) if self._paged else ()
+        samp = tuple(jnp.asarray(a) for a in self._samp_rows(
+            [st.request for _s, st in rows], bb))
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
         first, ok, self._caches = self._run_step(
             "serving.prefill", ("chunk", bb, tb), self._jit_chunk,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
-             self._caches, jnp.asarray(sidx), jnp.asarray(off)) + tbl,
+             self._caches, jnp.asarray(sidx), jnp.asarray(off)) + samp
+            + tbl,
             [st.request for _s, st in rows])
         if tr is not None:
             tr.record_span(
@@ -2389,25 +2710,52 @@ class InferenceEngine:
             self._release(slot)
             self._complete(st)
 
+    def _decode_rows(self):  # guarded-by: _step_lock
+        """The fixed-shape per-slot decode arrays (tokens, positions,
+        sampling params) plus the riding (slot, state) pairs — shared
+        by the plain step and the speculative draft/verify cycle.
+
+        Idle rows (free slots, the scratch row, and slots still mid-
+        chunked-prefill) park at position Tmax: their fixed-shape K/V
+        write becomes an out-of-bounds scatter, which jax DROPS — they
+        must not write at position 0, where a mid-prefill slot already
+        holds real (copied or chunk-prefilled) prefix K/V."""
+        s1 = self.num_slots + 1
+        tok = onp.zeros((s1,), "int32")
+        pos = onp.full((s1,), self.max_length, "int32")
+        temp = onp.zeros((s1,), "float32")
+        topk = onp.zeros((s1,), "int32")
+        topp = onp.ones((s1,), "float32")
+        keys = onp.zeros((s1, 2), "uint32")
+        riders = []
+        for slot, st in self._alloc.items():
+            if st.prefilling or st.waiting:
+                continue             # waiting = page allocation deferred
+            r = st.request
+            tok[slot] = st.last_token
+            pos[slot] = st.pos
+            temp[slot] = r.temperature
+            topk[slot] = r.top_k
+            topp[slot] = r.top_p
+            keys[slot] = r.key
+            riders.append((slot, st))
+        return tok, pos, (temp, topk, topp, keys), riders
+
     def _decode_step(self):  # guarded-by: _step_lock
         import jax.numpy as jnp
 
         alloc = self._alloc
-        s1 = self.num_slots + 1
-        tok = onp.zeros((s1,), "int32")
-        # idle rows (free slots, the scratch row, and slots still mid-
-        # chunked-prefill) park at position Tmax: their fixed-shape K/V
-        # write becomes an out-of-bounds scatter, which jax DROPS — they
-        # must not write at position 0, where a mid-prefill slot already
-        # holds real (copied or chunk-prefilled) prefix K/V
-        pos = onp.full((s1,), self.max_length, "int32")
-        riders = []
-        for slot, st in alloc.items():
-            if st.prefilling or st.waiting:
-                continue             # waiting = page allocation deferred
-            tok[slot] = st.last_token
-            pos[slot] = st.pos
-            riders.append(st.request)
+        tok, pos, samp, slot_riders = self._decode_rows()
+        riders = [st.request for _s, st in slot_riders]
+        if self._paged and self.spec_tokens:
+            # a degraded/fallback cycle RETURNS the soft window claims
+            # _grow_pages made for the speculation that is not running:
+            # holding them under pool pressure would let an
+            # optimization's claims force real work to park (the
+            # documented never-parks-a-victim contract).  Never written
+            # (no verify ran past the trim boundary), so no scrub.
+            for slot, st in slot_riders:
+                self._rewind_pages(slot, st, scrub=False)
         self.metrics.count("decode_steps")
         tbl = (self._table_arg(),) if self._paged else ()
         tr = _trace_active()
@@ -2415,7 +2763,8 @@ class InferenceEngine:
         nxt, ok, self._caches = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
-             jnp.asarray(pos)) + tbl, riders)
+             jnp.asarray(pos))
+            + tuple(jnp.asarray(a) for a in samp) + tbl, riders)
         if tr is not None:
             tr.record_span(
                 "serving.decode_step", t0, time.monotonic(),
@@ -2432,6 +2781,163 @@ class InferenceEngine:
                 continue
             st.advance(int(nxt[slot]))
             self._finish_if_done(slot, st)
+
+    # ------------------------------------------------------- speculative
+    def _spec_fault(self, where: str):  # guarded-by: _step_lock
+        """Contain a fault at a serving.draft/serving.verify site:
+        speculation is an optimization layer and must never fail a
+        request — the cycle degrades to plain one-token decode and the
+        riders lose nothing but speed."""
+        self.metrics.count("spec_faults")
+        self.metrics.mark("spec_fault", where)
+
+    def _spec_step(self):  # guarded-by: _step_lock
+        """One speculative decode cycle (docs/serving.md "Speculative
+        decode"): ONE compiled draft call proposes ``k`` tokens per
+        slot (early-exit drafter, read-only on the caches), ONE
+        batched verify forward writes the window's K/V and samples the
+        model's own token at every window position, and the host
+        accepts each slot's longest draft prefix that matches the
+        verify samples plus the first non-matching verify token — so
+        every accepted token is EXACTLY the token the non-speculative
+        engine would have produced (greedy: longest argmax match), and
+        a cycle banks between 1 and k+1 tokens for two dispatches.
+
+        Rejected tokens rewind by bookkeeping: ``pos`` simply stops at
+        the last accepted token, the stale K/V beyond it is rewritten
+        before it can be attended (the chunk-padding argument), and
+        under the paged layout any page claimed past the rewound
+        boundary is scrubbed and released back to the pool
+        (:meth:`_rewind_pages`)."""
+        import jax.numpy as jnp
+
+        alloc = self._alloc
+        k = self.spec_tokens
+        tok, pos, samp, slot_riders = self._decode_rows()
+        if not slot_riders or all(st.remaining <= 1
+                                  for _s, st in slot_riders):
+            # nothing to speculate ON: every rider needs exactly one
+            # more token, so a verify window would be pure overhead
+            self._decode_step()
+            return
+        riders = [st.request for _s, st in slot_riders]
+        samp_j = tuple(jnp.asarray(a) for a in samp)
+        tbl = (self._table_arg(),) if self._paged else ()
+        tr = _trace_active()
+        tids = tuple(r.trace_id for r in riders
+                     if r.trace_id is not None)
+        # NaN-poisoned drafter (chaos spec_storm): the poison rides the
+        # draft program as a traced scalar, so the splice recompiles
+        # nothing and the garbage proposals flow through the REAL
+        # rejection path
+        bad = _poison("serving.draft_logits")
+        pois = jnp.asarray(bad if bad is not None else 0.0, jnp.float32)
+        t0 = time.monotonic() if tr is not None else 0.0
+        try:
+            # riders=() — like the prefix copy, the draft must degrade
+            # on a retryable fault immediately, never spend the
+            # requests' retry budgets (which the mandatory verify or a
+            # fallback decode step may later need)
+            draft = self._run_step(
+                "serving.draft", ("draft",), self._jit_draft,
+                (self._params(), jnp.asarray(tok), self._caches,
+                 jnp.asarray(pos)) + samp_j + (pois,) + tbl, ())
+        except Exception:
+            # injection fires BEFORE dispatch and the draft is
+            # read-only on every shared buffer either way: plain
+            # decode this cycle is always safe
+            self._spec_fault("draft")
+            self._decode_step()
+            return
+        if tr is not None:
+            tr.record_span("serving.draft", t0, time.monotonic(),
+                           trace_ids=tids, tokens=k)
+        # window = [last_token, d_1..d_k]; stays on device for the
+        # verify, comes to host only for the acceptance scan
+        toks2 = jnp.concatenate(
+            [jnp.asarray(tok)[:, None], draft], axis=1)
+        t0 = time.monotonic() if tr is not None else 0.0
+        try:
+            vt, ok, self._caches = self._run_step(
+                "serving.verify", ("verify",), self._jit_verify,
+                (self._params(), toks2, self._caches,
+                 jnp.asarray(pos)) + samp_j + tbl, ())
+        except Exception:
+            self._spec_fault("verify")
+            self._decode_step()
+            return
+        if tr is not None:
+            tr.record_span("serving.verify", t0, time.monotonic(),
+                           trace_ids=tids, tokens=k + 1)
+        self.metrics.count("spec_cycles")
+        draft = onp.asarray(draft)
+        vt = onp.asarray(vt)
+        ok = onp.asarray(ok)
+        n_prop = n_acc = 0
+        for slot, st in slot_riders:
+            req = st.request
+            if self.guard_nonfinite and not ok[slot]:
+                # non-finite logits anywhere in the window: the verify
+                # wrote that window's K/V, so the standard scrub-on-NaN
+                # release covers exactly the poisoned pages
+                self._fail_nonfinite(slot, st, "decode")
+                continue
+            # a slot whose budget caps the window could never accept
+            # more than `remaining` drafts: counting the full k as
+            # proposed would bias the acceptance rate — the documented
+            # drafter-quality signal — low on short-budget traffic
+            n_prop += min(k, st.remaining)
+            accepted = []
+            for i in range(k + 1):
+                if st.remaining - len(accepted) <= 0:
+                    break
+                t = int(vt[slot, i])
+                accepted.append(t)
+                matched = i < k and int(draft[slot, i]) == t
+                if matched:
+                    n_acc += 1       # draft token i confirmed — counts
+                    #                  even when it is the eos below
+                if req.eos_id is not None and t == req.eos_id:
+                    # matched-draft eos still ends the request — the
+                    # non-speculative engine would have stopped here
+                    break
+                if not matched:
+                    # v[i+1] conditioned on a rejected draft token:
+                    # invalid, stop at the correction/bonus token
+                    break
+            st.advance_many(accepted)
+            if self._paged:
+                self._rewind_pages(slot, st)
+            self._finish_if_done(slot, st)
+        self.metrics.count("spec_tokens_proposed", n_prop)
+        self.metrics.count("spec_tokens_accepted", n_acc)
+
+    def _rewind_pages(self, slot, st, scrub=True):  # guarded-by: _step_lock
+        """Release pages claimed past the rewound speculation boundary:
+        the slot needs coverage through its next write position
+        (``st.pos``) only.  After a verify ran, freed pages are
+        SCRUBBED before they return to the pool — their window K/V is
+        finite whenever the verify's guard passed, but a page crossing
+        tenants carries no provenance, and zeroing the rare
+        rejected-boundary page is cheaper than reasoning about it ever
+        after.  ``scrub=False`` is the degraded-cycle return path: the
+        claims were never written, and a still-dirty page from an older
+        NaN tenant keeps its mark (scrubbed lazily at its next claim,
+        as ever)."""
+        keep = self._pool.pages_for(st.pos + 1)
+        if len(st.pages) <= keep:
+            return
+        tail = st.pages[keep:]
+        del st.pages[keep:]
+        self._page_table[slot, keep:keep + len(tail)] = \
+            self._pool.scratch
+        self._table_dirty()
+        freed = self._pool.release(tail)
+        if freed:
+            if scrub:
+                self._scrub_pages(freed, count=False)
+                self._pool.dirty.difference_update(freed)
+            self.metrics.count("spec_pages_rewound", len(freed))
 
     # ----------------------------------------------------------- forward path
     def _forward_cycle(self):

@@ -132,6 +132,13 @@ class PagePool:
             return True
         return False
 
+    def release(self, pids) -> List[int]:
+        """Drop one reader from each of ``pids``; returns the ids that
+        actually FREED (last reader gone).  The shared body of a slot
+        release and of the speculative-decode page rewind — the caller
+        owns scrubbing the freed ids where hygiene demands it."""
+        return [pid for pid in pids if self.unref(pid)]
+
     def mark_dirty(self, pids):
         """Record pages that hold non-finite K/V but are still
         referenced (the scrub-on-NaN path could not zero them); the

@@ -77,12 +77,29 @@ class SlotState:
     def prefilling(self) -> bool:
         return self.last_token is None
 
+    @property
+    def remaining(self) -> int:
+        """Decode budget left — what speculation may accept at most."""
+        return self.max_new_tokens - len(self.generated)
+
     def advance(self, token: int):
         """Record one generated token; generated[i] sits at position
         prompt_len + i, so pos tracks the LAST token's position."""
         self.generated.append(token)
         self.last_token = token
         self.pos = self.prompt_len + len(self.generated) - 1
+
+    def advance_many(self, tokens):
+        """Record a speculation cycle's ACCEPTED tokens in order.  The
+        invariant is unchanged — ``pos`` ends at the LAST accepted
+        token's position, so K/V the verify forward wrote BEYOND the
+        accepted prefix sit past ``pos`` and are rewritten before they
+        can be attended (the same argument chunk padding relies on):
+        rejected speculation rewinds by simply not advancing, which is
+        also why preemption always parks at the last accepted position,
+        never mid-draft."""
+        for t in tokens:
+            self.advance(t)
 
 
 class SlotAllocator:

@@ -153,6 +153,17 @@ class ServingMetrics:
                  # throughput but their wall time never reaches the
                  # histogram, so they must not dilute per-token cost)
                  "decode_tokens_observed",
+                 # speculative decode (docs/serving.md "Speculative
+                 # decode"): draft+verify cycles run, draft tokens
+                 # proposed vs accepted (their ratio is the acceptance
+                 # rate the drafter is judged by), contained faults at
+                 # the serving.draft / serving.verify sites (each
+                 # degrades that cycle to plain one-token decode), and
+                 # pages released by the paged-KV rewind of rejected
+                 # speculation
+                 "spec_cycles", "spec_tokens_proposed",
+                 "spec_tokens_accepted", "spec_faults",
+                 "spec_pages_rewound",
                  # paged KV layout (docs/serving.md "Paged KV"):
                  # page-pool exhaustion / contained page_alloc-fault
                  # events (each degrades to an alloc retry or a
@@ -349,6 +360,20 @@ class ServingMetrics:
                 if pref else None,
             },
             "ttft": ttft,
+            # speculative decode (docs/serving.md): acceptance_rate is
+            # accepted / proposed DRAFT tokens (the bonus token every
+            # cycle banks is not "proposed", so a dead drafter reads
+            # 0.0, not 1/k)
+            "speculative": {
+                "spec_cycles": c["spec_cycles"],
+                "spec_tokens_proposed": c["spec_tokens_proposed"],
+                "spec_tokens_accepted": c["spec_tokens_accepted"],
+                "spec_faults": c["spec_faults"],
+                "spec_pages_rewound": c["spec_pages_rewound"],
+                "acceptance_rate": round(
+                    c["spec_tokens_accepted"] / c["spec_tokens_proposed"],
+                    4) if c["spec_tokens_proposed"] else None,
+            },
             # per-class accounting of graceful degradation
             # (docs/overload.md); the engine overlays its controller
             # snapshot under stats()["overload"]["controller"]

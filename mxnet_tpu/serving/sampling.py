@@ -1,0 +1,109 @@
+"""Per-request seeded sampling for the batched serving programs.
+
+One in-graph sampler serves every compiled decode/prefill/draft/verify
+program (docs/serving.md "Sampling & speculative decode"): each row of a
+batched logits matrix carries its OWN ``(temperature, top_k, top_p,
+key)`` — all traced arguments, so a greedy row and a temperature-0.9
+row ride the same XLA program and the bucket lattice (and the
+``warmup()`` compile freeze) is untouched.
+
+Determinism contract: a request's token at absolute position ``p`` is
+drawn from ``jax.random.categorical(fold_in(key, p), filtered_logits)``
+— a pure function of (request seed, position, logits).  That buys three
+properties the engine leans on hard:
+
+- **per-request determinism**: same prompt + seed → same stream, no
+  matter what else shares the batch or how admission interleaves;
+- **preemption/rewind safety**: a resumed continuation re-samples at
+  the SAME absolute positions with the SAME key, so parking and
+  failover are token-identical even for sampled requests;
+- **speculative parity**: the verify forward samples each window
+  position with the same (key, position) the non-speculative engine
+  would have used, so accepting the longest draft match reproduces the
+  non-speculative stream EXACTLY — speculation changes speed, never
+  tokens (the greedy case degenerates to longest-exact-argmax-match).
+
+``temperature <= 0`` selects the exact ``argmax`` branch — bit-identical
+to the pre-sampling engine, which is what keeps every greedy parity
+test (engine vs ``net.generate`` vs paged vs speculative) pinned.
+``jax.random.categorical`` is itself Gumbel-argmax, so sampled rows
+match ``net.generate``'s sampler exactly where the filters agree.
+"""
+from __future__ import annotations
+
+__all__ = ["sample_tokens", "request_key"]
+
+#: the additive mask value for filtered-out logits — matches
+#: ``net.generate``'s top-k mask and the attention masks elsewhere in
+#: the tree (a true -inf would breed NaN through 0 * inf in corner
+#: reductions)
+_NEG = -1e30
+
+
+def request_key(seed: int):
+    """The host-side ``(2,)`` uint32 PRNG key for a request seed.
+
+    This is ``jax.random.PRNGKey(seed)``'s threefry packing computed
+    without a device op — ``submit()`` runs on the caller's thread and
+    must not pay a device round trip per request.  The engine only ever
+    compares streams drawn with THESE keys against each other and
+    against ``net.generate(seed=...)`` (same packing), so the contract
+    is internal consistency, not cross-impl portability.
+    """
+    import numpy as onp
+    s = int(seed)
+    return onp.array([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF],
+                     dtype=onp.uint32)
+
+
+def sample_tokens(logits, temperature, top_k, top_p, keys, positions):
+    """Draw one token per row of ``logits`` (B, V), in-graph.
+
+    ``temperature``/``top_p`` float32 (B,), ``top_k`` int32 (B,),
+    ``keys`` uint32 (B, 2) per-request PRNG keys, ``positions`` int32
+    (B,) the absolute position of the token each row just CONSUMED —
+    the fold constant, so a given request's draw at a given position is
+    reproducible regardless of batch composition.  Per-row semantics:
+
+    - ``temperature <= 0``: exact argmax (greedy), bypassing the
+      sampling math entirely for that row's result;
+    - ``top_k > 0``: keep only the k highest logits (ties at the kth
+      value are kept, matching ``net.generate``);
+    - ``top_p < 1``: nucleus filter over the (already top-k-filtered)
+      distribution — keep the smallest set of tokens whose cumulative
+      probability reaches ``top_p`` (the top-1 token always survives).
+
+    Returns int32 (B,) tokens.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    v = logits.shape[-1]
+    greedy = temperature <= 0.0
+    arg = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / \
+        jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: the kth-largest value per row is the cutoff; rows with
+    # top_k == 0 keep everything
+    desc = -jnp.sort(-lg, axis=-1)
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)
+    lg = jnp.where((top_k[:, None] > 0) & (lg < kth), _NEG, lg)
+    # top-p over the filtered logits: in sorted space, keep tokens whose
+    # EXCLUSIVE cumulative probability is still below p (the first token
+    # has exclusive mass 0, so the nucleus is never empty), then map the
+    # cutoff VALUE back to the unsorted rows
+    desc = -jnp.sort(-lg, axis=-1)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.maximum(
+        jnp.sum(cum < jnp.minimum(top_p, 1.0)[:, None], axis=-1), 1)
+    cut = jnp.take_along_axis(desc, (n_keep - 1)[:, None], axis=-1)
+    lg = jnp.where((top_p[:, None] < 1.0) & (lg < cut), _NEG, lg)
+    # per-row seeded draw: fold the request key with the row's absolute
+    # position, then categorical (= Gumbel-argmax, the same sampler
+    # net.generate uses — engine-vs-generate sampled parity holds
+    # wherever the filters agree)
+    folded = jax.vmap(jax.random.fold_in)(keys, positions)
+    samp = jax.vmap(jax.random.categorical)(folded, lg).astype(jnp.int32)
+    return jnp.where(greedy, arg, samp)

@@ -1,0 +1,471 @@
+"""Speculative multi-token decode + per-request sampling
+(docs/serving.md "Sampling & speculative decode").
+
+Contracts under test: speculation changes SPEED, never tokens —
+greedy decode through a speculating engine is token-identical to the
+plain engine, to the paged engine and to ``net.generate``; sampled
+streams are identical with speculation on or off (and match
+``generate`` where the filters agree); rejected speculation rewinds
+paged claims refcount-clean; ``spec_tokens=0`` is exactly the
+pre-speculation engine; and draft/verify faults degrade to plain
+decode without failing a request or spending its retry budget.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import (InferenceEngine, InvalidRequestError,
+                               sample_tokens, request_key)
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=VOCAB, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    return InferenceEngine(net, **kw)
+
+
+# --------------------------------------------------------- greedy parity
+
+def test_spec_greedy_parity_across_buckets_and_compile_freeze(net):
+    """THE acceptance contract: a mixed-length concurrent greedy
+    workload through a speculating engine is token-identical to
+    per-request ``net.generate``, with the compile counter FROZEN
+    after a warmup that covered the extended (bucket, k) lattice."""
+    prompts = _prompts((3, 5, 9, 12, 5, 7, 16, 2))
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, spec_tokens=3, draft_layers=1)
+    n_warm = eng.warmup()
+    # full + chunk lattices, decode, prefix copy, + draft + verify
+    assert n_warm <= 2 * len(eng.lattice) + 4
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["compile_cache"]["compiles"] == n_warm
+    sp = s["speculative"]
+    assert sp["spec_cycles"] >= 1
+    assert sp["spec_tokens_proposed"] > 0
+    assert s["engine"]["spec_tokens"] == 3
+
+
+def test_spec_greedy_parity_paged_layout(net):
+    """Speculation composes with the paged KV layout: parity vs
+    generate, window pages claimed softly and rewound on rejection,
+    refcounts clean after drain (every page back on the free list)."""
+    prompts = _prompts((3, 6, 10, 13), seed=3)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 10,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, kv_layout="paged", page_size=4, spec_tokens=3,
+                  draft_layers=1, prefix_min_tokens=64)
+    n_warm = eng.warmup()
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        s = eng.stats()
+        # prefix inserts disabled (min_tokens > prompts): every page
+        # must be back on the free list once all requests completed
+        assert eng._pool.free_count == eng.num_pages
+        assert all(r == 0 for r in eng._pool._refs)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["compile_cache"]["compiles"] == n_warm
+
+
+def test_spec_rewind_releases_pages(net):
+    """Rejected speculation that crossed a page boundary RELEASES the
+    over-claimed pages (spec_pages_rewound moves) and never strands a
+    claim.  A permanently NaN-poisoned drafter makes rejection
+    deterministic — every cycle collapses to ~1 accepted token while
+    the window claimed pages ahead, so boundary-crossing rewinds are
+    guaranteed (and the output stays token-identical to generate:
+    garbage proposals cost speed, never correctness)."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((3, 6, 10, 13), seed=3)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 12,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, kv_layout="paged", page_size=4, spec_tokens=3,
+                  draft_layers=1, prefix_min_tokens=64)
+    eng.warmup()
+    with FaultPlan().nonfinite_at("serving.draft_logits", every=1):
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=12)
+                    for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            s = eng.stats()
+            assert eng._pool.free_count == eng.num_pages
+            assert all(r == 0 for r in eng._pool._refs)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    sp = s["speculative"]
+    assert sp["spec_tokens_accepted"] < sp["spec_tokens_proposed"]
+    assert sp["spec_pages_rewound"] >= 1
+
+
+# -------------------------------------------------------- sampled parity
+
+def test_sampled_streams_identical_spec_on_off(net):
+    """Distribution-identity made testable: at a fixed per-request
+    seed the sampled token STREAMS are identical with speculation on
+    or off (the verify forward samples each position with exactly the
+    key+position the plain engine would), across temperature, top-k
+    and top-p settings — and deterministic across runs."""
+    prompts = _prompts((4, 6, 9, 5), seed=2)
+    kw = [dict(temperature=0.8, seed=7),
+          dict(temperature=1.2, top_k=12, seed=11),
+          dict(temperature=0.7, top_k=5, top_p=0.9, seed=3),
+          dict()]                                    # greedy rider
+
+    def run(spec):
+        eng = _engine(net, spec_tokens=3 if spec else 0, draft_layers=1)
+        eng.warmup()
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8, **k)
+                    for p, k in zip(prompts, kw)]
+            return [f.result(timeout=120) for f in futs]
+
+    off = run(False)
+    on = run(True)
+    for a, b in zip(off, on):
+        onp.testing.assert_array_equal(a, b)
+    for a, b in zip(off, run(False)):        # deterministic re-run
+        onp.testing.assert_array_equal(a, b)
+    # a different seed must move a sampled stream (vocab 97, 8 draws:
+    # collision odds are negligible and the fixture is deterministic)
+    eng = _engine(net)
+    eng.warmup()
+    with eng:
+        alt = eng.infer(prompts[0], max_new_tokens=8, temperature=0.8,
+                        seed=8)
+    assert not onp.array_equal(off[0], alt)
+
+
+def test_sampled_parity_vs_generate(net):
+    """The engine's sampler IS ``net.generate``'s sampler: same
+    categorical(fold_in(key, position)) rule, so at matching
+    temperature/top_k/seed the engine stream equals the fused-loop
+    generate stream — speculation on or off."""
+    p = _prompts((6,), seed=4)[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                       temperature=1.1, top_k=9, seed=5).asnumpy()[0]
+    for spec in (0, 2):
+        eng = _engine(net, spec_tokens=spec, draft_layers=1)
+        eng.warmup()
+        with eng:
+            out = eng.infer(p, max_new_tokens=8, temperature=1.1,
+                            top_k=9, seed=5)
+        onp.testing.assert_array_equal(ref, out)
+
+
+def test_sampled_preemption_resumes_token_identical(net):
+    """Sampling folds the request key with ABSOLUTE positions, so a
+    preempted sampled request resumes to the exact same stream (the
+    overload guarantee used to be greedy-only)."""
+    from mxnet_tpu.serving import Request
+    import time
+    ref_eng = _engine(net, num_slots=2, max_batch=2)
+    ref_eng.warmup()
+    p = _prompts((6,), seed=9)[0]
+    with ref_eng:
+        ref = ref_eng.infer(p, max_new_tokens=30, temperature=0.9,
+                            seed=13)
+    eng = _engine(net, num_slots=1, max_batch=1, prefix_pool_rows=2,
+                  prefix_min_tokens=2, default_priority="best_effort")
+    eng.warmup()
+    with eng:
+        victim = eng.submit(p, max_new_tokens=30, temperature=0.9,
+                            seed=13, priority="best_effort")
+        deadline = time.monotonic() + 30
+        while eng.metrics.counters["decode_steps"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)       # victim decoding, slot occupied
+        hog = eng.submit(_prompts((4,), seed=10)[0], max_new_tokens=2,
+                         priority="interactive")
+        out = victim.result(timeout=120)
+        hog.result(timeout=120)
+        s = eng.stats()
+    onp.testing.assert_array_equal(ref, out)
+    assert s["overload"]["preemptions"] >= 1
+
+
+# ------------------------------------------------------------- k=0 / eos
+
+def test_spec_zero_is_the_plain_engine(net):
+    """``spec_tokens=0`` compiles NO draft/verify programs and runs
+    the plain decode path — the exact pre-speculation engine."""
+    eng = _engine(net)
+    n_warm = eng.warmup()
+    assert eng._jit_draft is None and eng._jit_verify is None
+    assert n_warm <= 2 * len(eng.lattice) + 2
+    p = _prompts((5,), seed=12)[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 6,
+                       temperature=0).asnumpy()[0]
+    with eng:
+        out = eng.infer(p, max_new_tokens=6)
+    onp.testing.assert_array_equal(ref, out)
+    s = eng.stats()
+    assert s["speculative"]["spec_cycles"] == 0
+    assert s["speculative"]["spec_tokens_proposed"] == 0
+
+
+def test_spec_eos_stops_inside_window(net):
+    """An eos token ACCEPTED mid-window ends the request exactly where
+    the plain engine would — no token beyond eos is ever accepted."""
+    p = _prompts((6,), seed=4)[0]
+    ref = net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                       temperature=0).asnumpy()[0]
+    gen = ref[len(p):]
+    eos = int(gen[2])
+    stop_at = int(onp.argmax(gen == eos))
+    eng = _engine(net, spec_tokens=3, draft_layers=1)
+    eng.warmup()
+    with eng:
+        out = eng.infer(p, max_new_tokens=8, eos_id=eos)
+    assert len(out) == len(p) + stop_at + 1 and out[-1] == eos
+    onp.testing.assert_array_equal(ref[:len(out)], out)
+
+
+# -------------------------------------------------------------- faults
+
+def test_spec_fault_containment(net):
+    """Faults at serving.draft / serving.verify degrade that cycle to
+    plain one-token decode: tokens stay correct, nothing fails,
+    nothing is retried (speculation never spends request budgets)."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((4, 7, 9), seed=41)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, num_slots=3, max_batch=3, spec_tokens=2,
+                  draft_layers=1)
+    n_warm = eng.warmup()
+    plan = (FaultPlan()
+            .raise_at("serving.draft", at=1)
+            .raise_at("serving.verify", at=1, retryable=True)
+            .raise_at("serving.verify", at=3))
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["requests"]["completed"] == len(prompts)
+    assert s["speculative"]["spec_faults"] >= 3
+    assert s["resilience"]["retries"] == 0
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert plan.fired("serving.draft") == 1
+    assert plan.fired("serving.verify") == 2
+
+
+def test_spec_poisoned_draft_logits_contained(net):
+    """A NaN-poisoned draft head (the serving.draft_logits NUMERIC
+    site) produces garbage proposals — the verify forward rejects
+    them, outputs stay token-identical, no request fails, and the NaN
+    never reaches the shared caches (the drafter is read-only)."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((4, 8), seed=51)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, num_slots=2, max_batch=2, spec_tokens=2,
+                  draft_layers=1)
+    eng.warmup()
+    plan = FaultPlan().nonfinite_at("serving.draft_logits", every=1)
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            # the caches the poisoned drafts read stay NaN-free
+            clean = all(
+                bool(onp.isfinite(onp.asarray(a)).all())
+                for layer in eng._caches for a in layer.values())
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert clean
+    assert plan.fired("serving.draft_logits") >= 1
+    s = eng.stats()
+    assert s["requests"]["completed"] == len(prompts)
+    assert s["requests"].get("timeouts", 0) == 0
+
+
+# ------------------------------------------------------- config / units
+
+def test_spec_config_validation(net):
+    with pytest.raises(mx.MXNetError):
+        _engine(net, spec_tokens=-1)
+    with pytest.raises(mx.MXNetError):
+        _engine(net, spec_tokens=2, draft_layers=2)   # == num_layers
+    with pytest.raises(mx.MXNetError):
+        _engine(net, spec_tokens=2, draft_layers=0)
+    from mxnet_tpu.gluon import nn
+    dense = nn.Dense(8, in_units=16)
+    dense.initialize()
+    with pytest.raises(mx.MXNetError):
+        InferenceEngine(dense, spec_tokens=2)         # forward mode
+    eng = _engine(net)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(_prompts((4,))[0], temperature=-1.0)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(_prompts((4,))[0], top_p=0.0)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(_prompts((4,))[0], top_k=-3)
+    with pytest.raises(InvalidRequestError):
+        eng.submit(_prompts((4,))[0], temperature=float("nan"))
+    assert eng.stats()["requests"]["rejected_invalid"] == 4
+
+
+def test_sample_tokens_unit_semantics():
+    """In-graph sampler unit contract: greedy rows take the exact
+    argmax; top-k=1 forces the argmax even at high temperature; top-p
+    always keeps the top-1 token; per-row keys decorrelate rows."""
+    import jax.numpy as jnp
+    rs = onp.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(4, 33).astype("float32"))
+    keys = jnp.asarray(onp.stack([request_key(i) for i in range(4)]))
+    pos = jnp.asarray(onp.arange(4, dtype="int32"))
+    arg = onp.argmax(onp.asarray(logits), axis=-1)
+    # greedy
+    out = sample_tokens(logits, jnp.zeros((4,)), jnp.zeros((4,), jnp.int32),
+                        jnp.ones((4,)), keys, pos)
+    onp.testing.assert_array_equal(onp.asarray(out), arg)
+    # top_k=1 == greedy regardless of temperature
+    out = sample_tokens(logits, jnp.full((4,), 5.0),
+                        jnp.ones((4,), jnp.int32), jnp.ones((4,)),
+                        keys, pos)
+    onp.testing.assert_array_equal(onp.asarray(out), arg)
+    # tiny top_p == greedy (nucleus collapses to the top-1 token)
+    out = sample_tokens(logits, jnp.full((4,), 5.0),
+                        jnp.zeros((4,), jnp.int32),
+                        jnp.full((4,), 1e-6), keys, pos)
+    onp.testing.assert_array_equal(onp.asarray(out), arg)
+    # same logits, different keys: rows draw independently (at high
+    # temperature the distribution is near-uniform over 33 tokens, so
+    # 4 identical draws would be a ~1e-5 coincidence; fixed seeds make
+    # this deterministic, and the fixture was checked to differ)
+    same = jnp.tile(logits[:1], (4, 1))
+    out = sample_tokens(same, jnp.full((4,), 3.0),
+                        jnp.zeros((4,), jnp.int32), jnp.ones((4,)),
+                        keys, pos)
+    assert len(set(onp.asarray(out).tolist())) > 1
+
+
+def test_spec_window_claims_released_under_pool_pressure(net):
+    """Speculation's soft window claims must never park real work: a
+    pool with room for the base footprints but NOT for speculation
+    windows degrades cycles to plain decode AND returns the claims —
+    every request completes with zero preemptions (before the release,
+    a degraded cycle left its window pages claimed on live slots, and
+    the next slot's base growth page-faulted into parking a victim for
+    an optimization that never ran)."""
+    prompts = _prompts((8, 8), seed=77)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 24,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    # 2 slots x worst case (32/8 = 4 pages) exactly: zero headroom for
+    # any window claim once both requests approach full length
+    eng = _engine(net, num_slots=2, max_batch=2, kv_layout="paged",
+                  page_size=8, num_pages=8, spec_tokens=3,
+                  draft_layers=1, prefix_min_tokens=64)
+    eng.warmup()
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=24) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        s = eng.stats()
+        assert eng._pool.free_count == eng.num_pages
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["overload"]["preemptions"] == 0
+    assert s["requests"]["completed"] == 2
+
+
+def test_spec_soft_claims_never_evict_prefix_entries(net):
+    """The speculation window's soft page claim allocates from the
+    free list ONLY: it must not evict cached prefixes (future TTFT) to
+    fund an optimization — under window pressure the cycle degrades to
+    plain decode and the prefix entry survives."""
+    seeds = _prompts((8, 8, 8, 8), seed=83)
+    runner = _prompts((8,), seed=85)[0]
+    ref = net.generate(mx.nd.array(runner[None], dtype="int32"), 24,
+                       temperature=0).asnumpy()[0]
+    # pool 8 = worst case exactly; four cached 1-page prefixes leave 4
+    # free pages — precisely the runner's base footprint (8 + 24 = 32
+    # positions), so its speculation-window claims past position 28 can
+    # only be met by evicting an entry, which soft claims must never do
+    eng = _engine(net, num_slots=1, max_batch=1, kv_layout="paged",
+                  page_size=8, num_pages=8, spec_tokens=3,
+                  draft_layers=1, prefix_min_tokens=2)
+    eng.warmup()
+    with eng:
+        for p in seeds:
+            eng.infer(p, max_new_tokens=8)
+        assert len(eng._prefix) >= 4       # four 1-page claims live
+        out = eng.infer(runner, max_new_tokens=24)
+        # the runner's window pressure degraded to plain decode
+        # instead of stripping the cache: every entry survived, and a
+        # re-serve of a seed prompt still hits
+        assert len(eng._prefix) >= 4
+        hits0 = eng.metrics.counters["prefix_hits"]
+        eng.infer(seeds[0], max_new_tokens=8)
+        assert eng.metrics.counters["prefix_hits"] > hits0
+    onp.testing.assert_array_equal(ref, out)
+
+
+def test_fleet_sampled_passthrough(net):
+    """The fleet tier fronts the SAME submit surface: sampling params
+    ride placement (and failover/hedge attempts carry them), and the
+    absolute-position fold makes the fleet stream equal the
+    single-engine stream."""
+    from mxnet_tpu.fleet import FleetRouter
+    p = _prompts((6,), seed=91)[0]
+    eng = _engine(net)
+    eng.warmup()
+    with eng:
+        ref = eng.infer(p, max_new_tokens=8, temperature=0.9, top_k=11,
+                        seed=17)
+
+    def factory(name):
+        return _engine(net, name=name)
+
+    fleet = FleetRouter(factory=factory, num_replicas=2,
+                        name="spec_fleet_test")
+    fleet.warmup()
+    with fleet:
+        out = fleet.infer(p, max_new_tokens=8, temperature=0.9,
+                          top_k=11, seed=17)
+    onp.testing.assert_array_equal(ref, out)
+
+
+def test_spec_registry_gauges(net):
+    """Acceptance-rate and draft-depth gauges land in the process-wide
+    registry under the engine's label."""
+    from mxnet_tpu.observability import flatten
+    eng = _engine(net, spec_tokens=2, draft_layers=1,
+                  name="spec_gauge_test")
+    eng.warmup()
+    with eng:
+        eng.infer(_prompts((5,), seed=60)[0], max_new_tokens=6)
+        flat = flatten(prefix="mxtpu_serving_spec", include_zero=True)
+    lbl = f'{{engine="{eng.name}"}}'
+    assert flat[f"mxtpu_serving_spec_draft_tokens{lbl}"] == 2
+    rate = flat[f"mxtpu_serving_spec_acceptance_rate{lbl}"]
+    assert 0.0 <= rate <= 1.0
+    assert flat[f"mxtpu_serving_spec_tokens_proposed_total{lbl}"] > 0

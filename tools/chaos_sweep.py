@@ -161,6 +161,7 @@ def serving_scenarios(net):
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
         ("prefix_storm", lambda: serving_prefix_storm(net)),
         ("paged_storm", lambda: serving_paged_storm(net)),
+        ("spec_storm", serving_spec_storm),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
         ("replica_kill", lambda: fleet_replica_kill(net)),
         ("rolling_restart", lambda: fleet_rolling_restart(net)),
@@ -531,6 +532,113 @@ def serving_paged_storm(net):
                    "compiles_warmup": n_warm,
                    "compiles_total": s["compile_cache"]["compiles"],
                    "preemptions": s["overload"]["preemptions"],
+                   "faults_fired": plan.fired()},
+    }
+
+
+def serving_spec_storm():
+    """Speculative-decode chaos (docs/serving.md "Speculative
+    decode"): a paged pool at ONE page of headroom serves mixed
+    greedy/sampled traffic through a speculating engine while faults
+    land on the draft and verify dispatches AND the draft head's
+    logits are NaN-poisoned every few cycles.  Invariants: ZERO lost
+    requests (speculation is an optimization layer — every fault
+    degrades that cycle to plain one-token decode), greedy rows
+    token-identical to fault-free ``net.generate``, the rewound pages
+    of rejected speculation come back refcount-clean (after the storm
+    every page is reclaimable and no claim is stranded), no NaN
+    anywhere in the page pool (the drafter is read-only and the
+    sentinel zero page stays pristine), and the storm compiled
+    NOTHING after warmup."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import FaultPlan
+
+    # the shared 1-layer chaos net cannot draft (draft_layers must be
+    # < num_layers): build the 2-layer sibling
+    onp.random.seed(0)
+    from mxnet_tpu.models import get_gpt2
+    net = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=2,
+                   num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    rs = onp.random.RandomState(8)
+    greedy = [rs.randint(0, 61, (4 + (i % 4),)).astype("int32")
+              for i in range(6)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 6,
+                         temperature=0).asnumpy()[0] for p in greedy]
+    sampled = [rs.randint(0, 61, (5,)).astype("int32")
+               for _ in range(3)]
+    plan = (FaultPlan()
+            .raise_at("serving.draft", at=2)
+            .raise_at("serving.verify", at=1, retryable=True)
+            .raise_at("serving.verify", at=4)
+            .nonfinite_at("serving.draft_logits", every=3))
+    # worst case needs 32/8 = 4 pages; the pool holds 5 — speculation's
+    # soft window claims must yield under pressure (degrade to plain
+    # decode), never park a victim for an optimization
+    eng = _engine(net, num_slots=3, max_batch=3, kv_layout="paged",
+                  page_size=8, num_pages=5, spec_tokens=2,
+                  draft_layers=1, prefix_min_tokens=2)
+    n_warm = eng.warmup()
+    mismatched = stranded = 0
+    with plan:
+        eng.start()
+        futs = [eng.submit(p, max_new_tokens=6) for p in greedy]
+        sfuts = [eng.submit(p, max_new_tokens=6, temperature=1.0,
+                            top_k=12, seed=i)
+                 for i, p in enumerate(sampled)]
+        for ref, f in zip(refs, futs):
+            try:
+                out = f.result(timeout=60)
+                if not onp.array_equal(out, ref):
+                    mismatched += 1
+            except Exception:
+                stranded += 1
+        for f in sfuts:
+            try:
+                f.result(timeout=60)
+            except Exception:
+                stranded += 1
+        s = eng.stats()
+        # refcount-clean: with every request drained, the only live
+        # claims are the prefix cache's own — evicting everything must
+        # return EVERY page to the free list (no stranded rewound or
+        # window claim anywhere)
+        eng._prefix.evict_pages(eng.num_pages)
+        refcount_clean = (eng._pool.free_count == eng.num_pages
+                          and all(r == 0 for r in eng._pool._refs))
+        # NaN hygiene: poisoned draft logits must never reach the pool
+        # (read-only drafter), and the zero page stays pristine
+        pool_clean = all(
+            bool(onp.isfinite(onp.asarray(a[:eng.num_pages])).all())
+            and bool((onp.asarray(a[eng.num_pages]) == 0).all())
+            for layer in eng._caches for a in layer.values())
+        try:
+            eng.stop(timeout=15)
+        except Exception:
+            pass
+    _join_zombies()
+    sp = s["speculative"]
+    passed = (mismatched == 0 and stranded == 0
+              and refcount_clean and pool_clean
+              and sp["spec_cycles"] >= 1
+              and sp["spec_faults"] >= 2
+              and s["compile_cache"]["compiles"] == n_warm
+              and plan.fired("serving.draft") >= 1
+              and plan.fired("serving.verify") >= 2
+              and plan.fired("serving.draft_logits") >= 1)
+    return {
+        "name": "serving/spec_storm",
+        "passed": bool(passed),
+        "detail": {"requests": len(greedy) + len(sampled),
+                   "mismatched": mismatched, "stranded": stranded,
+                   "refcount_clean": refcount_clean,
+                   "pool_clean": pool_clean,
+                   "speculative": sp,
+                   "slots": s["slots"],
+                   "compiles_warmup": n_warm,
+                   "compiles_total": s["compile_cache"]["compiles"],
                    "faults_fired": plan.fired()},
     }
 
