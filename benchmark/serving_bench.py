@@ -79,6 +79,21 @@ and it emits ``serving_speculative_plain`` (baseline) and
 record carries the measured acceptance rate, the spec counters, and
 the live registry snapshot).
 
+``--workload sharded`` runs the 1-device vs N-virtual-device GSPMD
+comparison (docs/serving.md "Sharded decode"): the same concurrent
+greedy+sampled burst through an unsharded engine and through one with
+``mesh=N`` (tensor-parallel over
+``--xla_force_host_platform_device_count`` CPU devices).  Output
+streams are asserted token-identical between the arms EVERY trial —
+sharding's contract is bytes moved, math unchanged — and the compile
+counter is asserted frozen per (bucket, mesh) point.  It emits
+``serving_sharded_1dev`` (baseline) and ``serving_sharded_mesh<N>``
+(``vs_baseline`` is the tokens/s ratio; on CPU the N "devices" share
+the same cores, so the ratio measures GSPMD partition overhead — the
+CPU run exists to pin parity and the freeze, the TPU run reuses it
+unchanged for real speedups; the record carries the mesh stats section
+and the live registry snapshot).
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -807,6 +822,116 @@ def bench_speculative(concurrency: int = 8, trials: int = 3):
              registry_live=last_spec["registry"]))
 
 
+def _build_sharded_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        prompt_lens = (64, 96, 128)
+        seq_buckets = (64, 128, 256)
+        max_new = 64
+    else:   # CPU sanity: the comparison is about PARITY and the
+        # compile freeze on a real mesh, not speed (the virtual devices
+        # share one host's cores) — but units large enough that the
+        # partitioned matmuls are real work, not dispatch noise
+        cfg = dict(vocab_size=2048, units=256, num_layers=4, num_heads=8,
+                   max_length=256, dropout=0.0)
+        prompt_lens = (8, 12, 16, 24)
+        seq_buckets = (8, 16, 32)
+        max_new = 32
+    net = get_gpt2("gpt2_124m", **cfg)
+    net.initialize()
+    return net, prompt_lens, seq_buckets, max_new
+
+
+def bench_sharded(concurrency: int = 8, trials: int = 3,
+                  mesh_devices: int = None):
+    """1-device vs N-device sharded decode on the same mixed
+    greedy/sampled burst.  Token parity between the arms is asserted
+    every trial (the contract sharding is judged by), and so is the
+    per-(bucket, mesh)-point compile freeze.  See the module docstring
+    for what the CPU ratio does and does not mean."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+    from mxnet_tpu.test_utils import mesh_devices as _devices
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = mesh_devices or min(4, len(jax.devices()))
+    if n < 2 or _devices(n) is None:
+        raise SystemExit(
+            f"--workload sharded needs >= 2 XLA devices (have "
+            f"{len(jax.devices())}) — on CPU set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=N")
+    net, prompt_lens, seq_buckets, max_new = _build_sharded_net(on_tpu)
+    rs = onp.random.RandomState(0)
+    prompts = [rs.randint(0, net.vocab_size,
+                          (prompt_lens[i % len(prompt_lens)],))
+               .astype("int32") for i in range(concurrency)]
+    # half greedy (the generate-parity anchor), half seeded sampled —
+    # parity between the arms must hold at ANY sampling setting
+    samp = [dict() if i % 2 == 0
+            else dict(temperature=1.0, top_k=20, seed=100 + i)
+            for i in range(concurrency)]
+    total_tokens = concurrency * max_new
+
+    def build(mesh):
+        kw = dict(mesh=mesh) if mesh else {}
+        eng = InferenceEngine(
+            net, num_slots=concurrency, max_batch=concurrency,
+            seq_buckets=seq_buckets, queue_depth=4 * concurrency,
+            default_max_new_tokens=max_new,
+            name=f"serving_sharded_{mesh or 1}dev", **kw)
+        eng.warmup()
+        return eng
+
+    def one_trial(eng):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=max_new, **k)
+                for p, k in zip(prompts, samp)]
+        outs = [f.result(timeout=1800) for f in futs]
+        return total_tokens / (time.perf_counter() - t0), outs
+
+    one_vals, mesh_vals = [], []
+    eng1, engN = build(None), build(n)
+    warm1 = eng1.stats()["compile_cache"]["compiles"]
+    warmN = engN.stats()["compile_cache"]["compiles"]
+    with eng1, engN:
+        one_trial(eng1)          # untimed priming burst per arm (host
+        one_trial(engN)          # warmth is not a property of either)
+        for _ in range(max(1, trials)):
+            tps, outs_1 = one_trial(eng1)
+            one_vals.append(tps)
+            tps, outs_n = one_trial(engN)
+            mesh_vals.append(tps)
+            for a, b in zip(outs_1, outs_n):   # parity gate, per trial
+                if not onp.array_equal(a, b):
+                    raise AssertionError(
+                        "sharded/1-device output streams diverged — "
+                        "the bench numbers would be comparing "
+                        "different work")
+        s1, sN = eng1.stats(), engN.stats()
+        for s, warm in ((s1, warm1), (sN, warmN)):
+            if s["compile"]["compiles"] != warm:
+                raise AssertionError(
+                    f"compile counter moved on traffic at mesh point "
+                    f"{s['compile']['mesh_point']} — the (bucket, "
+                    "mesh) freeze broke")
+        from mxnet_tpu.observability import flatten
+        registry = flatten(prefix="mxtpu_serving")
+    ratio = round(statistics.median(mesh_vals) /
+                  statistics.median(one_vals), 4)
+    base = {"concurrency": concurrency, "max_new_tokens": max_new,
+            "parity_asserted": True}
+    yield _record("serving_sharded_1dev", one_vals, "tokens/sec", None,
+                  dict(base, mesh=s1["mesh"], compile=s1["compile"]))
+    yield _record(
+        f"serving_sharded_mesh{n}", mesh_vals, "tokens/sec", ratio,
+        dict(base, mesh=sN["mesh"], compile=sN["compile"],
+             registry_live=registry))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
@@ -814,9 +939,23 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
-                             "paged", "speculative"),
+                             "paged", "speculative", "sharded"),
                     default="decode")
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="device count for --workload sharded "
+                         "(default: min(4, local devices))")
     args = ap.parse_args()
+
+    if args.workload == "sharded" and "host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        # the sharded workload needs virtual host devices, and the flag
+        # is read exactly ONCE at backend bring-up — set it before any
+        # jax initialization.  Harmless under a real TPU: it only
+        # affects the host (CPU) platform.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=%d"
+            % max(args.mesh_devices or 4, 2))
 
     from mxnet_tpu.utils.platform import init_backend
     platform = init_backend()
@@ -834,6 +973,9 @@ def main():
         recs = bench_paged(trials=args.trials)
     elif args.workload == "speculative":
         recs = bench_speculative(trials=args.trials)
+    elif args.workload == "sharded":
+        recs = bench_sharded(trials=args.trials,
+                             mesh_devices=args.mesh_devices)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -34,6 +34,13 @@ def _dense_blocks_only(net):
 class _GPT2Decoding:
     """KV-cache incremental decoding mixin surface for GPT2Model."""
 
+    def kv_heads(self):
+        """(num_heads, head_dim) of the serving KV caches — the axes a
+        GSPMD serving mesh shards (docs/serving.md "Sharded decode"):
+        ``num_heads`` must divide evenly over the mesh's model axis."""
+        blk0 = self.blocks[0]
+        return blk0.attn._num_heads, blk0.attn._head_dim
+
     def init_cache(self, batch, max_length=None, dtype=None):
         """Per-layer KV caches (B, Tmax, H, D), zero-filled.  Cache dtype
         follows the parameters (bf16 params → bf16 cache, half the HBM)

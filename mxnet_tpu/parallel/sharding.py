@@ -96,6 +96,30 @@ def param_sharding(param, mesh: Mesh,
     return NamedSharding(mesh, rules.spec(logical_axes_of(param)))
 
 
+def divisible_spec(shape, logical_axes, mesh: Mesh, mapping) -> P:
+    """PartitionSpec mapping each logical axis through ``mapping``
+    (logical name → mesh axis name), REPLICATING any dimension whose
+    size does not divide its mesh axis — the pragmatic t5x-style
+    fallback a *serving* mesh wants: an odd-sized vocab table (97 on a
+    2-way mesh) replicates instead of erroring, while the axes that
+    MUST shard evenly (the KV head dimension) are validated separately
+    by the caller (`InferenceEngine`'s typed construction checks,
+    docs/serving.md "Sharded decode")."""
+    from .mesh import axis_size
+    spec = []
+    axes = tuple(logical_axes or ())
+    for i, dim in enumerate(shape):
+        a = axes[i] if i < len(axes) else None
+        m = mapping.get(a) if a is not None else None
+        if m is not None:
+            sz = axis_size(mesh, m)
+            if sz > 1 and dim % sz == 0:
+                spec.append(m)
+                continue
+        spec.append(None)
+    return P(*spec)
+
+
 def shard_params(block, mesh: Mesh, rules: Optional[ShardingRules] = None):
     """Place every initialized parameter of ``block`` onto the mesh per the
     rules (replacing KVStore broadcast: parity src/kvstore/comm.h

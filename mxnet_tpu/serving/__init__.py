@@ -11,10 +11,15 @@ their suffix), chunked prefill (long prompts interleave with decode),
 bounded-queue load shedding, per-request deadlines and phase-split
 latency/TTFT metrics.  ``submit(temperature=, top_k=, top_p=, seed=)``
 opens the sampling workload (per-request seeded PRNG, deterministic
-streams, one compiled program per bucket), and ``spec_tokens=k`` turns
+streams, one compiled program per bucket), ``spec_tokens=k`` turns
 on speculative multi-token decode: a self-drafting early-exit proposer
 plus one batched verify forward per cycle, token-identical to the
-non-speculative engine at any sampling setting.  See docs/serving.md.
+non-speculative engine at any sampling setting, and ``mesh=N`` shards
+the whole thing tensor-parallel over a GSPMD mesh — one engine drives
+N devices, every bucket-lattice program one pjit-partitioned
+executable, still token-identical to the 1-device engine with the
+compile counter frozen per (bucket, mesh) point.  See
+docs/serving.md.
 
 Quick start::
 

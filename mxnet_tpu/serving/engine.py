@@ -86,6 +86,24 @@ to the pool (paged).  Faults at ``serving.draft``/``serving.verify``
 degrade that cycle to plain one-token decode — speculation can slow
 down, never fail or corrupt, a request.
 
+Sharded decode (docs/serving.md "Sharded decode"): with ``mesh=`` the
+engine serves TENSOR-PARALLEL over a named GSPMD mesh — one
+``InferenceEngine`` drives N devices.  GPT-2 parameters are placed by
+their logical sharding axes (heads/vocab/mlp over the model axis,
+Megatron column/row parallel) and every per-layer KV cache shards its
+HEAD dimension, so each chip holds ``1/N`` of the weights and of the
+KV state; every compiled program in the (batch, seq) bucket lattice —
+full prefill, chunked/offset prefill, decode step, the prefix-cache
+row copy, draft/verify, the paged page-table variants — becomes ONE
+pjit-partitioned executable (committed sharded operands +
+``with_sharding_constraint`` on the cache outputs) with the same
+donation and the same compile-freeze contract, now per (bucket, mesh)
+point.  The slot/batch axis stays replicated by default or
+data-shards over a second mesh axis (dense layout only).  Decode is
+token-identical to the 1-device engine — sharding moves bytes, never
+the math — which CPU verification pins via
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+
 Prefix reuse (docs/serving.md): with ``prefix_pool_rows > 0`` a
 host-side radix tree (:mod:`.prefix_cache`) maps admitted prompt
 prefixes to a reserved pool of KV cache rows; a request whose prompt
@@ -388,6 +406,27 @@ class InferenceEngine:
     draft_layers : transformer blocks the drafter runs before its
         early-exit LM head (must be < the model's layer count — the
         drafter has to be cheaper than the verify forward it feeds).
+    mesh : sharded decode over a GSPMD mesh (decode mode; docs/
+        serving.md "Sharded decode").  ``None`` (default) is the exact
+        single-device engine; a device COUNT builds a tensor-parallel
+        mesh over the first N local devices; an explicit
+        :class:`jax.sharding.Mesh` (e.g. from
+        :func:`~mxnet_tpu.parallel.make_mesh`) serves over that.
+        Parameters shard by their logical axes (heads/vocab/mlp
+        Megatron-style), every per-layer KV cache shards its head
+        dimension, and each compiled program in the bucket lattice
+        becomes one pjit-partitioned executable — token-identical to
+        the 1-device engine, compile counter frozen per (bucket, mesh)
+        point.  Incompatible configs (device count not dividing the
+        head count, slot axis with ``kv_layout='paged'``, more devices
+        than the process has) raise :class:`ServingError` at
+        construction.  CPU verification:
+        ``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+    mesh_axes : mesh axis name(s) the engine shards over (default
+        ``"tp"``): the first is the MODEL axis (heads/vocab/mlp + the
+        KV head dim); an optional second is the SLOT axis,
+        data-sharding the KV rows (dense layout only — must divide
+        ``num_slots + 1 + prefix_pool_rows``).
     name : base name for this engine's metrics identity.  The claimed
         name (``self.name``) is uniquified against every other live
         engine (``serving``, ``serving-2``, …) so fleet replicas export
@@ -427,6 +466,8 @@ class InferenceEngine:
                  num_pages: Optional[int] = None,
                  spec_tokens: int = 0,
                  draft_layers: int = 1,
+                 mesh=None,
+                 mesh_axes="tp",
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -576,6 +617,10 @@ class InferenceEngine:
                                    "speculate)")
             self.spec_tokens = 0
             self.draft_layers = int(draft_layers)
+        # sharded decode (docs/serving.md "Sharded decode") — resolved
+        # AFTER the layout knobs above: validation reads num_slots /
+        # prefix_pool_rows / kv_layout
+        self._init_mesh(mesh, mesh_axes)
         self.prefix_fault_limit = int(prefix_fault_limit)
         # consecutive-fault streaks, PER SITE: a clean host lookup runs
         # right before every device copy, so a shared counter could
@@ -626,6 +671,133 @@ class InferenceEngine:
         self._exporter = None
         self._build_fns()
         self._register_gauges()
+
+    # -------------------------------------------------------- sharded decode
+    def _init_mesh(self, mesh, mesh_axes):
+        """Resolve the ``mesh=``/``mesh_axes=`` config into a validated
+        GSPMD serving mesh (docs/serving.md "Sharded decode").  Every
+        incompatibility is a typed :class:`ServingError` HERE, at
+        construction — a mesh that cannot shard the model must never
+        surface as an XLA shape error mid-warmup.
+
+        ``mesh`` is ``None`` (single-device, the exact pre-sharding
+        engine), a device count (builds a tensor-parallel-only mesh
+        over the first N local devices via
+        :func:`~mxnet_tpu.parallel.make_mesh`), or an explicit
+        :class:`jax.sharding.Mesh`.  ``mesh_axes`` names the mesh axes
+        the engine shards over: the first is the MODEL axis (attention
+        heads, vocab-parallel LM head, MLP hidden — and the KV caches'
+        head dimension), an optional second is the SLOT axis
+        (data-sharding the KV rows; dense layout only — physical pages
+        have no stable slot mapping to shard over)."""
+        self.mesh = None
+        self.mesh_axes = ()
+        self.mesh_devices = 1
+        self._model_axis = None
+        self._slot_axis = None
+        self._mesh_key = "1dev"
+        self._kv_ns = None
+        self._param_shardings = None
+        self._mesh_param_cache = {}
+        self._compiles_by_mesh = {}
+        if mesh is None:
+            return
+        if self.mode != "decode":
+            raise ServingError(
+                "mesh= is a decode-mode knob — forward mode has no "
+                "sharded serving surface (shard the net's params with "
+                "parallel.shard_params instead)")
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import axis_size, make_mesh
+        if isinstance(mesh, bool) or (not isinstance(mesh, (int, Mesh))):
+            raise ServingError(
+                f"mesh= must be None, a device count, or a "
+                f"jax.sharding.Mesh, got {type(mesh).__name__}")
+        if isinstance(mesh, int):
+            if mesh < 1:
+                raise ServingError(f"mesh={mesh} must be >= 1 devices")
+            devs = jax.devices()
+            if len(devs) < mesh:
+                raise ServingError(
+                    f"mesh={mesh} needs {mesh} devices, this process has "
+                    f"{len(devs)} — for CPU verification set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={mesh} "
+                    "BEFORE jax initializes (docs/serving.md 'Sharded "
+                    "decode')")
+            mesh = make_mesh(dp=1, tp=mesh, devices=devs[:mesh])
+        axes = (mesh_axes,) if isinstance(mesh_axes, str) \
+            else tuple(mesh_axes)
+        if not 1 <= len(axes) <= 2 or len(set(axes)) != len(axes):
+            raise ServingError(
+                f"mesh_axes must be one or two DISTINCT axis names "
+                f"(model axis[, slot axis]), got {axes!r}")
+        for a in axes:
+            if a not in mesh.axis_names:
+                raise ServingError(
+                    f"mesh_axes entry {a!r} is not an axis of the mesh "
+                    f"(axes: {tuple(mesh.axis_names)})")
+        model_ax = axes[0]
+        slot_ax = axes[1] if len(axes) == 2 else None
+        t = axis_size(mesh, model_ax)
+        heads = None
+        if hasattr(self.net, "kv_heads"):
+            heads = int(self.net.kv_heads()[0])
+        else:
+            blocks = getattr(self.net, "blocks", None) or ()
+            attn = getattr(blocks[0], "attn", None) if blocks else None
+            heads = getattr(attn, "_num_heads", None)
+        if heads is not None and heads % t:
+            raise ServingError(
+                f"mesh axis {model_ax!r} spans {t} devices, which does "
+                f"not divide the model's {heads} attention heads — the "
+                "KV head dimension must shard evenly (grow/pad the head "
+                "count or shrink the mesh)")
+        if slot_ax is not None:
+            d = axis_size(mesh, slot_ax)
+            if self._paged:
+                raise ServingError(
+                    "a slot axis in mesh_axes is incompatible with "
+                    "kv_layout='paged': physical pages migrate between "
+                    "slots, so the page axis has no stable slot mapping "
+                    "to shard over — use the model axis alone, or "
+                    "kv_layout='dense'")
+            rows = self.num_slots + 1 + self.prefix_pool_rows
+            if rows % d:
+                raise ServingError(
+                    f"slot axis {slot_ax!r} ({d} devices) does not "
+                    f"divide the KV row count num_slots+1+"
+                    f"prefix_pool_rows={rows} — pad num_slots or "
+                    "prefix_pool_rows")
+        self.mesh = mesh
+        self.mesh_axes = axes
+        self.mesh_devices = int(mesh.size)
+        self._model_axis = model_ax
+        self._slot_axis = slot_ax
+        self._mesh_key = "%ddev:%s" % (self.mesh_devices, ",".join(
+            "%s=%d" % (a, axis_size(mesh, a)) for a in axes))
+        if heads is not None:
+            # per-layer cache leaves: dense (R, Tmax, H, D) rows, paged
+            # (N+1, ps, H, D) pages — the HEAD axis shards either way
+            # (validated above), the row axis only under a slot axis
+            spec = PartitionSpec(None if self._paged else slot_ax, None,
+                                 model_ax if t > 1 else None, None)
+            self._kv_ns = NamedSharding(mesh, spec)
+
+    def _place_caches(self, caches):  # guarded-by: _step_lock
+        """Commit every KV cache leaf onto the mesh.  Also the RE-pin
+        after eager host-side cache surgery (scrub-on-NaN, slot
+        zeroing): an eager op can come back differently sharded, and a
+        committed input whose sharding moved would MISS the jit cache —
+        a silent recompile on traffic the warmup() freeze forbids.
+        ``device_put`` of an already-correctly-placed array is a
+        no-op."""
+        if self._kv_ns is None:
+            return caches
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._kv_ns), caches)
 
     def _register_gauges(self):
         """Compile-event and bucket-lattice gauges in the process-wide
@@ -685,6 +857,11 @@ class InferenceEngine:
                        "duplicated row under the dense layout)",
                   fn=bound(lambda e: e._pool.shared_count
                            if e._pool is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_mesh_devices",
+                  help="devices the engine's compiled programs span "
+                       "(GSPMD sharded decode; 1 = unsharded "
+                       "single-device serving)",
+                  fn=bound(lambda e: e.mesh_devices), **lbl)
         reg.gauge("mxtpu_serving_overload_factor",
                   help="brownout degradation factor (1.0 = normal; "
                        "lower = non-interactive token budgets capped "
@@ -731,6 +908,20 @@ class InferenceEngine:
         net = self.net
         if self.mode == "decode":
             guard = self.guard_nonfinite
+            kv_ns = self._kv_ns
+
+            def pin_c(c):
+                # sharded decode: constrain the cache outputs IN-GRAPH.
+                # With committed sharded inputs GSPMD usually propagates
+                # this anyway — the explicit constraint makes every
+                # program deterministically partitioned (and keeps the
+                # output sharding stable, which the jit cache keys on:
+                # a drifting cache sharding would recompile on traffic)
+                if kv_ns is None:
+                    return c
+                return jax.tree_util.tree_map(
+                    lambda a: jax.lax.with_sharding_constraint(a, kv_ns),
+                    c)
 
             def row_ok(logits_jax):
                 # per-row health flag, computed IN-GRAPH next to the
@@ -752,7 +943,7 @@ class InferenceEngine:
                 ok = row_ok(logits.jax) if guard else \
                     jnp.ones((logits.jax.shape[0],), jnp.bool_)
                 return (sample_tokens(logits.jax, temp, topk, topp,
-                                      keys, fpos), ok, c)
+                                      keys, fpos), ok, pin_c(c))
 
             spec_k = self.spec_tokens
             spec_layers = self.draft_layers
@@ -780,7 +971,7 @@ class InferenceEngine:
                     jnp.repeat(topk, w, axis=0),
                     jnp.repeat(topp, w, axis=0),
                     jnp.repeat(keys, w, axis=0), fpos)
-                return toks.reshape(s, w), ok, c
+                return toks.reshape(s, w), ok, pin_c(c)
 
             if self._paged:
                 # the paged programs take the page table as ONE extra
@@ -871,9 +1062,28 @@ class InferenceEngine:
                     m = (jnp.arange(a.shape[1]) < length).reshape(
                         (a.shape[1],) + (1,) * (a.ndim - 2))
                     return a.at[dst].set(jnp.where(m, a[src], a[dst]))
-                return _jax.tree_util.tree_map(cp, caches)
+                return pin_c(_jax.tree_util.tree_map(cp, caches))
 
             self._items, pure_prefill = make_pure_fn(net, prefill)
+            if self.mesh is not None:
+                # one NamedSharding per parameter, from the logical axes
+                # the model layer annotates (transformer.py): heads and
+                # MLP hidden shard Megatron-style, the tied vocab table
+                # vocab-parallel; dimensions the mesh cannot divide
+                # evenly replicate (divisible_spec) — only the KV head
+                # axis is a hard divisibility requirement, validated at
+                # construction
+                from jax.sharding import NamedSharding
+
+                from ..parallel.sharding import (divisible_spec,
+                                                 logical_axes_of)
+                mapping = {"heads": self._model_axis,
+                           "vocab": self._model_axis,
+                           "mlp": self._model_axis}
+                self._param_shardings = tuple(
+                    NamedSharding(self.mesh, divisible_spec(
+                        p.shape, logical_axes_of(p), self.mesh, mapping))
+                    for p in self._items)
             _, pure_step = make_pure_fn(net, step)
             _, pure_chunk = make_pure_fn(net, chunk)
             pure_verify = pure_draft = None
@@ -921,7 +1131,30 @@ class InferenceEngine:
         # net (fleet rebuild-and-rewarm): a mid-trace read here would
         # capture that trace's swapped-in tracers as "parameters"
         from ..gluon.cached_op import param_snapshot
-        return param_snapshot(self._items)
+        vals = param_snapshot(self._items)
+        if self._param_shardings is None:
+            return vals
+        return self._mesh_params(vals)
+
+    def _mesh_params(self, vals):  # guarded-by: _step_lock
+        """Mesh-placed view of the live parameter payloads, cached by
+        payload IDENTITY: steady-state dispatch reuses the committed
+        sharded copies (zero transfers), while a payload swapped under
+        the engine (``set_data``, a trainer sharing the net) re-shards
+        lazily at its next dispatch — the live-weights contract of
+        ``param_snapshot`` survives sharding.  The net itself is never
+        touched, so a 1-device engine (or ``generate``) sharing the
+        same net keeps its own placement."""
+        import jax
+        cache = self._mesh_param_cache
+        out = []
+        for i, v in enumerate(vals):
+            ent = cache.get(i)
+            if ent is None or ent[0] is not v:
+                ent = (v, jax.device_put(v, self._param_shardings[i]))
+                cache[i] = ent
+            out.append(ent[1])
+        return tuple(out)
 
     def _counted(self, key, fn, *args):
         """Run a compiled entry, tracking engine-level bucket hits vs
@@ -936,6 +1169,12 @@ class InferenceEngine:
         else:
             self._shape_seen.add(key)
             self.metrics.count("compiles")
+            # per-(bucket, mesh)-point accounting: one engine serves
+            # exactly one mesh point, so its compiles all land under
+            # its own key — stats()["compile"]["by_mesh_point"] merges
+            # across engines in a sharded-vs-1-device comparison
+            self._compiles_by_mesh[self._mesh_key] = \
+                self._compiles_by_mesh.get(self._mesh_key, 0) + 1
             first = True
             self._compiling = True
         try:
@@ -1670,6 +1909,31 @@ class InferenceEngine:
             "page_faults": c["page_faults"],
             "pages_scrubbed": c["pages_scrubbed"],
         }
+        # sharded decode (docs/serving.md "Sharded decode"): the mesh
+        # this engine's programs span, and the compile accounting per
+        # (bucket, mesh) point — warmup() freezes the "compiles" total,
+        # and by_mesh_point localizes any violation to the mesh that
+        # compiled it when several engines' stats are merged
+        mesh_axes = {}
+        if self.mesh is not None:
+            from ..parallel.mesh import axis_size
+            mesh_axes = {a: axis_size(self.mesh, a)
+                         for a in self.mesh_axes}
+        s["mesh"] = {
+            "enabled": self.mesh is not None,
+            "devices": self.mesh_devices,
+            "axes": mesh_axes,
+            "model_axis": self._model_axis,
+            "slot_axis": self._slot_axis,
+            "mesh_point": self._mesh_key,
+        }
+        s["compile"] = {
+            "mesh_point": self._mesh_key,
+            "by_mesh_point": dict(self._compiles_by_mesh),
+            "compiles": c["compiles"],
+            "bucket_hits": c["bucket_hits"],
+            "programs": len(self._shape_seen),
+        }
         # overlay the live controller state on the metrics' per-class
         # shed/served accounting (docs/overload.md)
         s["overload"]["controller"] = self._overload.snapshot()
@@ -1836,6 +2100,9 @@ class InferenceEngine:
                 self._caches = self.net.init_slot_cache(
                     self.num_slots + 1 + self.prefix_pool_rows,
                     self.max_length)
+            # sharded decode: commit the fresh caches onto the mesh so
+            # every compiled call sees stably-sharded operands
+            self._caches = self._place_caches(self._caches)
 
     def _release(self, slot: int):  # guarded-by: _step_lock
         """End a slot lease, dropping any prefix-cache read pin the
@@ -2455,8 +2722,8 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
         pids = jnp.asarray(freed, jnp.int32)
-        self._caches = jax.tree_util.tree_map(
-            lambda a: a.at[pids].set(0), self._caches)
+        self._caches = self._place_caches(jax.tree_util.tree_map(
+            lambda a: a.at[pids].set(0), self._caches))
         if count:
             self.metrics.count("pages_scrubbed", len(freed))
 
@@ -2696,8 +2963,8 @@ class InferenceEngine:
             self._pool.mark_dirty(set(written) - set(freed))
         elif self._caches is not None:
             import jax
-            self._caches = jax.tree_util.tree_map(
-                lambda a: a.at[slot].set(0), self._caches)
+            self._caches = self._place_caches(jax.tree_util.tree_map(
+                lambda a: a.at[slot].set(0), self._caches))
         self.metrics.count("nonfinite_outputs")
         self._fail(st.request, NonFiniteOutputError(
             f"request {st.request.id}: non-finite logits in {where} "

@@ -14,7 +14,26 @@ __all__ = ["default_rtol", "default_atol", "assert_almost_equal",
            "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
            "with_seed", "same", "check_consistency", "default_context",
            "set_default_context", "list_gpus", "download", "get_mnist",
-           "get_mnist_iterator"]
+           "get_mnist_iterator", "mesh_devices"]
+
+
+def mesh_devices(n):
+    """First ``n`` XLA devices, or ``None`` when the process has fewer.
+
+    Multi-device CPU runs (sharded-serving / sharded-trainer tests,
+    docs/serving.md "Sharded decode") need
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` set BEFORE
+    jax initializes — tests/conftest.py does this via
+    :func:`~mxnet_tpu.utils.platform.force_cpu`.  This helper GUARDS
+    instead of re-forcing: the flag is read exactly once at backend
+    bring-up, so forcing it from inside a test would either be a no-op
+    or poison the already-initialized platform for the rest of the
+    process.  Callers (the ``mesh_devices`` pytest fixture, the bench
+    workloads) skip or degrade when ``None`` comes back."""
+    import jax
+
+    devs = jax.devices()
+    return list(devs[:int(n)]) if len(devs) >= int(n) else None
 
 
 def _as_dtype(dtype):

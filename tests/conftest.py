@@ -34,6 +34,36 @@ except Exception:
     pass  # older jax: cache knobs absent — correctness unaffected
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh_devices():
+    """Factory fixture: ``mesh_devices(n)`` → the first ``n`` XLA
+    devices, SKIPPING the test when the process has fewer (e.g. a bare
+    pytest invocation that bypassed this conftest's ``force_cpu(8)``).
+    Guarding — instead of forcing the platform flag from inside the
+    test — keeps the main process's jax platform state unpoisoned:
+    ``--xla_force_host_platform_device_count`` is read exactly once at
+    backend bring-up, so a mid-session re-force is at best a no-op.
+    Multi-device tests that drive real meshes should be lean; heavy
+    variants carry the ``slow`` marker."""
+    from mxnet_tpu.test_utils import mesh_devices as _take
+
+    def take(n):
+        devs = _take(n)
+        if devs is None:
+            import jax
+            pytest.skip(
+                f"needs {n} XLA devices, have {len(jax.devices())} — "
+                "run under tests/conftest.py (force_cpu(8)) or set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count "
+                "before jax initializes")
+        return devs
+
+    return take
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

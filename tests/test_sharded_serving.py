@@ -1,0 +1,306 @@
+"""Sharded serving — tensor-parallel decode over a GSPMD mesh
+(docs/serving.md "Sharded decode").
+
+Contracts under test, all on virtual CPU devices
+(``--xla_force_host_platform_device_count``, forced by conftest.py):
+
+- a mesh engine's decode is TOKEN-IDENTICAL to the 1-device engine and
+  to per-request ``net.generate`` — greedy and seeded sampling, with
+  speculation, the paged KV layout, the prefix cache and chunked
+  prefill all composing unchanged;
+- the compile counter freezes per (bucket, mesh) point after
+  ``warmup()`` — sharding must never add a compile on traffic;
+- incompatible mesh configs raise typed :class:`ServingError` at
+  CONSTRUCTION, not as shape errors mid-warmup;
+- faults at the dispatch-path sites (``serving.decode_step`` /
+  ``serving.prefill``) are contained under the mesh engine exactly as
+  on one device.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import InferenceEngine, ServingError
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    # 4 heads: divides 2- and 4-way meshes, leaves 3 as the validation
+    # counterexample.  vocab 97 is deliberately ODD — the vocab-parallel
+    # LM head must fall back to replication (divisible_spec), not die.
+    n = get_gpt2("gpt2_124m", vocab_size=VOCAB, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    return InferenceEngine(net, **kw)
+
+
+def _run(eng, prompts, samp=None, max_new=8):
+    """warmup + drive one engine through the prompts; returns (outs,
+    stats, warmup_compiles) and asserts the per-mesh-point compile
+    freeze — no program may compile on traffic."""
+    n_warm = eng.warmup()
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=max_new,
+                           **((samp or [{}] * len(prompts))[i]))
+                for i, p in enumerate(prompts)]
+        outs = [f.result(timeout=300) for f in futs]
+        s = eng.stats()
+    assert s["compile"]["compiles"] == n_warm, \
+        "compile counter moved on traffic — the (bucket, mesh) freeze broke"
+    assert s["compile"]["by_mesh_point"] == \
+        {s["mesh"]["mesh_point"]: n_warm}
+    return outs, s, n_warm
+
+
+# ------------------------------------------------------------------ parity
+
+def test_sharded_greedy_parity_across_buckets(net, mesh_devices):
+    """The acceptance contract: mixed-length greedy traffic through a
+    2-device mesh engine is token-identical to per-request generate."""
+    mesh_devices(2)
+    prompts = _prompts((3, 5, 9, 12, 5, 16))
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    outs, s, _ = _run(_engine(net, mesh=2, name="shard_greedy"), prompts)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["mesh"]["enabled"] and s["mesh"]["devices"] == 2
+    assert s["mesh"]["model_axis"] == "tp"
+    assert s["mesh"]["mesh_point"] == "2dev:tp=2"
+
+
+def test_sharded_sampled_streams_match_unsharded(net, mesh_devices):
+    """Seeded sampled streams (temperature / top-k / top-p) are
+    identical between the mesh engine and the 1-device engine — the
+    per-request fold-at-position PRNG is placement-independent."""
+    mesh_devices(2)
+    prompts = _prompts((4, 7, 10, 6), seed=2)
+    samp = [dict(), dict(temperature=1.0, top_k=5, seed=7),
+            dict(temperature=0.8, top_p=0.9, seed=11),
+            dict(temperature=1.3, seed=13)]
+    base, _, _ = _run(_engine(net, name="shard_base1"), prompts, samp)
+    outs, _, _ = _run(_engine(net, mesh=2, name="shard_samp"), prompts,
+                      samp)
+    for a, b in zip(base, outs):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_sharded_speculative_parity(net, mesh_devices):
+    """spec_tokens=k under the mesh: draft + verify are pjit programs
+    too, and accepted streams stay identical to the unsharded engine
+    (greedy AND sampled rows)."""
+    mesh_devices(2)
+    prompts = _prompts((3, 9, 12, 5), seed=3)
+    samp = [dict(), dict(temperature=1.0, top_k=5, seed=7), dict(),
+            dict(temperature=0.9, seed=23)]
+    base, _, _ = _run(_engine(net, name="shard_base2"), prompts, samp)
+    outs, s, _ = _run(_engine(net, mesh=2, spec_tokens=2, draft_layers=1,
+                              name="shard_spec"), prompts, samp)
+    for a, b in zip(base, outs):
+        onp.testing.assert_array_equal(a, b)
+    assert s["speculative"]["spec_cycles"] > 0
+
+
+def test_sharded_paged_parity(net, mesh_devices):
+    """kv_layout='paged' under the mesh: page scatters/gathers shard
+    the head axis, greedy output identical to the 1-device DENSE
+    engine (the strictest cross-layout, cross-placement pin)."""
+    mesh_devices(2)
+    prompts = _prompts((3, 9, 12, 5), seed=4)
+    base, _, _ = _run(_engine(net, name="shard_base3"), prompts)
+    outs, s, _ = _run(_engine(net, mesh=2, kv_layout="paged", page_size=8,
+                              name="shard_paged"), prompts)
+    for a, b in zip(base, outs):
+        onp.testing.assert_array_equal(a, b)
+    assert s["slots"]["pages_total"] > 0
+
+
+def test_sharded_prefix_and_chunked_prefill_compose(net, mesh_devices):
+    """Prefix-cache hits (compiled masked row copy) and chunked/offset
+    prefill run as mesh programs: long shared-prefix prompts stream
+    token-identically to generate, with hits and chunks recorded."""
+    mesh_devices(2)
+    rs = onp.random.RandomState(5)
+    shared = rs.randint(0, VOCAB, (24,)).astype("int32")
+    prompts = [onp.concatenate(
+        [shared, rs.randint(0, VOCAB, (4,)).astype("int32")])
+        for _ in range(3)]
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 4,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, mesh=2, prefix_pool_rows=2, prefill_chunk=8,
+                  prefix_min_tokens=4, name="shard_prefix")
+    n_warm = eng.warmup()
+    with eng:
+        outs = [eng.infer(p, max_new_tokens=4) for p in prompts]
+        s = eng.stats()
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["prefix_cache"]["prefix_hits"] > 0
+    assert s["batches"]["prefill_chunks"] > 0
+    assert s["compile"]["compiles"] == n_warm
+
+
+def test_sharded_slot_axis_parity(net, mesh_devices):
+    """Data-sharding the KV slot rows over a second mesh axis (dense
+    layout): same tokens as generate — the slot axis moves rows, not
+    math."""
+    devs = mesh_devices(2)
+    from mxnet_tpu.parallel import make_mesh
+    mesh = make_mesh(dp=2, tp=1, devices=devs)
+    prompts = _prompts((3, 9, 5), seed=6)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    # num_slots=3 -> 4 KV rows (slots + scratch), divisible by dp=2
+    outs, s, _ = _run(_engine(net, mesh=mesh, mesh_axes=("tp", "dp"),
+                              num_slots=3, max_batch=3,
+                              name="shard_dp"), prompts)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["mesh"]["slot_axis"] == "dp"
+
+
+@pytest.mark.slow
+def test_sharded_4dev_2d_mesh_parity(net, mesh_devices):
+    """The heavy variant: a 2x2 (tp x dp) mesh over 4 devices, prefix
+    cache on, mixed greedy + sampled traffic — streams identical to
+    the 1-device engine."""
+    devs = mesh_devices(4)
+    from mxnet_tpu.parallel import make_mesh
+    mesh = make_mesh(dp=2, tp=2, devices=devs)
+    prompts = _prompts((3, 7, 12, 9, 5), seed=7)
+    samp = [dict(), dict(temperature=1.0, top_k=7, seed=3), dict(),
+            dict(temperature=0.7, seed=9), dict()]
+    base, _, _ = _run(_engine(net, num_slots=3, max_batch=3,
+                              prefix_pool_rows=2, prefix_min_tokens=4,
+                              name="shard_base4"), prompts, samp)
+    outs, s, _ = _run(
+        _engine(net, mesh=mesh, mesh_axes=("tp", "dp"), num_slots=3,
+                max_batch=3, prefix_pool_rows=2, prefix_min_tokens=4,
+                name="shard_2x2"), prompts, samp)
+    for a, b in zip(base, outs):
+        onp.testing.assert_array_equal(a, b)
+    assert s["mesh"]["devices"] == 4
+    assert s["mesh"]["axes"] == {"tp": 2, "dp": 2}
+
+
+# --------------------------------------------------- freeze + observability
+
+def test_compile_freeze_distinct_mesh_points(net, mesh_devices):
+    """A 1-device and a mesh engine over the same net freeze
+    independently, and their stats localize compiles to DISTINCT mesh
+    points — the merged view a sharded-vs-unsharded comparison reads."""
+    mesh_devices(2)
+    prompts = _prompts((5, 9), seed=8)
+    _, s1, n1 = _run(_engine(net, name="shard_pt1"), prompts)
+    _, s2, n2 = _run(_engine(net, mesh=2, name="shard_pt2"), prompts)
+    assert s1["compile"]["mesh_point"] == "1dev"
+    assert s2["compile"]["mesh_point"] == "2dev:tp=2"
+    merged = dict(s1["compile"]["by_mesh_point"])
+    merged.update(s2["compile"]["by_mesh_point"])
+    assert merged == {"1dev": n1, "2dev:tp=2": n2}
+
+
+def test_mesh_devices_gauge_and_stats_section(net, mesh_devices):
+    mesh_devices(2)
+    from mxnet_tpu.observability import flatten
+    eng = _engine(net, mesh=2, name="shard_gauge")
+    try:
+        flat = flatten(prefix="mxtpu_serving_mesh_devices")
+        row = {k: v for k, v in flat.items() if "shard_gauge" in k}
+        assert list(row.values()) == [2], row
+        s = eng.stats()
+        assert s["mesh"] == {
+            "enabled": True, "devices": 2, "axes": {"tp": 2},
+            "model_axis": "tp", "slot_axis": None,
+            "mesh_point": "2dev:tp=2"}
+    finally:
+        eng.stop(drain=False)
+    # unsharded engines read 1 — the gauge is always present
+    eng = _engine(net, name="shard_gauge1")
+    try:
+        assert eng.mesh_devices == 1
+        assert eng.stats()["mesh"]["enabled"] is False
+    finally:
+        eng.stop(drain=False)
+
+
+# ------------------------------------------------------------- validation
+
+def test_mesh_config_validation_typed(net, mesh_devices):
+    """Every incompatible mesh config is a ServingError at
+    CONSTRUCTION — never an XLA shape error mid-warmup."""
+    mesh_devices(2)
+    with pytest.raises(ServingError, match="attention heads"):
+        _engine(net, mesh=3, name="shard_bad_heads")      # 4 % 3 != 0
+    with pytest.raises(ServingError, match="paged"):
+        _engine(net, mesh=2, kv_layout="paged", page_size=8,
+                mesh_axes=("tp", "dp"), name="shard_bad_paged")
+    with pytest.raises(ServingError, match="devices"):
+        _engine(net, mesh=4096, name="shard_bad_count")
+    with pytest.raises(ServingError, match="axis"):
+        _engine(net, mesh=2, mesh_axes="bogus", name="shard_bad_axis")
+    with pytest.raises(ServingError, match="DISTINCT"):
+        _engine(net, mesh=2, mesh_axes=("tp", "tp"), name="shard_dup")
+    with pytest.raises(ServingError, match=">= 1"):
+        _engine(net, mesh=0, name="shard_zero")
+    with pytest.raises(ServingError, match="Mesh"):
+        _engine(net, mesh="tp", name="shard_type")
+    from mxnet_tpu.parallel import make_mesh
+    import jax
+    m = make_mesh(dp=2, tp=1, devices=jax.devices()[:2])
+    with pytest.raises(ServingError, match="row count"):
+        # num_slots=2 -> 3 rows, not divisible by dp=2
+        _engine(net, mesh=m, mesh_axes=("tp", "dp"), num_slots=2,
+                prefix_pool_rows=0, name="shard_bad_rows")
+    with pytest.raises(ServingError, match="decode-mode"):
+        from mxnet_tpu.gluon import nn
+        fwd = nn.Dense(4, in_units=4)
+        fwd.initialize()
+        InferenceEngine(fwd, mode="forward", mesh=2, name="shard_fwd")
+
+
+# ------------------------------------------------------------ containment
+
+def test_sharded_dispatch_fault_containment(net, mesh_devices):
+    """Faults on the dispatch path (serving.decode_step /
+    serving.prefill) under the mesh engine: retryable faults retry
+    within budget and the output is still token-identical — sharding
+    adds no new failure surface."""
+    mesh_devices(2)
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((5, 9), seed=9)
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 8,
+                         temperature=0).asnumpy()[0] for p in prompts]
+    eng = _engine(net, mesh=2, name="shard_fault")
+    eng.warmup()
+    plan = (FaultPlan()
+            .raise_at("serving.decode_step", at=2, retryable=True)
+            .raise_at("serving.prefill", at=1, retryable=True))
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=300) for f in futs]
+            s = eng.stats()
+    assert plan.fired("serving.decode_step") == 1
+    assert plan.fired("serving.prefill") == 1
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    assert s["resilience"]["retries"] >= 2
+    assert s["requests"]["completed"] == len(prompts)

@@ -40,6 +40,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # ----------------------------------------------------------------- helpers
 
+def _one_device_mesh(par):
+    """The training scenarios are single-device BY DESIGN (their
+    contract is bit-identical kill/resume determinism, not sharding):
+    pin the mesh to device 0 so main()'s virtual-host-device flag —
+    needed by the sharded_parity serving scenario — cannot change
+    their mesh arithmetic."""
+    import jax
+
+    return par.make_mesh(dp=1, devices=jax.devices()[:1])
+
+
 def _tiny_gpt2():
     import numpy as onp
 
@@ -162,6 +173,7 @@ def serving_scenarios(net):
         ("prefix_storm", lambda: serving_prefix_storm(net)),
         ("paged_storm", lambda: serving_paged_storm(net)),
         ("spec_storm", serving_spec_storm),
+        ("sharded_parity", lambda: serving_sharded_parity(net)),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
         ("replica_kill", lambda: fleet_replica_kill(net)),
         ("rolling_restart", lambda: fleet_rolling_restart(net)),
@@ -169,6 +181,77 @@ def serving_scenarios(net):
         ("retry_storm", lambda: fleet_retry_storm(net)),
         ("gray_replica", lambda: fleet_gray_replica(net)),
     ]
+
+
+def serving_sharded_parity(net):
+    """Sharded serving chaos (docs/serving.md "Sharded decode"): the
+    same mixed greedy+sampled burst through a 1-device engine and a
+    2-device GSPMD mesh engine, with retryable faults injected on the
+    MESH engine's dispatch path only (scoped ``serving.decode_step@`` /
+    ``serving.prefill@``).  Invariants: zero lost requests, the mesh
+    streams TOKEN-IDENTICAL to the 1-device engine's, faults contained
+    (retried within budget, never a failed request), and zero compiles
+    post-warmup at either (bucket, mesh) point."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.resilience import FaultPlan
+
+    if len(jax.devices()) < 2:
+        return {"name": "serving/sharded_parity", "passed": True,
+                "detail": {"skipped": "needs >= 2 XLA devices — set "
+                                      "XLA_FLAGS=--xla_force_host_"
+                                      "platform_device_count"}}
+    rs = onp.random.RandomState(17)
+    prompts = [rs.randint(0, 61, (l,)).astype("int32")
+               for l in (3, 5, 7, 4, 6, 5)]
+    samp = [{} if i % 2 == 0
+            else dict(temperature=1.0, top_k=8, seed=50 + i)
+            for i in range(len(prompts))]
+    eng1 = _engine(net, name="chaos_sharded_1dev")
+    eng2 = _engine(net, mesh=2, name="chaos_sharded_mesh")
+    warm1, warm2 = eng1.warmup(), eng2.warmup()
+    plan = (FaultPlan()
+            .raise_at(f"serving.decode_step@{eng2.name}", at=2,
+                      retryable=True)
+            .raise_at(f"serving.prefill@{eng2.name}", at=1,
+                      retryable=True))
+    lost = mismatched = 0
+    with plan:
+        with eng1, eng2:
+            futs1 = [eng1.submit(p, max_new_tokens=4, **k)
+                     for p, k in zip(prompts, samp)]
+            futs2 = [eng2.submit(p, max_new_tokens=4, **k)
+                     for p, k in zip(prompts, samp)]
+            for f1, f2 in zip(futs1, futs2):
+                try:
+                    a = f1.result(timeout=60)
+                    b = f2.result(timeout=60)
+                    if not onp.array_equal(a, b):
+                        mismatched += 1
+                except Exception:
+                    lost += 1
+            s1, s2 = eng1.stats(), eng2.stats()
+    _join_zombies()
+    frozen = (s1["compile"]["compiles"] == warm1
+              and s2["compile"]["compiles"] == warm2)
+    passed = (lost == 0 and mismatched == 0 and frozen
+              and s2["resilience"]["retries"] >= 2
+              and plan.fired() == 2
+              and s2["mesh"]["devices"] == 2)
+    return {
+        "name": "serving/sharded_parity",
+        "passed": bool(passed),
+        "detail": {"requests": len(prompts), "lost": lost,
+                   "mismatched": mismatched,
+                   "faults_fired": plan.fired(),
+                   "retries": s2["resilience"]["retries"],
+                   "compile_frozen": frozen,
+                   "mesh": s2["mesh"],
+                   "compile_by_mesh_point": {
+                       **s1["compile"]["by_mesh_point"],
+                       **s2["compile"]["by_mesh_point"]}},
+    }
 
 
 # --------------------------------------------------------- fleet scenarios
@@ -1032,7 +1115,7 @@ def training_kill_resume(kills=3, steps=12):
     from mxnet_tpu import parallel as par
     from mxnet_tpu.resilience import (FaultPlan, ResilientLoop,
                                       SimulatedPreemption)
-    mesh = par.make_mesh(dp=1)
+    mesh = _one_device_mesh(par)
     workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
     try:
         with par.use_mesh(mesh):
@@ -1119,7 +1202,7 @@ def training_checkpoint_corruption(steps=12):
     from mxnet_tpu import parallel as par
     from mxnet_tpu.resilience import (FaultPlan, ResilientLoop,
                                       SimulatedPreemption)
-    mesh = par.make_mesh(dp=1)
+    mesh = _one_device_mesh(par)
     workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
     ckdir = os.path.join(workdir, "chaos")
     try:
@@ -1197,7 +1280,7 @@ def training_nan_storm(steps=10):
     from mxnet_tpu import amp
     from mxnet_tpu import parallel as par
     from mxnet_tpu.resilience import FaultPlan, ResilientLoop
-    mesh = par.make_mesh(dp=1)
+    mesh = _one_device_mesh(par)
     workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
     try:
         with par.use_mesh(mesh):
@@ -1237,7 +1320,7 @@ def training_persistent_nan_rewind(steps=10):
     from mxnet_tpu import amp
     from mxnet_tpu import parallel as par
     from mxnet_tpu.resilience import FaultPlan, ResilientLoop
-    mesh = par.make_mesh(dp=1)
+    mesh = _one_device_mesh(par)
     workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
     try:
         with par.use_mesh(mesh):
@@ -1276,7 +1359,7 @@ def training_bad_batch_quarantine(steps=4):
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.resilience import FaultPlan, ResilientLoop
-    mesh = par.make_mesh(dp=1)
+    mesh = _one_device_mesh(par)
     workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
     try:
         with par.use_mesh(mesh):
@@ -1448,6 +1531,16 @@ def main():
                          "on any claimed-but-never-witnessed or "
                          "witnessed-but-unmapped lock site")
     args = ap.parse_args()
+
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        # the sharded_parity scenario needs virtual host devices, and
+        # the flag is read exactly ONCE at backend bring-up — set it
+        # before any jax initialization.  Harmless everywhere else:
+        # single-device scenarios keep running on cpu:0, and under a
+        # real TPU the flag only affects the host platform.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=2")
 
     if args.corroborate:
         args.lockwitness = True
