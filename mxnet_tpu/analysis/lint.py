@@ -20,6 +20,12 @@ the how-to-add-a-rule recipe):
     catalog (templated entries like ``mxtpu_serving_<counter>_total``
     match as families) — an undocumented metric is invisible to the
     fleet scraper's dashboards.
+``span-name``
+    Every complete ``serving.*``/``fleet.*``/``loop.*`` span or
+    flight-recorder event-name literal must appear in the
+    docs/observability.md taxonomy tables — mirroring ``metric-name``,
+    so recording an event and documenting it stay one change (an
+    undocumented event is a timeline entry no operator can look up).
 ``typed-raise``
     No bare ``ValueError``/``RuntimeError``/``KeyError``/``TypeError``/
     ``Exception`` raised inside ``serving/`` or ``fleet/`` — every
@@ -80,6 +86,8 @@ RULES: Dict[str, str] = {
     "fault-site": "fault site literal not registered in faults.KNOWN_SITES",
     "metric-name": "metric literal violates mxtpu_* naming or is missing "
                    "from the docs/observability.md catalog",
+    "span-name": "span/flight-recorder event name literal missing from "
+                 "the docs/observability.md taxonomy tables",
     "typed-raise": "untyped exception raised on a serving/fleet path "
                    "(must be MXNetError-typed)",
     "naked-acquire": "lock acquired outside `with` without a matching "
@@ -108,6 +116,20 @@ FAULT_PLAN_BUILDERS = ("raise_at", "delay_at", "kill_at", "call_at",
 #: lockwitness constructors whose first argument is a lock site
 LOCK_SITE_CALLS = ("named_lock", "named_rlock", "named_condition",
                    "_named_lock", "_named_rlock", "_named_condition")
+
+#: call names whose first positional string argument is a span or
+#: flight-recorder event name (Tracer.span/record_span/event,
+#: FlightRecorder.record/trigger/dump, ``tr.event``-style wrappers) —
+#: the span-name rule only fires when that argument ALSO matches
+#: _SPAN_NAME_RE, so e.g. ``autograd.record()`` (no args) and
+#: ``metrics.span("prefill")`` (bare phase word) are never candidates
+SPAN_NAME_CALLS = ("span", "record_span", "event", "record", "trigger",
+                   "dump")
+#: a COMPLETE span/event name in the enforced namespaces — the same
+#: components whose fault sites and error taxonomy are already linted
+_SPAN_NAME_RE = re.compile(r"^(?:serving|fleet|loop)\.[a-z0-9_]+$")
+#: backticked span/event tokens in the docs taxonomy tables
+_SPAN_DOC_RE = re.compile(r"`((?:serving|fleet|loop)\.[a-z0-9_]+)`")
 
 METRIC_RE = re.compile(r"^mxtpu_[a-z0-9_]+$")
 _METRIC_DOC_RE = re.compile(r"mxtpu_[a-z0-9_<>]*[a-z0-9_>]")
@@ -301,6 +323,17 @@ def collect_lock_sites(indexes: Sequence[FileIndex]) -> Set[str]:
     return sites
 
 
+def _doc_span_catalog(doc_path: Optional[str]) -> Optional[Set[str]]:
+    """Every backticked ``serving.*``/``fleet.*``/``loop.*`` token in
+    docs/observability.md — the span/event taxonomy the ``span-name``
+    rule enforces.  Recording an event and documenting it are one
+    change, mirroring the metric-name rule."""
+    if not doc_path or not os.path.exists(doc_path):
+        return None
+    with open(doc_path, encoding="utf-8") as f:
+        return set(_SPAN_DOC_RE.findall(f.read()))
+
+
 def _doc_catalog(doc_path: Optional[str]):
     """Parse docs/observability.md into (exact-name set, template-regex
     list).  ``mxtpu_serving_<counter>_total`` becomes a family regex."""
@@ -378,6 +411,36 @@ def _check_metric_names(idx: FileIndex, catalog, findings):
             idx.path, node.lineno, "metric-name",
             f"metric {v!r} is not in the docs/observability.md catalog "
             f"— undocumented metrics are invisible to fleet dashboards"))
+
+
+def _check_span_names(idx: FileIndex, span_catalog: Optional[Set[str]],
+                      findings):
+    """``span-name`` (docs/static_analysis.md): a COMPLETE
+    ``serving.*``/``fleet.*``/``loop.*`` literal passed as the span or
+    flight-recorder event name must appear in the
+    docs/observability.md taxonomy tables — an undocumented event is a
+    timeline entry (or a flight-bundle trigger) no operator can look
+    up at 3am.  Dynamic names and names outside the three enforced
+    namespaces are the runtime's problem, not this rule's."""
+    if span_catalog is None:
+        return
+    for node in idx.calls:
+        if _call_name(node) not in SPAN_NAME_CALLS:
+            continue
+        lit = _str_arg(node)
+        if lit is None:
+            continue
+        name, line = lit
+        if not _SPAN_NAME_RE.match(name):
+            continue
+        if name in span_catalog:
+            continue
+        findings.append(Finding(
+            idx.path, line, "span-name",
+            f"span/event name {name!r} is not in the "
+            f"docs/observability.md taxonomy tables — record an event "
+            f"and document it in one change (backtick it in a taxonomy "
+            f"row)"))
 
 
 def _check_typed_raises(idx: FileIndex, findings):
@@ -558,6 +621,7 @@ def run_lint(paths: Sequence[str],
         cand = os.path.join(root, "docs", "observability.md")
         doc_catalog_path = cand if os.path.exists(cand) else None
     catalog = _doc_catalog(doc_catalog_path)
+    span_catalog = _doc_span_catalog(doc_catalog_path)
 
     if allowlist_path is None:
         from .lockwitness import DEFAULT_ALLOWLIST_PATH
@@ -568,6 +632,7 @@ def run_lint(paths: Sequence[str],
         per_file: List[Finding] = []
         _check_fault_sites(idx, known_sites, per_file)
         _check_metric_names(idx, catalog, per_file)
+        _check_span_names(idx, span_catalog, per_file)
         _check_typed_raises(idx, per_file)
         _check_naked_acquire(idx, per_file)
         _check_wall_clock(idx, per_file)

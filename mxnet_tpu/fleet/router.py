@@ -76,6 +76,7 @@ import numpy as onp
 
 from ..analysis.lockwitness import (named_lock as _named_lock,
                                     note_blocking as _note_blocking)
+from ..observability.flightrecorder import active as _fr_active
 from ..resilience.faults import inject as _inject
 from ..serving.errors import (DeadlineInfeasibleError, EngineCrashedError,
                               EngineStoppedError, FleetSaturatedError,
@@ -284,7 +285,7 @@ class FleetFuture:
         re-raises."""
         if isinstance(exc, EngineCrashedError):
             if handle.mark_dead(str(exc)):
-                self._router._count("replica_deaths")
+                self._router._replica_death(handle, str(exc))
         elif isinstance(exc, QueueFullError):
             # the replica shed queued work under pressure — same
             # breaker signal as a shed at submit
@@ -732,9 +733,32 @@ class FleetRouter:
             self._prev_handlers = None
 
     def _on_term_signal(self, signum, frame):
-        threading.Thread(target=self.stop, kwargs={"drain": True},
+        def _drain():
+            # bundle on the helper thread, never inside the handler:
+            # the interrupted frame may hold locks the bundle's
+            # registry collect() needs (engine.py has the same shape)
+            fr = _fr_active()
+            if fr is not None:
+                fr.trigger("signal.sigterm", fleet=self.name,
+                           signum=signum)
+            self.stop(drain=True)
+
+        threading.Thread(target=_drain,
                          name="mxnet_tpu-fleet-drain",
                          daemon=True).start()
+
+    # ------------------------------------------------------------ forensics
+    def _replica_death(self, h: ReplicaHandle, reason: str) -> None:
+        """One replica transitioned to DEAD (monitor probe, failing
+        submit, or a dropped in-flight attempt): count it, and give the
+        flight recorder its trigger — a replica death is exactly the
+        moment an operator asks what the fleet was doing."""
+        self._count("replica_deaths")
+        fr = _fr_active()
+        if fr is not None:
+            fr.trigger("fleet.replica_death", fleet=self.name,
+                       replica=h.name, reason=reason,
+                       deaths=h.total_deaths)
 
     # ----------------------------------------------------------- monitor
     def _monitor_loop(self):
@@ -742,7 +766,8 @@ class FleetRouter:
             for h in self._handles:
                 try:
                     if h.probe():
-                        self._count("replica_deaths")
+                        self._replica_death(h, h.last_error
+                                            or "health probe failed")
                     elif h.due_for_readmission() and not self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                         # abort= closes the stop-vs-rebuild race: a
                         # rebuild still in flight when the fleet stops
@@ -750,12 +775,22 @@ class FleetRouter:
                         # resurrecting a replica on a stopped fleet
                         if h.rebuild(abort=lambda: self._stopping):  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                             self._count("readmissions")
+                            fr = _fr_active()
+                            if fr is not None:
+                                fr.record("fleet.readmission",
+                                          fleet=self.name,
+                                          replica=h.name)
                     elif h.due_for_unsuspect() and not self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
                         # suspension elapsed: back to traffic with a
                         # fresh latency window — no rebuild, the engine
                         # never stopped (docs/integrity.md)
                         if h.unsuspect():
                             self._count("gray_readmissions")
+                            fr = _fr_active()
+                            if fr is not None:
+                                fr.record("fleet.gray_readmission",
+                                          fleet=self.name,
+                                          replica=h.name)
                 except Exception:
                     continue       # the monitor must outlive any probe
             try:
@@ -798,6 +833,13 @@ class FleetRouter:
                         f"{med * 1e3:.1f}ms over {s['count']} samples",
                         now):
                     self._count("gray_ejections")
+                    fr = _fr_active()
+                    if fr is not None:
+                        fr.record("fleet.gray_ejection", fleet=self.name,
+                                  replica=h.name,
+                                  ewma_ms=round(s["ewma"] * 1e3, 2),
+                                  p99_ms=round(s["p99"] * 1e3, 2),
+                                  peer_median_ms=round(med * 1e3, 2))
             else:
                 h.suspects = 0
 
@@ -901,7 +943,7 @@ class FleetRouter:
                 h.breaker.record_failure(now)
                 if isinstance(e, EngineCrashedError) and \
                         h.mark_dead(str(e)):
-                    self._count("replica_deaths")
+                    self._replica_death(h, str(e))
                 continue
             except InvalidRequestError:
                 h.breaker.release_probe()
@@ -954,6 +996,9 @@ class FleetRouter:
                 self._sat_times.clear()
         if due:
             self._count("fleet_brownouts")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("fleet.brownout", fleet=self.name)
             for h in self._healthy():
                 try:
                     h.engine.force_brownout("fleet saturated")

@@ -18,6 +18,19 @@ resilience/guardrail/io counter dicts):
 - :mod:`.export` — Prometheus text-format and JSON-lines exporters plus
   a :class:`BackgroundExporter` thread with graceful drain (wired into
   ``InferenceEngine.stop()`` and SIGTERM handling).
+- :mod:`.flightrecorder` — failure-time forensics: an always-on,
+  bounded lifecycle-event ring (engine submit/shed/preempt/crash,
+  watchdog trips, loop rewinds/quarantines, fleet deaths/gray
+  ejections/brownouts, page faults, NaN scrubs) that on a trigger —
+  watchdog trip, condemnation, NaN burst, replica death, SIGTERM, SLO
+  breach, explicit ``dump()`` — atomically writes a debug **bundle**:
+  last-N events, implicated span timelines, registry snapshot, every
+  live engine's ``stats()``, the active fault plan, lock-witness
+  graph, and versions.  ``tools/obs_bundle.py`` renders one.
+- :mod:`.slo` — declared objectives (:class:`SLO`) evaluated at scrape
+  time from the existing histograms/counters by :class:`SLOTracker`,
+  exporting ``mxtpu_slo_*`` burn-rate/budget gauges; a breach is a
+  flight-recorder trigger.
 
 Quick start::
 
@@ -38,6 +51,11 @@ from .trace import (Span, Tracer, active as active_tracer,
                     disable as disable_tracing, enable as enable_tracing)
 from .export import (BackgroundExporter, flatten, parse_prometheus,
                      to_json_lines, to_prometheus)
+from .flightrecorder import (FlightRecorder,
+                             active as active_flight_recorder,
+                             disable as disable_flight_recorder,
+                             enable as enable_flight_recorder)
+from .slo import SLO, SLOTracker
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -46,4 +64,7 @@ __all__ = [
     "active_tracer",
     "BackgroundExporter", "to_prometheus", "to_json_lines",
     "parse_prometheus", "flatten",
+    "FlightRecorder", "enable_flight_recorder",
+    "disable_flight_recorder", "active_flight_recorder",
+    "SLO", "SLOTracker",
 ]

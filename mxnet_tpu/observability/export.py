@@ -31,12 +31,21 @@ __all__ = ["to_prometheus", "to_json_lines", "parse_prometheus",
            "flatten", "BackgroundExporter"]
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus label-value escaping (exposition format 0.0.4):
+    backslash, double-quote and NEWLINE are the three escapes.  Engine
+    and fleet names are user-supplied strings, so a raw newline here
+    would tear the sample line in half — half a metric for the scraper
+    and a parse failure for everyone honest."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(
-        '%s="%s"' % (k, str(v).replace("\\", r"\\").replace('"', r"\""))
-        for k, v in sorted(labels.items()))
+    inner = ",".join('%s="%s"' % (k, _escape_label_value(v))
+                     for k, v in sorted(labels.items()))
     return "{%s}" % inner
 
 
@@ -93,9 +102,24 @@ def to_prometheus(snapshot: dict) -> str:
 
 
 _SAMPLE_RE = re.compile(
+    # labels are matched GREEDILY to the last `}` before the value: a
+    # label VALUE may legally contain `}` (only \ " and newline are
+    # escaped), so `[^}]*` would truncate `{name="a}b"}` mid-value
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$")
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label_value(v: str) -> str:
+    """Single-pass inverse of :func:`_escape_label_value`.  Sequential
+    ``str.replace`` calls are WRONG here: with ``\\n`` in the escape
+    set, the wire text ``\\\\n`` (a literal backslash followed by the
+    letter n) contains the two-char sequence ``\\n`` and a naive
+    replace would turn it into a real newline."""
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPES.get(m.group(1), m.group(1)), v)
 
 
 def parse_prometheus(text: str) \
@@ -113,7 +137,7 @@ def parse_prometheus(text: str) \
         if m is None:
             raise ValueError(f"malformed prometheus sample line: {ln!r}")
         labels = tuple(sorted(
-            (k, v.replace(r"\"", '"').replace(r"\\", "\\"))
+            (k, _unescape_label_value(v))
             for k, v in _LABEL_RE.findall(m.group("labels") or "")))
         raw = m.group("value")
         value = float("inf") if raw == "+Inf" else \

@@ -218,6 +218,43 @@ _ACTIVE: Optional[Tracer] = None
 _LOCK = _named_lock("obs.trace_global", "active-tracer swaps")
 
 
+def _ring_samples():
+    """Registry collector: the span ring's occupancy and evictions as
+    scrapeable series (docs/observability.md).  ``tracer.dropped``
+    used to be visible only on the tracer object — a scraper could
+    not tell a quiet host from a ring silently thrashing (every
+    dropped span is a hole in some request's timeline).  No samples
+    while tracing is disabled: there is no ring to report on, and an
+    absent series is distinguishable from a zero one."""
+    tr = _ACTIVE
+    if tr is None:
+        return []
+    with tr._lock:
+        dropped, size = tr.dropped, len(tr._ring)
+    return [
+        {"name": "mxtpu_trace_spans_dropped_total", "kind": "counter",
+         "labels": {}, "value": dropped,
+         "help": "spans evicted by the ring bound — each is a hole in "
+                 "some request's timeline (resets when the tracer is "
+                 "replaced)"},
+        {"name": "mxtpu_trace_ring_spans", "kind": "gauge",
+         "labels": {}, "value": size,
+         "help": "spans currently in the ring"},
+        {"name": "mxtpu_trace_ring_capacity", "kind": "gauge",
+         "labels": {}, "value": tr.capacity,
+         "help": "ring bound — ring_spans pinned here plus a climbing "
+                 "dropped_total means the ring is thrashing"},
+    ]
+
+
+def _register_ring_collector():
+    from .registry import default_registry
+    default_registry().register_collector("trace", _ring_samples)
+
+
+_register_ring_collector()
+
+
 def enable(capacity: int = 4096,
            profiler_markers: bool = False) -> Tracer:
     """Install (or replace) the process-global tracer and return it.
@@ -227,6 +264,10 @@ def enable(capacity: int = 4096,
     tracer = Tracer(capacity=capacity, profiler_markers=profiler_markers)
     with _LOCK:
         _ACTIVE = tracer
+    # re-register on every enable: a test that reset() the registry
+    # (dropping all collectors) still gets ring telemetry back the
+    # moment tracing turns on
+    _register_ring_collector()
     return tracer
 
 

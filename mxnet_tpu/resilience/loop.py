@@ -51,6 +51,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 from .. import base as _base
 from .. import random as _random
+from ..observability.flightrecorder import active as _fr_active
 from ..observability.trace import active as _trace_active
 from .checkpoint import AtomicCheckpointer
 from .faults import RetryableFault
@@ -206,6 +207,10 @@ class ResilientLoop:
             dq = len(ck.quarantined()) - q_before
             if dq:
                 self.metrics.count("checkpoint_quarantines", dq)
+                fr = _fr_active()
+                if fr is not None:
+                    fr.record("loop.quarantine", quarantined=dq,
+                              step=step)
         if step is not None and int(meta.get("step", step)) != int(step):
             self.metrics.count("checkpoint_fallbacks")
             tr = _trace_active()
@@ -396,5 +401,10 @@ class ResilientLoop:
                 tr.event("loop.rewind", step=step,
                          restored=int(_meta.get("step", latest)),
                          consecutive_bad=consecutive_bad)
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("loop.rewind", step=step,
+                          restored=int(_meta.get("step", latest)),
+                          consecutive_bad=consecutive_bad)
             return 0
         return consecutive_bad

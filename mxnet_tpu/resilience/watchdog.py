@@ -64,6 +64,18 @@ class Watchdog(threading.Thread):
                 except Exception:
                     pass           # telemetry must never mask the trip
                 try:
+                    # a trip is a flight-recorder TRIGGER: bundle the
+                    # process state BEFORE on_trip condemns anything —
+                    # the evidence of why dies with the monitored loop
+                    from ..observability.flightrecorder import \
+                        active as _fr_active
+                    fr = _fr_active()
+                    if fr is not None:
+                        fr.trigger("watchdog.trip", watchdog=self.name,
+                                   reason=reason)
+                except Exception:
+                    pass
+                try:
                     self._on_trip(reason)
                 finally:
                     return

@@ -147,6 +147,7 @@ import numpy as onp
 from ..analysis.lockwitness import (named_condition as _named_condition,
                                     named_lock as _named_lock,
                                     note_blocking as _note_blocking)
+from ..observability.flightrecorder import active as _fr_active
 from ..observability.trace import active as _trace_active
 from ..resilience.faults import (RetryableFault, inject as _inject,
                                  poison as _poison)
@@ -886,6 +887,26 @@ class InferenceEngine:
                        "token is not counted as proposed)",
                   fn=bound(accept_rate), **lbl)
 
+        def compile_samples():
+            eng = ref()
+            if eng is None:
+                raise ReferenceError("engine collected")
+            # one gauge per (engine, mesh point): the per-mesh-point
+            # compile freeze — stats()["compile"]["by_mesh_point"] —
+            # made scrapeable, so a production dashboard can alert on
+            # ANY mesh point whose count moves after warmup(), not
+            # only an in-process assertion
+            return [{"name": "mxtpu_serving_compiles", "kind": "gauge",
+                     "labels": {"engine": eng.metrics.name,
+                                "mesh_point": mp},
+                     "value": n,
+                     "help": "XLA compiles at this (engine, mesh "
+                             "point) — frozen after warmup()"}
+                    for mp, n in sorted(eng._compiles_by_mesh.items())]
+
+        reg.register_collector(
+            f"serving-compiles:{self.metrics.name}", compile_samples)
+
     # ------------------------------------------------------------- exporter
     def attach_exporter(self, exporter) -> "InferenceEngine":
         """Tie a :class:`~mxnet_tpu.observability.BackgroundExporter`
@@ -1356,6 +1377,12 @@ class InferenceEngine:
         self._crashed = exc
         self.metrics.count("watchdog_trips")
         self.metrics.mark("watchdog_trip")
+        # forensics: the condemnation IS the moment the evidence dies
+        # with the engine — bundle before the futures are swept, so
+        # the ring still holds the 30 seconds that led here
+        fr = _fr_active()
+        if fr is not None:
+            fr.trigger("serving.crash", engine=self.name, reason=reason)
         self._batcher.close()
         with self._cond:
             self._stopping = True       # a recovered scheduler exits
@@ -1436,8 +1463,21 @@ class InferenceEngine:
 
     def _on_term_signal(self, signum, frame):
         # never drain inside a signal handler (arbitrary interrupted
-        # frame, possibly holding locks) — hand off to a helper thread
-        threading.Thread(target=self.stop, kwargs={"drain": True},
+        # frame, possibly holding locks) — hand off to a helper thread.
+        # The flight-recorder bundle ALSO runs there: the handler may
+        # have interrupted a frame holding the very locks the bundle's
+        # registry collect() needs, and a same-thread re-acquire is a
+        # self-deadlock
+        def _drain():
+            fr = _fr_active()
+            if fr is not None:
+                # SIGTERM is the preemption notice — bundle FIRST, the
+                # drain may not finish before the follow-up SIGKILL
+                fr.trigger("signal.sigterm", engine=self.name,
+                           signum=signum)
+            self.stop(drain=True)
+
+        threading.Thread(target=_drain,
                          name="mxnet_tpu-serving-drain",
                          daemon=True).start()
 
@@ -1480,6 +1520,11 @@ class InferenceEngine:
         if tr is not None:
             tr.event(event, trace_id=trace_id, reason=reason,
                      request=request_id)
+        fr = _fr_active()
+        if fr is not None:
+            fr.record(event, engine=self.name, reason=reason,
+                      priority=priority, request=request_id,
+                      trace_id=trace_id)
         raise exc
 
     def _shed_queued(self, victim: Request, reason: str):
@@ -1495,6 +1540,11 @@ class InferenceEngine:
         if tr is not None:
             tr.event("serving.shed", trace_id=victim.trace_id,
                      reason=reason, request=victim.id)
+        fr = _fr_active()
+        if fr is not None:
+            fr.record("serving.shed", engine=self.name, reason=reason,
+                      priority=victim.priority_name, request=victim.id,
+                      trace_id=victim.trace_id)
         victim.future.set_exception(QueueFullError(
             f"request {victim.id} ({victim.priority_name}) evicted from "
             f"the queue by higher-priority arrival ({reason})"))
@@ -1675,6 +1725,11 @@ class InferenceEngine:
             tr.event("serving.submit", trace_id=req.trace_id,
                      request=req.id, kind=req.kind,
                      priority=req.priority_name)
+        fr = _fr_active()
+        if fr is not None:
+            fr.record("serving.submit", engine=self.name,
+                      request=req.id, kind=req.kind,
+                      priority=req.priority_name, trace_id=req.trace_id)
         try:
             victim = self._batcher.put(req)
         except QueueFullError as e:
@@ -1722,6 +1777,10 @@ class InferenceEngine:
         if not was and self._overload.brownout:
             self.metrics.count("brownouts")
             self.metrics.mark("brownout", reason)
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.brownout", engine=self.name,
+                          reason=reason)
 
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
@@ -2031,6 +2090,10 @@ class InferenceEngine:
         if tr is not None and req.trace_id is not None:
             tr.event("serving.error", trace_id=req.trace_id,
                      error=type(exc).__name__)
+        fr = _fr_active()
+        if fr is not None:
+            fr.record("serving.error", engine=self.name, request=req.id,
+                      error=type(exc).__name__, trace_id=req.trace_id)
 
     def _fail_inflight(self, exc: BaseException):  # guarded-by: _step_lock
         for req in self._batcher.drain():
@@ -2176,6 +2239,10 @@ class InferenceEngine:
         if entered:
             self.metrics.count("brownouts")
             self.metrics.mark("brownout")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.brownout", engine=self.name,
+                          reason="overload")
 
     def _sweep_cancelled(self):
         """Free the slots of requests cancelled mid-decode (the
@@ -2303,6 +2370,11 @@ class InferenceEngine:
         if tr is not None and req.trace_id is not None:
             tr.event("serving.preempt", trace_id=req.trace_id,
                      request=req.id, generated=len(st.generated))
+        fr = _fr_active()
+        if fr is not None:
+            fr.record("serving.preempt", engine=self.name, request=req.id,
+                      generated=len(st.generated),
+                      priority=req.priority_name, trace_id=req.trace_id)
 
     # --------------------------------------------------------- prefix cache
     def _prefix_usable(self) -> bool:  # guarded-by: _step_lock
@@ -2630,6 +2702,11 @@ class InferenceEngine:
         while pages is None:
             self.metrics.count("page_faults")
             self.metrics.mark("page_fault")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.page_fault", engine=self.name,
+                          slot=slot, need=need,
+                          request=st.request.id)
             victim = self._page_victim(slot, st.request.priority)
             if victim is None:
                 st.waiting = True
@@ -2726,6 +2803,10 @@ class InferenceEngine:
             lambda a: a.at[pids].set(0), self._caches))
         if count:
             self.metrics.count("pages_scrubbed", len(freed))
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.scrub", engine=self.name,
+                          pages=len(freed))
 
     # ------------------------------------------------------------ admission
     def _admit(self, live):
@@ -2966,6 +3047,12 @@ class InferenceEngine:
             self._caches = self._place_caches(jax.tree_util.tree_map(
                 lambda a: a.at[slot].set(0), self._caches))
         self.metrics.count("nonfinite_outputs")
+        fr = _fr_active()
+        if fr is not None:
+            # burst detection lives in the recorder: one NaN request is
+            # that request's problem, a burst triggers a bundle
+            fr.nonfinite(engine=self.name, request=st.request.id,
+                         where=where, trace_id=st.request.trace_id)
         self._fail(st.request, NonFiniteOutputError(
             f"request {st.request.id}: non-finite logits in {where} "
             f"after {len(st.generated)} generated tokens — the model "

@@ -86,6 +86,39 @@ def test_rule_metric_name(tmp_path):
     assert lines == [5, 6]
 
 
+def test_rule_span_name(tmp_path):
+    """``span-name``: complete serving./fleet./loop. span and
+    flight-recorder event literals must be backticked in the
+    docs/observability.md taxonomy tables; dynamic names, other
+    namespaces and non-span calls never fire the rule."""
+    cat = tmp_path / "catalog.md"
+    cat.write_text("| `serving.documented` | per request | ... |\n"
+                   "| `fleet.known_event` | attrs | ... |\n")
+    src = (
+        "def f(tr, fr, name):\n"
+        "    tr.event('serving.documented')\n"          # ok: in taxonomy
+        "    fr.record('fleet.known_event', x=1)\n"     # ok: in taxonomy
+        "    tr.span(name)\n"                           # dynamic: skipped
+        "    tr.event('checkpoint.fallback')\n"         # other ns: skipped
+        "    tr.event('chaos.probe')\n"                 # other ns: skipped
+        "    fr.record('prefill')\n"                    # bare word: skipped
+        "    tr.event('serving.undocumented')\n"        # finding
+        "    fr.trigger('fleet.unheard_of')\n"          # finding
+        "    tr.record_span('loop.mystery', 0, 1)\n"    # finding
+    )
+    fs = _lint_snippet(tmp_path, src, catalog=str(cat))
+    assert _rules(fs) == ["span-name"] and len(fs) == 3
+    assert sorted(f.line for f in fs) == [8, 9, 10]
+    # inject()/register_site() calls carry serving.* FAULT sites, which
+    # are the fault-site rule's domain, never span-name's
+    src2 = ("from mxnet_tpu.resilience.faults import inject, "
+            "register_site\n"
+            "register_site('serving.fixture_site')\n"
+            "inject('serving.fixture_site')\n")
+    fs = _lint_snippet(tmp_path / "other", src2, catalog=str(cat))
+    assert all(f.rule != "span-name" for f in fs)
+
+
 def test_rule_typed_raise(tmp_path):
     src = (
         "from mxnet_tpu.base import MXNetError\n"

@@ -1,0 +1,530 @@
+"""Flight recorder + SLO tracking (docs/observability.md §4–§5).
+
+Contracts under test: the event ring is bounded and zero-cost when
+disabled; triggers atomically write bundles that ``tools/obs_bundle.py``
+parses and that name their triggering event; automatic triggers are
+rate-limited while explicit ``dump()`` always writes; an engine
+condemnation, a NaN burst and the SIGTERM path each produce a bundle
+at the failure edge; bundle sections are individually fail-safe; SLO
+objectives evaluate correctly from the existing histograms/counters,
+export the ``mxtpu_slo_*`` gauge family, and a breach transition fires
+the recorder exactly once; the tracer ring and per-mesh-point compile
+accounting are scrapeable.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.observability import flightrecorder as frmod
+from mxnet_tpu.serving import InferenceEngine
+from mxnet_tpu.serving.errors import EngineCrashedError
+from mxnet_tpu.serving.metrics import ServingMetrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import obs_bundle  # noqa: E402  (tools/ has no package __init__)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=1,
+                 num_heads=2, max_length=32, dropout=0.0)
+    n.initialize()
+    return n
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    yield
+    obs.disable_flight_recorder()
+    obs.disable_tracing()
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 61, (l,)).astype("int32") for l in lens]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8,))
+    kw.setdefault("default_max_new_tokens", 4)
+    kw.setdefault("watchdog_interval", 0.05)
+    return InferenceEngine(net, **kw)
+
+
+# ------------------------------------------------------------------ recorder
+
+def test_ring_bounded_and_evictions_counted(tmp_path):
+    fr = obs.enable_flight_recorder(capacity=8, bundle_dir=str(tmp_path))
+    for i in range(20):
+        fr.record("serving.submit", request=i)
+    assert len(fr) == 8
+    assert fr.dropped == 12
+    # oldest evicted, newest kept
+    assert [e.attrs["request"] for e in fr.events()] == list(range(12, 20))
+    fr.clear()
+    assert len(fr) == 0 and fr.dropped == 0
+
+
+def test_disabled_recorder_is_one_none_check():
+    obs.disable_flight_recorder()
+    assert frmod.active() is None
+    assert obs.active_flight_recorder() is None
+
+
+def test_trigger_writes_parseable_bundle(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    fr.record("serving.submit", engine="e1", request=1, trace_id=7)
+    fr.record("serving.shed", engine="e1", reason="queue_full")
+    path = fr.trigger("serving.crash", engine="e1", reason="fixture")
+    assert path is not None and os.path.exists(path)
+    b = obs_bundle.load_bundle(path)
+    assert b["kind"] == frmod.BUNDLE_KIND
+    assert b["trigger"]["name"] == "serving.crash"
+    assert b["trigger"]["attrs"]["reason"] == "fixture"
+    names = [e["name"] for e in b["events"]]
+    # the ring's history AND the trigger itself are in the bundle
+    assert names[:3] == ["serving.submit", "serving.shed",
+                         "serving.crash"]
+    for key in obs_bundle.REQUIRED_KEYS:
+        assert key in b
+    assert b["versions"]["python"]
+    assert isinstance(b["registry"].get("samples"), list)
+    # renders without raising, and names the trigger
+    assert "serving.crash" in obs_bundle.render(b)
+
+
+def test_bundle_write_is_atomic_no_temp_left(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    fr.trigger("serving.crash", engine="e")
+    leftovers = [n for n in os.listdir(tmp_path)
+                 if n.startswith(".bundle-tmp-")]
+    assert leftovers == []
+    # every file present parses completely — no torn publishes
+    for p in fr.bundles():
+        obs_bundle.load_bundle(p)
+
+
+def test_auto_triggers_rate_limited_dump_is_not(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=60.0)
+    p1 = fr.trigger("serving.crash", engine="e")
+    p2 = fr.trigger("serving.crash", engine="e")   # inside the window
+    assert p1 is not None and p2 is None
+    assert len(fr.bundles()) == 1
+    p3 = fr.dump("manual.dump", note="operator asked")
+    assert p3 is not None
+    assert len(fr.bundles()) == 2
+    assert fr.bundles_written == 2
+
+
+def test_bundle_seq_continues_across_recorders(tmp_path):
+    """A fresh recorder pointed at the same bundle_dir (process
+    restart after the crash being debugged, or re-enable()) must not
+    os.replace() over a prior incident's bundle: numbering continues
+    from what is on disk."""
+    fr1 = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                     min_interval=0.0)
+    p1 = fr1.dump("manual.dump", run=1)
+    fr2 = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                     min_interval=0.0)
+    p2 = fr2.dump("manual.dump", run=2)
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+    seqs = sorted(int(os.path.basename(p).split("-")[1])
+                  for p in fr2.bundles())
+    assert seqs == [1, 2]
+    assert obs_bundle.load_bundle(p1)["trigger"]["attrs"]["run"] == 1
+
+
+def test_forced_dump_waits_out_inflight_bundle(tmp_path):
+    """dump() always writes: a bundle in flight on ANOTHER thread is
+    waited out, not silently dropped — the operator's explicit
+    forensics at the moment of an incident must not vanish.  Only
+    same-thread re-entrancy (a bundle section re-triggering) drops."""
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    other = threading.Thread(target=lambda: None)
+    other.start()
+    other.join()
+    with fr._lock:
+        fr._dumping = True
+        fr._dump_thread = other          # an in-flight dump elsewhere
+
+    def release():
+        time.sleep(0.3)
+        with fr._lock:
+            fr._dumping = False
+            fr._dump_thread = None
+
+    t = threading.Thread(target=release)
+    t.start()
+    p = fr.dump("manual.dump")
+    t.join()
+    assert p is not None and os.path.exists(p)
+    # same-thread re-entrancy still drops (no deadlock, no recursion)
+    with fr._lock:
+        fr._dumping = True
+        fr._dump_thread = threading.current_thread()
+    assert fr.dump("manual.dump") is None
+    with fr._lock:
+        fr._dumping = False
+        fr._dump_thread = None
+
+
+def test_max_bundles_prunes_oldest(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0, max_bundles=3)
+    for i in range(6):
+        assert fr.dump("manual.dump", i=i) is not None
+    paths = fr.bundles()
+    assert len(paths) == 3
+    # the survivors are the newest three (seq 4, 5, 6)
+    seqs = sorted(int(os.path.basename(p).split("-")[1]) for p in paths)
+    assert seqs == [4, 5, 6]
+
+
+def test_bundle_sections_fail_safe(tmp_path, monkeypatch):
+    """A producer that raises mid-dump yields an error stanza, never a
+    lost bundle — forensics must not die of the failure it documents."""
+    from mxnet_tpu.observability import slo as slomod
+    monkeypatch.setattr(slomod, "tracker_snapshots",
+                        lambda: 1 / 0)
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    path = fr.trigger("serving.crash", engine="e")
+    assert path is not None
+    b = obs_bundle.load_bundle(path)
+    assert "error" in b["slo"]
+    assert b["trigger"]["name"] == "serving.crash"
+
+
+def test_nonfinite_burst_triggers_once_per_window(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0,
+                                    nonfinite_burst=3,
+                                    nonfinite_window=60.0)
+    assert fr.nonfinite(engine="e", request=1) is None
+    assert fr.nonfinite(engine="e", request=2) is None
+    p = fr.nonfinite(engine="e", request=3)        # burst edge
+    assert p is not None
+    b = obs_bundle.load_bundle(p)
+    assert b["trigger"]["name"] == "serving.nonfinite_burst"
+    # still inside the window: more NaNs record but do not re-trigger
+    assert fr.nonfinite(engine="e", request=4) is None
+    assert len(fr.events("serving.nonfinite")) == 4
+
+
+def test_record_never_raises(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path))
+    fr.record("serving.submit", payload=object())   # non-serializable attr
+    assert len(fr.events("serving.submit")) == 1
+    # and the bundle still writes (default=repr in the JSON dump)
+    assert fr.dump("manual.dump") is not None
+
+
+def test_fault_plan_section(tmp_path):
+    from mxnet_tpu.resilience import FaultPlan
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    with FaultPlan(seed=3).raise_at("serving.decode_step", at=1):
+        p = fr.trigger("serving.crash", engine="e")
+    b = obs_bundle.load_bundle(p)
+    assert b["fault_plan"] is not None
+    assert b["fault_plan"]["seed"] == 3
+    assert any("serving.decode_step" in s for s in
+               b["fault_plan"]["specs"])
+    # without an active plan the section is null
+    p2 = fr.dump("manual.dump")
+    assert obs_bundle.load_bundle(p2)["fault_plan"] is None
+
+
+# --------------------------------------------------------- engine wiring
+
+def test_condemned_engine_bundles_with_live_stats(net, tmp_path):
+    """The tentpole contract: an EngineCrashedError origin writes a
+    bundle BEFORE the evidence dies — carrying the ring's lead-up
+    events and the condemned engine's own stats()."""
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    eng = _engine(net, name="forensic_fixture")
+    with eng:
+        futs = [eng.submit(p, max_new_tokens=2)
+                for p in _prompts((3, 4))]
+        for f in futs:
+            f.result(timeout=60)
+        eng.condemn("fixture condemnation")
+        with pytest.raises(EngineCrashedError):
+            eng.submit(_prompts((3,))[0])
+    crash_bundles = [p for p in fr.bundles() if "serving.crash" in p]
+    assert crash_bundles, fr.bundles()
+    b = obs_bundle.load_bundle(crash_bundles[0])
+    assert b["trigger"]["name"] == "serving.crash"
+    assert "fixture condemnation" in b["trigger"]["attrs"]["reason"]
+    names = {e["name"] for e in b["events"]}
+    assert "serving.submit" in names          # the lead-up survived
+    eng_stats = b["engines"]["forensic_fixture"]
+    assert eng_stats["engine"]["name"] == "forensic_fixture"
+    assert "by_mesh_point" in eng_stats["compile"]
+    assert "kv_layout" in eng_stats["slots"]
+    # post-condemnation rejects are recorded too (ring keeps rolling)
+    assert fr.events("serving.reject")
+
+
+def test_sigterm_path_bundles(net, tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    eng = _engine(net, name="sigterm_fixture")
+    eng.start()
+    # call the handler directly — it spawns the drain helper thread,
+    # which triggers the bundle then stops the engine
+    eng._on_term_signal(15, None)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any("signal.sigterm" in p for p in fr.bundles()) \
+                and eng._thread is not None and not eng._thread.is_alive():
+            break
+        time.sleep(0.05)
+    sig = [p for p in fr.bundles() if "signal.sigterm" in p]
+    assert sig
+    b = obs_bundle.load_bundle(sig[0])
+    assert b["trigger"]["name"] == "signal.sigterm"
+    assert b["trigger"]["attrs"]["engine"] == "sigterm_fixture"
+
+
+def test_tracer_timelines_implicated_in_bundle(net, tmp_path):
+    tracer = obs.enable_tracing(capacity=512)
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    with _engine(net, name="trace_fixture") as eng:
+        fut = eng.submit(_prompts((4,))[0], max_new_tokens=2)
+        fut.result(timeout=60)
+        p = fr.dump("manual.dump")
+    b = obs_bundle.load_bundle(p)
+    assert b["traces"]["enabled"] is True
+    tl = b["traces"]["timelines"].get(str(fut.trace_id))
+    assert tl, b["traces"]
+    assert any(s["name"] == "serving.request" for s in tl)
+    assert tracer.timeline(fut.trace_id)   # the live ring agrees
+
+
+# ------------------------------------------------------------------- SLOs
+
+def _metrics_with(completed=0, timeouts=0, queue_full=0, crashed=0,
+                  ttft=()):
+    m = ServingMetrics("slo_fixture", register=False)
+    m.count("completed", completed)
+    m.count("timeouts", timeouts)
+    m.count("rejected_queue_full", queue_full)
+    m.count("rejected_crashed", crashed)
+    for t in ttft:
+        m.ttft.observe(t)
+    return m
+
+
+def test_slo_validation():
+    with pytest.raises(Exception):
+        obs.SLO("empty")
+    with pytest.raises(Exception):
+        obs.SLO("bad", ttft_p99=-1.0)
+    with pytest.raises(Exception):
+        obs.SLO("bad", availability=1.0)     # zero error budget
+    with pytest.raises(Exception):
+        obs.SLOTracker(obs.SLO("x", availability=0.9), object())
+
+
+def test_slo_hit_rate_and_availability_math():
+    m = _metrics_with(completed=90)
+    t = obs.SLOTracker(obs.SLO("s", deadline_hit_rate=0.95,
+                               availability=0.95), m, register=False)
+    m.count("completed", 90)
+    m.count("timeouts", 10)
+    m.count("rejected_queue_full", 5)
+    recs = {r["objective"]: r for r in t.evaluate()}
+    hr = recs["deadline_hit_rate"]
+    assert hr["observed"] == pytest.approx(90 / 100)
+    assert hr["breached"] is True
+    # error rate 0.10 against a 0.05 budget: burn 2x, remaining -1
+    assert hr["burn_rate"] == pytest.approx(2.0)
+    assert hr["budget_remaining"] == pytest.approx(-1.0)
+    av = recs["availability"]
+    assert av["observed"] == pytest.approx(90 / 95)
+    assert av["breached"] is True
+    # a second evaluation with no new traffic burns nothing
+    recs2 = {r["objective"]: r for r in t.evaluate()}
+    assert recs2["deadline_hit_rate"]["burn_rate"] == 0.0
+    # but the integrated budget stays spent
+    assert recs2["deadline_hit_rate"]["budget_remaining"] == \
+        pytest.approx(-1.0)
+    # reset starts a new period
+    t.reset()
+    recs3 = {r["objective"]: r for r in t.evaluate()}
+    assert recs3["deadline_hit_rate"]["breached"] is False
+    assert recs3["deadline_hit_rate"]["budget_remaining"] == 1.0
+
+
+def test_slo_ttft_p99_objective():
+    # samples land AFTER the tracker baseline — the objective is
+    # evaluated over the tracker's window, not the histogram's lifetime
+    fast = _metrics_with()
+    t = obs.SLOTracker(obs.SLO("s", ttft_p99=0.100), fast,
+                       register=False)
+    for v in [0.010] * 99 + [0.020]:
+        fast.ttft.observe(v)
+    rec = t.evaluate()[0]
+    assert rec["objective"] == "ttft_p99"
+    assert rec["samples"] == 100
+    assert 0 < rec["observed"] <= 0.100 and rec["breached"] is False
+    slow = _metrics_with()
+    t2 = obs.SLOTracker(obs.SLO("s2", ttft_p99=0.100), slow,
+                        register=False)
+    for v in [0.010] * 50 + [0.500] * 50:
+        slow.ttft.observe(v)
+    rec2 = t2.evaluate()[0]
+    assert rec2["observed"] > 0.100 and rec2["breached"] is True
+    # ~half the mass is above target against a 1% budget
+    assert rec2["burn_rate"] > 10
+    # pre-baseline history is invisible: a fresh tracker over the SAME
+    # slow histogram sees an empty window and no breach
+    t3 = obs.SLOTracker(obs.SLO("s3", ttft_p99=0.100), slow,
+                        register=False)
+    rec3 = t3.evaluate()[0]
+    assert rec3["samples"] == 0 and rec3["breached"] is False
+
+
+def test_slo_breach_fires_flight_recorder_once(tmp_path):
+    fr = obs.enable_flight_recorder(bundle_dir=str(tmp_path),
+                                    min_interval=0.0)
+    m = _metrics_with(completed=100)
+    t = obs.SLOTracker(obs.SLO("breach_fixture",
+                               deadline_hit_rate=0.99), m,
+                       register=False)
+    m.count("timeouts", 50)
+    t.evaluate()
+    breach = [p for p in fr.bundles() if "slo.breach" in p]
+    assert len(breach) == 1
+    b = obs_bundle.load_bundle(breach[0])
+    assert b["trigger"]["name"] == "slo.breach"
+    assert b["trigger"]["attrs"]["objective"] == "deadline_hit_rate"
+    # the bundle embeds the tracker's own verdict (snapshot, no
+    # re-evaluation)
+    assert any(o["objective"] == "deadline_hit_rate" and o["breached"]
+               for snap in b["slo"] for o in snap["objectives"])
+    # latched: still breached on re-evaluation, no second bundle
+    t.evaluate()
+    assert len([p for p in fr.bundles() if "slo.breach" in p]) == 1
+    # recovery unlatches; a NEW breach fires again
+    m.count("completed", 100000)
+    t.reset()
+    t.evaluate()
+    m.count("timeouts", 100000)
+    t.evaluate()
+    assert len([p for p in fr.bundles() if "slo.breach" in p]) == 2
+
+
+def test_slo_gauges_in_registry_collect():
+    reg = obs.default_registry()
+    m = _metrics_with(completed=100)
+    t = obs.SLOTracker(obs.SLO("collect_fixture",
+                               availability=0.999,
+                               deadline_hit_rate=0.999), m)
+    try:
+        samples = [s for s in reg.collect()["samples"]
+                   if s["name"].startswith("mxtpu_slo_")
+                   and s["labels"].get("slo") == "collect_fixture"]
+        names = {s["name"] for s in samples}
+        assert names == {"mxtpu_slo_target", "mxtpu_slo_value",
+                         "mxtpu_slo_breached", "mxtpu_slo_burn_rate",
+                         "mxtpu_slo_budget_remaining"}
+        objectives = {s["labels"]["objective"] for s in samples}
+        assert objectives == {"availability", "deadline_hit_rate"}
+        assert all(s["labels"]["source"] == "slo_fixture"
+                   for s in samples)
+        # prometheus rendering round-trips the family
+        text = obs.to_prometheus({"samples": samples})
+        parsed = obs.parse_prometheus(text)
+        assert any(n == "mxtpu_slo_breached" for n, _l in parsed)
+    finally:
+        reg.unregister_collector("slo:collect_fixture:slo_fixture")
+
+
+def test_slo_trackers_sharing_a_name_do_not_evict_each_other():
+    """A fleet declares ONE SLO name across N replica trackers: each
+    registers under (slo, source), so one scrape carries every
+    replica's gauges side by side instead of last-writer-wins."""
+    reg = obs.default_registry()
+    m1 = ServingMetrics("slo_replica_1", register=False)
+    m2 = ServingMetrics("slo_replica_2", register=False)
+    t1 = obs.SLOTracker(obs.SLO("shared_slo", availability=0.99), m1)
+    t2 = obs.SLOTracker(obs.SLO("shared_slo", availability=0.99), m2)
+    assert t1 is not t2                  # hold both: collectors are weak
+    try:
+        sources = {s["labels"]["source"]
+                   for s in reg.collect()["samples"]
+                   if s["name"] == "mxtpu_slo_target"
+                   and s["labels"].get("slo") == "shared_slo"}
+        assert sources == {"slo_replica_1", "slo_replica_2"}
+    finally:
+        reg.unregister_collector("slo:shared_slo:slo_replica_1")
+        reg.unregister_collector("slo:shared_slo:slo_replica_2")
+
+
+def test_fraction_above_interpolation():
+    from mxnet_tpu.observability.slo import fraction_above
+    from mxnet_tpu.serving.metrics import LatencyHistogram
+    h = LatencyHistogram()
+    for _ in range(80):
+        h.observe(0.001)
+    for _ in range(20):
+        h.observe(1.0)
+    assert fraction_above(h, 0.1) == pytest.approx(0.2, abs=0.02)
+    assert fraction_above(h, 2.0) == 0.0        # above observed max
+    assert fraction_above(h, 1e-9) == pytest.approx(1.0)
+    assert fraction_above(LatencyHistogram(), 0.1) == 0.0
+
+
+# ------------------------------------------- trace-ring + compile gauges
+
+def test_trace_ring_metrics_exported():
+    reg = obs.default_registry()
+    obs.disable_tracing()
+    assert not any(s["name"].startswith("mxtpu_trace_")
+                   for s in reg.collect()["samples"])
+    tracer = obs.enable_tracing(capacity=4)
+    for i in range(10):
+        tracer.event("chaos.filler", i=i)
+    by_name = {s["name"]: s for s in reg.collect()["samples"]
+               if s["name"].startswith("mxtpu_trace_")}
+    assert by_name["mxtpu_trace_ring_spans"]["value"] == 4
+    assert by_name["mxtpu_trace_ring_capacity"]["value"] == 4
+    assert by_name["mxtpu_trace_spans_dropped_total"]["value"] == 6
+    assert by_name["mxtpu_trace_spans_dropped_total"]["kind"] == "counter"
+
+
+def test_compiles_by_mesh_point_gauge_family(net):
+    reg = obs.default_registry()
+    eng = _engine(net, name="compile_gauge_fixture")
+    with eng:
+        eng.warmup()
+        fut = eng.submit(_prompts((4,))[0], max_new_tokens=2)
+        fut.result(timeout=60)
+        samples = [s for s in reg.collect()["samples"]
+                   if s["name"] == "mxtpu_serving_compiles"
+                   and s["labels"].get("engine")
+                   == "compile_gauge_fixture"]
+        stats = eng.stats()
+    assert samples, "no mxtpu_serving_compiles samples"
+    by_point = {s["labels"]["mesh_point"]: s["value"] for s in samples}
+    assert by_point == stats["compile"]["by_mesh_point"]
+    assert sum(by_point.values()) == stats["compile"]["compiles"]

@@ -267,6 +267,90 @@ def test_prometheus_round_trip():
         parse_prometheus(text[:len(text) // 2] + "\ngarbage{")
 
 
+def test_prometheus_label_value_escaping_round_trip():
+    """Engine and fleet names are user-supplied strings: label values
+    holding ``"``, ``\\`` and NEWLINES must round-trip through the
+    exposition format (a raw newline would tear the sample line in
+    half).  Includes the sequential-unescape trap: a literal backslash
+    followed by the letter n must NOT come back as a newline."""
+    nasty = [
+        'plain', 'quo"te', 'back\\slash', 'newline\nsplit',
+        'back\\slash then "quote"', '\\n is two chars, not a newline',
+        'trailing backslash\\', '\n', '\\', '"', 'brace}value',
+        'all\\of"it\ntogether}',
+    ]
+    for i, v in enumerate(nasty):
+        snap = {"samples": [{"name": "esc_gauge", "kind": "gauge",
+                             "labels": {"engine": v}, "value": float(i),
+                             "help": ""}]}
+        text = to_prometheus(snap)
+        parsed = parse_prometheus(text)
+        assert parsed == {("esc_gauge", (("engine", v),)): float(i)}, \
+            (v, text)
+
+
+def test_prometheus_label_value_escaping_fuzz():
+    import random
+    rng = random.Random(20260804)
+    alphabet = list('ab"\\\n}{=,x ') + ["\\n", "\\\\"]
+    for trial in range(200):
+        v = "".join(rng.choice(alphabet)
+                    for _ in range(rng.randint(0, 12)))
+        k = "k" + str(trial)
+        snap = {"samples": [{"name": "fuzz_gauge", "kind": "gauge",
+                             "labels": {k: v}, "value": 1.0,
+                             "help": ""}]}
+        text = to_prometheus(snap)
+        parsed = parse_prometheus(text)
+        assert parsed == {("fuzz_gauge", ((k, v),)): 1.0}, (repr(v), text)
+
+
+def test_background_exporter_raising_sink_survives(tmp_path):
+    """A ``sink=`` that raises must not kill the daemon thread:
+    failures are counted, later ticks retry, and ``stop(flush=True)``
+    still joins (docs/observability.md — a transient push-gateway
+    outage must not lose the exporter for good)."""
+    reg = MetricsRegistry()
+    reg.counter("sink_total").inc()
+    calls = {"n": 0}
+
+    def flaky_sink(text):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("gateway down")
+
+    exp = BackgroundExporter(sink=flaky_sink, interval=0.01, registry=reg)
+    with exp:
+        deadline = time.monotonic() + 10
+        while (exp.errors < 2 or exp.exports < 1) \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert not exp.is_alive()              # stop() joined despite errors
+    assert exp.errors >= 2                 # failures counted + surfaced
+    assert exp.exports >= 1                # ...and later ticks recovered
+
+
+def test_background_exporter_unwritable_path_survives(tmp_path):
+    """An unwritable ``path=`` (full disk, bad mount) is the same
+    contract: errors counted, thread alive until stop, final flush
+    attempt does not raise."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("a file where the export dir should be")
+    out = str(blocker / "m.prom")          # mkdir will fail: parent=file
+    reg = MetricsRegistry()
+    reg.counter("nope_total").inc()
+    exp = BackgroundExporter(path=out, interval=0.01, registry=reg)
+    with exp:
+        deadline = time.monotonic() + 10
+        while exp.errors < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert exp.is_alive()              # still running, not dead
+    assert not exp.is_alive()              # stop(flush=True) joined
+    assert exp.errors >= 2 and exp.exports == 0
+    # the flush error path never published a torn/partial file
+    assert not os.path.exists(out)
+
+
 def test_json_lines_every_line_parses():
     reg = MetricsRegistry()
     reg.counter("jl_total").inc()

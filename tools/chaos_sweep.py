@@ -1479,6 +1479,20 @@ def corroboration_probes(net):
     _join_zombies()
     probed.append(("fleet.router.saturation",
                    f"1-replica depth-1 flood ({sheds} fleet-wide sheds)"))
+    # SLO tracker state lock: only constructed when objectives are
+    # declared, which the matrix scenarios themselves never do (the
+    # per-scenario flight recorder exercises its own locks in every
+    # scenario, but the SLO plane is opt-in)
+    from mxnet_tpu.observability import SLO, SLOTracker
+    from mxnet_tpu.serving.metrics import ServingMetrics
+    sm = ServingMetrics("probe_slo", register=False)
+    sm.count("completed", 5)
+    trk = SLOTracker(SLO("probe_slo", availability=0.99), sm,
+                     register=False)
+    trk.evaluate()
+    trk.reset()
+    probed.append(("obs.slo", "SLOTracker evaluate/reset over probe "
+                              "metrics"))
     return probed
 
 
@@ -1506,6 +1520,79 @@ def raceguard_corroboration(witness, probed):
             "probes": [f"{site}: {how}" for site, how in probed],
             "acquisitions_per_mapped_site":
                 verdict["acquisitions_per_mapped_site"],
+        },
+    }
+
+
+# --------------------------------------------------------------- forensics
+
+#: scenarios whose failure path must hit an AUTOMATIC flight-recorder
+#: trigger (not the end-of-scenario dump): scenario -> acceptable
+#: trigger names.  These are the strong cases — the debugging story
+#: must fire at the failure edge, before the evidence is swept.
+FORENSICS_AUTO = {
+    "scheduler_crash": ("watchdog.trip", "serving.crash"),
+    "hung_step": ("watchdog.trip", "serving.crash"),
+    "sigterm_drain": ("signal.sigterm",),
+    "exporter_storm": ("signal.sigterm", "watchdog.trip",
+                       "serving.crash"),
+    "replica_kill": ("fleet.replica_death", "watchdog.trip",
+                     "serving.crash"),
+    "retry_storm": ("fleet.replica_death", "watchdog.trip",
+                    "serving.crash"),
+}
+
+
+def forensics_scenario(forensic_log, obs_bundle):
+    """The failure-time forensics contract (docs/observability.md
+    "Flight recorder"): every scenario in the matrix — in particular
+    every failure-injecting one — produced at least one bundle, every
+    bundle parses through ``tools/obs_bundle.py`` and names its
+    triggering event, and the scenarios whose failure path crosses an
+    automatic trigger (watchdog trip, condemnation, replica death,
+    SIGTERM) bundled themselves AT the failure edge rather than
+    relying on the end-of-scenario dump."""
+    problems = []
+    parsed = 0
+    auto_ok = {}
+    for entry in forensic_log:
+        name = entry["scenario"]
+        if not entry["bundles"]:
+            problems.append(f"{name}: no bundle on disk")
+            continue
+        triggers = []
+        for path in entry["bundles"]:
+            try:
+                b = obs_bundle.load_bundle(path)
+            except obs_bundle.BundleError as e:
+                problems.append(f"{name}: {e}")
+                continue
+            parsed += 1
+            triggers.append(b["trigger"]["name"])
+        if not triggers:
+            problems.append(f"{name}: no parseable bundle")
+            continue
+        expect = FORENSICS_AUTO.get(name)
+        if expect is not None:
+            hit = [t for t in triggers if t in expect]
+            auto_ok[name] = bool(hit)
+            if not hit:
+                problems.append(
+                    f"{name}: expected an automatic trigger from "
+                    f"{expect}, bundles carried {triggers}")
+    return {
+        "name": "forensics",
+        "passed": not problems,
+        "detail": {
+            "scenarios_checked": len(forensic_log),
+            "bundles_parsed": parsed,
+            "auto_triggered": auto_ok,
+            "problems": problems,
+            "per_scenario": [
+                {"scenario": e["scenario"],
+                 "auto_bundles": e["auto_bundles"],
+                 "events": e["event_names"]}
+                for e in forensic_log],
         },
     }
 
@@ -1560,17 +1647,50 @@ def main():
     from mxnet_tpu.utils.platform import init_backend
     platform = init_backend()
 
+    # forensics (docs/observability.md "Flight recorder"): every
+    # scenario runs with a FRESH flight recorder; scenarios whose
+    # failure path hits an automatic trigger (watchdog trip, engine
+    # condemnation, replica death, SIGTERM, NaN burst) bundle
+    # themselves, and every other scenario gets an explicit
+    # end-of-scenario dump() — the trigger matrix's escape hatch — so
+    # the `forensics` scenario can assert that EVERY scenario in the
+    # matrix yields a bundle tools/obs_bundle.py parses and that names
+    # its triggering event.  This is the first scenario set that tests
+    # the debugging story itself, not just the recovery story.
+    from mxnet_tpu.observability import flightrecorder as _flightrec
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_bundle as _obs_bundle
+
+    bundles_root = tempfile.mkdtemp(prefix="mxtpu-chaos-bundles-")
+    forensic_log = []
+
     scenarios = []
 
-    def run(fn, *a, **kw):
+    def run(fn, *a, _label=None, **kw):
+        label = _label or getattr(fn, "__name__", str(fn))
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in label)
+        fr = _flightrec.enable(
+            bundle_dir=os.path.join(bundles_root, safe),
+            min_interval=0.25)
         t0 = time.perf_counter()
         try:
             rec = fn(*a, **kw)
             recs = rec if isinstance(rec, list) else [rec]
         except Exception:
-            recs = [{"name": getattr(fn, "__name__", str(fn)),
+            recs = [{"name": label,
                      "passed": False,
                      "detail": {"error": traceback.format_exc(limit=5)}}]
+        auto = fr.bundles()
+        if not auto:
+            fr.dump("chaos.scenario_end", scenario=label)
+        forensic_log.append({
+            "scenario": label,
+            "auto_bundles": [os.path.basename(p) for p in auto],
+            "bundles": fr.bundles(),
+            "event_names": sorted({e.name for e in fr.events()}),
+        })
+        _flightrec.disable()
         for r in recs:
             r["seconds"] = round(time.perf_counter() - t0, 2)
             scenarios.append(r)
@@ -1579,13 +1699,16 @@ def main():
 
     net = _tiny_gpt2()
     for _name, thunk in serving_scenarios(net):
-        run(thunk)
+        run(thunk, _label=_name)
     run(training_kill_resume, kills=args.kills, steps=args.steps)
     run(training_commit_kill)
     run(training_checkpoint_corruption)
     run(training_nan_storm)
     run(training_persistent_nan_rewind)
     run(training_bad_batch_quarantine)
+
+    run(lambda: forensics_scenario(forensic_log, _obs_bundle),
+        _label="forensics")
 
     probed = []
     if witness is not None and args.corroborate:

@@ -1,0 +1,222 @@
+"""obs_bundle — validate and render flight-recorder debug bundles.
+
+A bundle (docs/observability.md "Flight recorder") is the JSON the
+:class:`~mxnet_tpu.observability.FlightRecorder` writes when a failure
+trigger fires — watchdog trip, engine condemnation, NaN burst, replica
+death, SIGTERM, SLO breach, or an explicit ``dump()``.  This tool is
+the operator's (and the chaos sweep's) reader:
+
+    python tools/obs_bundle.py <bundle.json> [...]
+    python tools/obs_bundle.py --json <bundle.json>     # validated dict
+    python tools/obs_bundle.py --validate <bundle.json> # parse only
+
+Exit code 0 when every bundle parses and validates, 1 on any invalid/
+unreadable bundle, 2 on usage errors (the verify_checkpoint.py
+convention).  ``load_bundle`` is importable — ``tools/chaos_sweep.py``
+uses it to assert that every failure-injecting scenario produced a
+bundle this tool can read and that names its triggering event.
+
+Purely stdlib: no jax, no mxnet_tpu import — a bundle must be readable
+on a laptop that cannot build the stack that crashed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+#: must match flightrecorder.BUNDLE_KIND / BUNDLE_SCHEMA_VERSION (not
+#: imported: this tool must run without the package installed)
+BUNDLE_KIND = "mxtpu-flight-bundle"
+KNOWN_SCHEMA_VERSIONS = (1,)
+
+#: sections every bundle carries (each may be an {"error": ...} stanza
+#: — a producer mid-teardown degrades the section, not the bundle)
+REQUIRED_KEYS = ("schema_version", "kind", "written_at", "trigger",
+                 "events", "traces", "registry", "engines", "slo",
+                 "fault_plan", "lockwitness", "recorder", "versions")
+
+
+class BundleError(ValueError):
+    """The file is not a readable flight bundle."""
+
+
+def load_bundle(path: str) -> dict:
+    """Parse and validate one bundle; raises :class:`BundleError` on
+    anything that is not a complete, trigger-named flight bundle (a
+    torn or foreign JSON must FAIL loudly, not half-render)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except OSError as e:
+        raise BundleError(f"{path}: unreadable: {e}") from None
+    except ValueError as e:
+        raise BundleError(f"{path}: not valid JSON: {e}") from None
+    if not isinstance(bundle, dict):
+        raise BundleError(f"{path}: expected a JSON object, "
+                          f"got {type(bundle).__name__}")
+    if bundle.get("kind") != BUNDLE_KIND:
+        raise BundleError(f"{path}: kind={bundle.get('kind')!r} is not "
+                          f"a flight bundle ({BUNDLE_KIND!r})")
+    if bundle.get("schema_version") not in KNOWN_SCHEMA_VERSIONS:
+        raise BundleError(
+            f"{path}: unknown schema_version "
+            f"{bundle.get('schema_version')!r} (this tool knows "
+            f"{KNOWN_SCHEMA_VERSIONS}) — refuse to guess at forensics")
+    missing = [k for k in REQUIRED_KEYS if k not in bundle]
+    if missing:
+        raise BundleError(f"{path}: missing sections: {missing}")
+    trig = bundle["trigger"]
+    if not (isinstance(trig, dict) and isinstance(trig.get("name"), str)
+            and trig["name"]):
+        raise BundleError(f"{path}: trigger does not name its event "
+                          f"(got {trig!r}) — a bundle that cannot say "
+                          "WHY it exists is not forensics")
+    if not isinstance(bundle["events"], list):
+        raise BundleError(f"{path}: events is not a list")
+    return bundle
+
+
+def _fmt_attrs(attrs: dict, limit: int = 5) -> str:
+    items = list(attrs.items())[:limit]
+    s = " ".join(f"{k}={v!r}" for k, v in items)
+    return s + (" …" if len(attrs) > limit else "")
+
+
+def render(bundle: dict) -> str:
+    """Human summary: trigger, the trailing event timeline, per-engine
+    vitals, SLO verdicts, and the environment stamp."""
+    out: List[str] = []
+    trig = bundle["trigger"]
+    out.append(f"flight bundle (schema v{bundle['schema_version']}) "
+               f"written_at={bundle['written_at']}")
+    out.append(f"TRIGGER  {trig['name']}  {_fmt_attrs(trig.get('attrs', {}))}")
+
+    events = bundle["events"]
+    out.append(f"\nevents ({len(events)} bundled, newest last):")
+    t_trig = None
+    for e in events:
+        if e.get("name") == trig["name"]:
+            t_trig = e.get("t")
+    for e in events:
+        dt = ""
+        if t_trig is not None and isinstance(e.get("t"), (int, float)):
+            dt = f"{e['t'] - t_trig:+9.3f}s "
+        out.append(f"  {dt}{e.get('name', '?'):28s} "
+                   f"{_fmt_attrs(e.get('attrs', {}))}")
+
+    engines = bundle.get("engines")
+    if isinstance(engines, dict) and "error" not in engines:
+        for name, st in sorted(engines.items()):
+            if not isinstance(st, dict) or "error" in st:
+                out.append(f"\nengine {name}: {st}")
+                continue
+            eng = st.get("engine", {})
+            comp = st.get("compile", {})
+            slots = st.get("slots", {})
+            res = st.get("resilience", {})
+            out.append(
+                f"\nengine {name}: mode={eng.get('mode')} "
+                f"queued={eng.get('queued')} "
+                f"active={eng.get('active_slots')}/{eng.get('num_slots')} "
+                f"crashed={eng.get('crashed')}")
+            out.append(
+                f"  compile: {comp.get('compiles')} total, "
+                f"by_mesh_point={comp.get('by_mesh_point')}")
+            out.append(
+                f"  kv: layout={slots.get('kv_layout')} "
+                f"pages={slots.get('pages_free')}/"
+                f"{slots.get('pages_total')} free "
+                f"page_faults={slots.get('page_faults')} "
+                f"scrubbed={slots.get('pages_scrubbed')}")
+            out.append(
+                f"  resilience: retries={res.get('retries')} "
+                f"watchdog_trips={res.get('watchdog_trips')} "
+                f"nonfinite={res.get('nonfinite_outputs')}")
+    elif engines:
+        out.append(f"\nengines: {engines}")
+
+    slo = bundle.get("slo")
+    if isinstance(slo, list) and slo:
+        out.append("\nSLOs:")
+        for snap in slo:
+            for rec in snap.get("objectives", []):
+                mark = "BREACHED" if rec.get("breached") else "ok"
+                out.append(
+                    f"  {snap.get('slo')}/{rec.get('objective')}: "
+                    f"{mark} observed={rec.get('observed')} "
+                    f"target={rec.get('target')} "
+                    f"burn={rec.get('burn_rate')} "
+                    f"budget_remaining={rec.get('budget_remaining')}")
+
+    plan = bundle.get("fault_plan")
+    if plan and isinstance(plan, dict) and "error" not in plan:
+        out.append(f"\nactive fault plan: {plan.get('repr')} "
+                   f"(last fires: {plan.get('log', [])[-5:]})")
+
+    lw = bundle.get("lockwitness")
+    if lw and isinstance(lw, dict) and "error" not in lw:
+        out.append(f"\nlockwitness: nodes={lw.get('nodes')} "
+                   f"edges={lw.get('edges')} cycles={lw.get('cycles')} "
+                   f"findings={len(lw.get('findings') or [])}")
+
+    traces = bundle.get("traces")
+    if isinstance(traces, dict) and traces.get("timelines"):
+        out.append(f"\nimplicated traces "
+                   f"({len(traces['timelines'])} timelines):")
+        for tid, tl in sorted(traces["timelines"].items()):
+            names = [s.get("name") for s in tl]
+            out.append(f"  trace {tid}: {len(tl)} spans "
+                       f"({' -> '.join(names[:8])}"
+                       f"{' …' if len(names) > 8 else ''})")
+
+    ver = bundle.get("versions", {})
+    out.append(f"\nenv: python={ver.get('python')} jax={ver.get('jax')} "
+               f"backend={ver.get('jax_backend')} pid={ver.get('pid')}")
+    reg = bundle.get("registry")
+    if isinstance(reg, dict):
+        out.append(f"registry snapshot: "
+                   f"{len(reg.get('samples', []))} samples")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("bundles", nargs="*", help="bundle JSON files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the validated bundle(s) as JSON instead "
+                         "of the human summary")
+    ap.add_argument("--validate", action="store_true",
+                    help="parse/validate only, print one OK/FAIL line "
+                         "per bundle")
+    args = ap.parse_args(argv)
+    if not args.bundles:
+        ap.print_usage(sys.stderr)
+        print("obs_bundle.py: error: no bundle files given",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in args.bundles:
+        try:
+            bundle = load_bundle(path)
+        except BundleError as e:
+            print(f"FAIL {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if args.validate:
+            print(f"OK   {path}: trigger={bundle['trigger']['name']} "
+                  f"events={len(bundle['events'])}")
+        elif args.json:
+            json.dump(bundle, sys.stdout, indent=1)
+            print()
+        else:
+            print(render(bundle))
+            print()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
