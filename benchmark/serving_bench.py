@@ -94,6 +94,26 @@ CPU run exists to pin parity and the freeze, the TPU run reuses it
 unchanged for real speedups; the record carries the mesh stats section
 and the live registry snapshot).
 
+``--workload disagg`` runs the disaggregated 1-prefill+1-decode pair
+against a colocated engine (docs/serving.md "Disaggregated serving")
+on the interference workload disaggregation exists for: a chatty
+decode background (short prompts, long generations) with long-prefill
+TTFT probes interleaved.  Each probe generates exactly ONE token, so
+its wall time IS its TTFT — in the colocated arm long prefills share
+the scheduler with the decode batch; in the disagg arm the prefill
+engine is dedicated and hands the KV pages to the decode engine at
+the first token.  Every output (probes and background) is asserted
+token-identical between the arms per trial.  It emits
+``serving_disagg_colocated_ttft`` (baseline) and
+``serving_disagg_1p1d_ttft`` (``vs_baseline`` is the TTFT ratio
+colocated/disagg, > 1 means disagg answered faster; on CPU both
+engines share the same cores, so the ratio measures the handoff
+overhead — host-numpy export, digest, adopt — that a real deployment
+pays for its interference win; the CPU run exists to pin parity and
+the freeze, the TPU run reuses it unchanged.  The record carries
+decode tokens/s, migration counters + latency, and the live registry
+snapshot).
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -932,6 +952,131 @@ def bench_sharded(concurrency: int = 8, trials: int = 3,
              registry_live=registry))
 
 
+def _build_disagg_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        name = "gpt2_124m"
+        probe_len, chatty_len, chatty_new = 1024, 64, 64
+        seq_buckets = (64, 128, 256, 512, 1024, 2048)
+        page_size = 128
+    else:   # CPU sanity: prefill must be COMPUTE-bound (same reasoning
+        # as the prefix bench) or probe TTFT measures dispatch, not the
+        # interference disaggregation removes
+        name = "gpt2_124m"
+        cfg = dict(vocab_size=512, units=128, num_layers=3, num_heads=4,
+                   max_length=96, dropout=0.0)
+        probe_len, chatty_len, chatty_new = 64, 8, 24
+        seq_buckets = (16, 64)
+        page_size = 16
+    net = get_gpt2(name, **cfg)
+    net.initialize()
+    return net, probe_len, chatty_len, chatty_new, seq_buckets, page_size
+
+
+def bench_disagg(n_chatty: int = 6, n_probes: int = 6, trials: int = 3):
+    """Disaggregated 1P+1D vs colocated on chatty-decode background +
+    long-prefill TTFT probes.  Probes generate ONE token (wall time ==
+    TTFT); all outputs are greedy and asserted token-identical between
+    the arms per trial.  Engines are built ONCE per arm (warmup pays
+    all compiles for both roles; the counter is asserted frozen after
+    all traffic) with an untimed priming burst per arm, then >= 3
+    timed trials — the bench_sharded discipline."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    (net, probe_len, chatty_len, chatty_new, seq_buckets,
+     page_size) = _build_disagg_net(on_tpu)
+    rs = onp.random.RandomState(13)
+    chatty = [rs.randint(0, net.vocab_size, (chatty_len,)).astype("int32")
+              for _ in range(n_chatty)]
+    probes = [rs.randint(0, net.vocab_size, (probe_len,)).astype("int32")
+              for _ in range(n_probes)]
+
+    def build(role, name, target=None):
+        eng = InferenceEngine(
+            net, num_slots=n_chatty, max_batch=n_chatty,
+            seq_buckets=seq_buckets, queue_depth=4 * (n_chatty + n_probes),
+            default_max_new_tokens=chatty_new, kv_layout="paged",
+            page_size=page_size, role=role, name=name)
+        if target is not None:
+            eng.migrate_to(target.adopt)
+        eng.warmup()
+        eng.start()
+        return eng
+
+    co = build("unified", "serving_disagg_colocated")
+    dec = build("decode", "serving_disagg_decode")
+    pre = build("prefill", "serving_disagg_prefill", target=dec)
+    arms = {"colocated": (co, [co]), "disagg": (pre, [pre, dec])}
+    warm = {e.name: e.stats()["compile_cache"]["compiles"]
+            for _, engs in arms.values() for e in engs}
+
+    def one_trial(arm):
+        ingress, _ = arms[arm]
+        t0 = time.perf_counter()
+        bg = [ingress.submit(p, max_new_tokens=chatty_new) for p in chatty]
+        ttfts, pouts = [], []
+        for p in probes:          # probes timed one at a time: a probe
+            tp = time.perf_counter()   # queued behind another probe
+            f = ingress.submit(p, max_new_tokens=1)   # would measure
+            pouts.append(f.result(timeout=1800))      # OUR burst, not
+            ttfts.append((time.perf_counter() - tp) * 1000.0)  # the arm
+        bouts = [f.result(timeout=1800) for f in bg]
+        dt = time.perf_counter() - t0
+        toks = sum(len(o) - len(p)
+                   for o, p in zip(pouts + bouts, probes + chatty))
+        return statistics.median(ttfts), toks / dt, pouts + bouts
+
+    co_ttft, dg_ttft, co_tps, dg_tps = [], [], [], []
+    one_trial("colocated")       # untimed priming burst per arm (host
+    one_trial("disagg")          # warmth is not a property of either)
+    for _ in range(max(1, trials)):
+        ttft, tps, outs_c = one_trial("colocated")
+        co_ttft.append(ttft)
+        co_tps.append(tps)
+        ttft, tps, outs_d = one_trial("disagg")
+        dg_ttft.append(ttft)
+        dg_tps.append(tps)
+        for a, b in zip(outs_c, outs_d):       # parity gate, per trial
+            if not onp.array_equal(a, b):
+                raise AssertionError(
+                    "disagg/colocated greedy outputs diverged — the "
+                    "handoff changed the math, bench numbers void")
+    for _, engs in arms.values():
+        for e in engs:
+            if e.stats()["compile_cache"]["compiles"] != warm[e.name]:
+                raise AssertionError(
+                    f"compile counter moved on traffic ({e.name}) — "
+                    "warmup must pay every program for both roles")
+    from mxnet_tpu.observability import flatten
+    last = {"registry": flatten(prefix="mxtpu_serving")}
+    mig = pre.stats()["migration"]
+    mig_in = dec.stats()["migration"]
+    for _, engs in arms.values():
+        for e in engs:
+            e.stop(drain=False)
+    ratio = round(statistics.median(co_ttft) /
+                  statistics.median(dg_ttft), 4)
+    base = {"n_chatty": n_chatty, "n_probes": n_probes,
+            "chatty_new_tokens": chatty_new, "probe_len": probe_len,
+            "parity_asserted": True}
+    yield _record(
+        "serving_disagg_colocated_ttft", co_ttft, "ms", None,
+        dict(base, decode_tokens_per_s=round(statistics.median(co_tps), 1)))
+    yield _record(
+        "serving_disagg_1p1d_ttft", dg_ttft, "ms", ratio,
+        dict(base, decode_tokens_per_s=round(statistics.median(dg_tps), 1),
+             migrations_by=mig["by"], migrated_pages=mig["migrated_pages"],
+             migrations_in=mig_in["migrations_in"],
+             migration_latency=mig["latency"],
+             registry_live=last["registry"]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=16)
@@ -939,7 +1084,7 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
-                             "paged", "speculative", "sharded"),
+                             "paged", "speculative", "sharded", "disagg"),
                     default="decode")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="device count for --workload sharded "
@@ -976,6 +1121,8 @@ def main():
     elif args.workload == "sharded":
         recs = bench_sharded(trials=args.trials,
                              mesh_devices=args.mesh_devices)
+    elif args.workload == "disagg":
+        recs = bench_disagg(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -102,6 +102,24 @@ class ReplicaHandle:
                                  "replica lifecycle state")
 
     # ---------------------------------------------------------------- state
+    @property
+    def role(self) -> str:
+        """Disaggregated-serving role (docs/fleet.md "Disaggregated
+        serving") read off the live engine — a rebuild (same factory,
+        same config) keeps it without the handle storing a copy that
+        could drift from the engine's truth."""
+        return getattr(self.engine, "role", "unified")  # raceguard: unguarded(engine ref is swapped atomically on rebuild; the factory rebuilds the same role)
+
+    def can_prefill(self) -> bool:
+        """May NEW requests be placed here?  Prefill-role and unified
+        replicas take fresh traffic; decode-role replicas only receive
+        work through adopt()."""
+        return self.role in ("prefill", "unified")
+
+    def can_decode(self) -> bool:
+        """May migrated bundles be adopted here?"""
+        return self.role in ("decode", "unified")
+
     def routable(self) -> bool:
         return self.state == HEALTHY  # raceguard: unguarded(placement hot path: atomic str read; a stale verdict is re-validated by the typed submit failure path)
 

@@ -84,6 +84,7 @@ from ..serving.errors import (DeadlineInfeasibleError, EngineCrashedError,
                               QueueFullError, RequestCancelledError,
                               RequestTimeoutError, ServingError)
 from ..serving.overload import CircuitBreaker, RetryBudget
+from .directory import FleetDirectory
 from .policy import RoutingPolicy
 from .replica import (DEAD, DRAINING, HEALTHY, STOPPED, SUSPECT,
                       ReplicaHandle)
@@ -284,8 +285,16 @@ class FleetFuture:
         resubmits within the request's budget and deadline, or
         re-raises."""
         if isinstance(exc, EngineCrashedError):
-            if handle.mark_dead(str(exc)):
-                self._router._replica_death(handle, str(exc))
+            # blame the engine that actually crashed: a disaggregated
+            # request routed to a PREFILL replica can die on the DECODE
+            # replica that adopted it — marking the routed handle dead
+            # would execute the wrong replica (and, with one prefill
+            # replica, take the whole admission path down with it)
+            src = getattr(exc, "engine", None)
+            victim = handle if src is None or src == handle.name \
+                else self._router._by_name.get(src)
+            if victim is not None and victim.mark_dead(str(exc)):
+                self._router._replica_death(victim, str(exc))
         elif isinstance(exc, QueueFullError):
             # the replica shed queued work under pressure — same
             # breaker signal as a shed at submit
@@ -514,6 +523,36 @@ class FleetRouter:
         self.spill_queue_depth = int(spill_queue_depth) \
             if spill_queue_depth is not None \
             else max(2, 2 * engines[0].num_slots)
+        # fleet-wide prefix/page directory (docs/fleet.md
+        # "Disaggregated serving"): affinity key -> the replica whose
+        # pool actually HOLDS that family's KV.  Consulted ahead of the
+        # stateless HRW rank for both unified placement and migrated
+        # decode placement; published wherever residency is created.
+        self._directory = FleetDirectory(tracker_entries)
+        # disaggregated prefill/decode fleet: any replica carrying a
+        # non-unified role splits placement two-stage — new requests go
+        # to prefill-capable replicas by load, and each prefill-role
+        # engine's migration egress is wired into the router's decode
+        # placement (directory affinity, then HRW, then load)
+        self.disaggregated = any(h.role != "unified"
+                                 for h in self._handles)
+        if self.disaggregated:
+            if self.mode != "decode":
+                raise ServingError(
+                    "disaggregated roles are a decode-mode concept; "
+                    "this fleet serves forward mode")
+            if not any(h.can_prefill() for h in self._handles):
+                raise ServingError(
+                    "disaggregated fleet has no prefill-capable "
+                    "replica (role='prefill' or 'unified') — nothing "
+                    "could ever accept a request")
+            if not any(h.can_decode() for h in self._handles):
+                raise ServingError(
+                    "disaggregated fleet has no decode-capable replica "
+                    "(role='decode' or 'unified') — every handoff "
+                    "would fall back colocated")
+            for h in self._handles:
+                self._wire_migration(h)
 
         self._counters = {}
         self._counters_lock = _named_lock("fleet.router.counters",
@@ -754,11 +793,91 @@ class FleetRouter:
         flight recorder its trigger — a replica death is exactly the
         moment an operator asks what the fleet was doing."""
         self._count("replica_deaths")
+        # a corpse must not attract affinity traffic: drop every
+        # directory entry pointing at it (a rebuilt successor starts
+        # with an empty pool and re-earns residency on fresh traffic)
+        self._directory.forget_replica(h.name)
         fr = _fr_active()
         if fr is not None:
             fr.trigger("fleet.replica_death", fleet=self.name,
                        replica=h.name, reason=reason,
                        deaths=h.total_deaths)
+
+    # ------------------------------------------------- disaggregated serving
+    def _wire_migration(self, h: ReplicaHandle) -> None:
+        """(Re)attach a prefill-role replica's migration egress to the
+        router's decode-placement shim.  Called at construction and
+        after every rebuild — a fresh engine starts with no target, and
+        an unwired prefill replica silently serves colocated, which is
+        safe but defeats the disaggregation."""
+        if h.role != "prefill":
+            return
+        h.engine.migrate_to(
+            lambda bundle, future: self._place_decode(bundle, future))
+
+    def _decode_order(self, key: Optional[bytes],
+                      candidates: List[ReplicaHandle]
+                      ) -> List[ReplicaHandle]:
+        """Decode-stage placement order: directory affinity (the
+        replica already holding this family's pages — a cross-replica
+        prefix hit on arrival), then HRW rank, then load.  Saturated
+        affinity targets spill to the back exactly like unified
+        placement."""
+        by_load = sorted(candidates, key=lambda h: (h.load(), h.name))
+        if key is None:
+            return by_load
+        byname = {h.name: h for h in candidates}
+        loc = self._directory.locate(key)
+        target = byname.get(loc) if loc is not None else None
+        if target is not None and \
+                not target.saturated(self.spill_queue_depth):
+            self._count("directory_hits")
+            return [target] + [h for h in by_load if h is not target]
+        self._count("directory_misses")
+        ranked = self._policy.rank(key, list(byname))
+        target = byname[ranked[0]]
+        rest = [h for h in by_load if h is not target]
+        if target.saturated(self.spill_queue_depth):
+            return rest + [target]
+        return [target] + rest
+
+    def _place_decode(self, bundle, future) -> None:
+        """Place one migrated KV bundle on a decode-capable replica
+        (the second stage of disaggregated placement).  Walks the
+        decode order, offering the bundle via ``adopt()``; the first
+        acceptor owns the request and its residency is published to
+        the directory so the family's followers decode on the same
+        pool.  Raises typed when nobody accepts — the prefill engine
+        catches it and finishes the request itself (colocated
+        fallback), so a refusal here degrades, never loses."""
+        candidates = [h for h in self._healthy() if h.can_decode()]
+        if not candidates:
+            self._count("migration_spills")
+            raise NoHealthyReplicaError(
+                f"fleet {self.name!r}: no healthy decode-capable "
+                f"replica to adopt the bundle")
+        # the affinity key rides the bundle as submit()'s route_hint —
+        # re-deriving it here would self-match the prompt in the radix
+        # tracker (it was recorded at the prefill routing stage) and
+        # key every family member uniquely
+        key = bundle.route_hint
+        last: Optional[Exception] = None
+        for h in self._decode_order(key, candidates):
+            try:
+                h.engine.adopt(bundle, future)
+            except ServingError as e:
+                # typed refusal (out of slots/pages, stopping, injected
+                # migrate_in fault): offer the next candidate
+                last = e
+                continue
+            h.routed += 1
+            self._count("migrations")
+            self._directory.publish(key, h.name)
+            return
+        self._count("migration_spills")
+        raise last if last is not None else NoHealthyReplicaError(
+            f"fleet {self.name!r}: every decode-capable replica "
+            f"refused the bundle")
 
     # ----------------------------------------------------------- monitor
     def _monitor_loop(self):
@@ -774,6 +893,9 @@ class FleetRouter:
                         # discards its replacement engine instead of
                         # resurrecting a replica on a stopped fleet
                         if h.rebuild(abort=lambda: self._stopping):  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
+                            # a rebuilt prefill-role engine starts with
+                            # no migration target — re-wire it
+                            self._wire_migration(h)
                             self._count("readmissions")
                             fr = _fr_active()
                             if fr is not None:
@@ -853,13 +975,26 @@ class FleetRouter:
     def _healthy(self) -> List[ReplicaHandle]:
         return [h for h in self._handles if h.routable()]
 
-    def _order_candidates(self, payload) -> List[ReplicaHandle]:
+    def _order_candidates(self, payload
+                          ) -> Tuple[List[ReplicaHandle],
+                                     Optional[bytes]]:
+        """Placement order for one NEW request, plus its affinity key
+        (``None`` when unkeyed) so the caller can publish where it
+        actually landed into the fleet directory.  In a disaggregated
+        fleet this is the PREFILL stage: only prefill-capable replicas
+        are candidates (decode-role replicas receive work through
+        ``adopt()``), ordered by load — prefill is compute-bound, so
+        load beats affinity here and the directory steers the DECODE
+        stage instead."""
         healthy = self._healthy()
+        if self.disaggregated:
+            healthy = [h for h in healthy if h.can_prefill()]
         if not healthy:
             self._count("no_healthy")
             raise NoHealthyReplicaError(
-                f"fleet {self.name!r}: no healthy replica "
-                f"({ {h.name: h.state for h in self._handles} })")
+                f"fleet {self.name!r}: no healthy "
+                f"{'prefill-capable ' if self.disaggregated else ''}"
+                f"replica ({ {h.name: h.state for h in self._handles} })")
         key, faulted = None, False
         try:
             _inject("fleet.route")
@@ -875,19 +1010,38 @@ class FleetRouter:
                 order = list(healthy)
                 self._rng.shuffle(order)
             self._count("random_routed")
-            return order
+            return order, None
         by_load = sorted(healthy, key=lambda h: (h.load(), h.name))
+        if self.disaggregated:
+            # prefill stage: pure load placement; the key still rides
+            # back so the decode stage's directory learns the family
+            self._count("least_loaded_routed")
+            return by_load, key
         if key is None:
             self._count("least_loaded_routed")
-            return by_load
+            return by_load, None
+        # directory affinity beats HRW: the replica that already HOLDS
+        # this family's KV (learned from where earlier members landed)
+        # wins even when the fleet membership changed since — HRW only
+        # decides for families the directory has never seen
+        loc = self._directory.locate(key)
+        target = self._by_name.get(loc) if loc is not None else None
+        if target is not None and target in healthy and \
+                not target.saturated(self.spill_queue_depth):
+            self._count("directory_hits")
+            self._count("affinity_routed")
+            return [target] + [h for h in by_load if h is not target], key
+        # unknown family, or stale/unusable residency (dead replica,
+        # saturated) — fall through to the stateless rank
+        self._count("directory_misses")
         ranked = self._policy.rank(key, [h.name for h in healthy])
         target = self._by_name[ranked[0]]
         rest = [h for h in by_load if h is not target]
         if target.saturated(self.spill_queue_depth):
             self._count("affinity_spills")
-            return rest + [target]
+            return rest + [target], key
         self._count("affinity_routed")
-        return [target] + rest
+        return [target] + rest, key
 
     def _submit_once(self, req: _FleetRequest,
                      exclude: Optional[Set[str]] = None
@@ -910,7 +1064,8 @@ class FleetRouter:
                 "on a replica")
         shed = infeasible = None
         breaker_skips = 0
-        for h in self._order_candidates(req.payload):
+        order, key = self._order_candidates(req.payload)
+        for h in order:
             if exclude and h.name in exclude:
                 continue
             if not h.breaker.allow(now):
@@ -922,6 +1077,8 @@ class FleetRouter:
                                       timeout=req.remaining(),
                                       eos_id=req.eos_id,
                                       priority=req.priority,
+                                      route_hint=key
+                                      if self.disaggregated else None,
                                       **req.sampling)
             except DeadlineInfeasibleError as e:
                 # the deadline is the REQUEST's own constraint — a
@@ -951,6 +1108,12 @@ class FleetRouter:
             h.breaker.record_success()
             h.routed += 1
             self._count("routed")
+            if not self.disaggregated:
+                # residency follows placement: this replica is about
+                # to prefill (and cache) the family's prefix.  In a
+                # disaggregated fleet residency is created by adopt()
+                # on the DECODE side — _place_decode publishes there.
+                self._directory.publish(key, h.name)
             return h, fut
         if infeasible is not None:
             raise infeasible       # original deadline semantics, always
@@ -1156,6 +1319,9 @@ class FleetRouter:
                       "spill_queue_depth": self.spill_queue_depth,
                       "max_failovers": self.max_failovers,
                       "tracked_prefixes": len(self._policy),
+                      "disaggregated": self.disaggregated,
+                      "roles": {h.name: h.role for h in self._handles},
+                      "directory": self._directory.stats(),
                       "gray": {"ejection": self.gray_ejection,
                                "multiplier": self.gray_multiplier,
                                "min_samples": self.gray_min_samples,
@@ -1247,6 +1413,9 @@ class FleetRouter:
                         "kind": "gauge", "labels": dict(lbl),
                         "value": round(self._retry_budget.available, 2),
                         "help": ""})
+        samples.append({"name": "mxtpu_fleet_directory_entries",
+                        "kind": "gauge", "labels": dict(lbl),
+                        "value": len(self._directory), "help": ""})
         looked = hits + misses
         if looked:
             samples.append({"name": "mxtpu_fleet_prefix_hit_rate",

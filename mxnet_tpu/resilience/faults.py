@@ -182,6 +182,15 @@ register_site("serving.draft_logits",
               "poison: NaN/Inf splice into the draft head's logits "
               "(proposals go garbage; verify rejects them — tokens "
               "stay correct, only speed degrades)")
+register_site("serving.migrate_out",
+              "disaggregated prefill→decode KV export (degrades to "
+              "colocated fallback: the prefill engine finishes the "
+              "request itself, no rider retry budget charged)")
+register_site("serving.migrate_in",
+              "disaggregated decode-side adopt ingress (fires BEFORE "
+              "any slot/page claim — a refused bundle leaves the "
+              "decode pool pristine and the prefill side degrades to "
+              "colocated fallback)")
 # overload control (docs/overload.md) — degrades, never fails a request
 register_site("overload.admission", "priority/deadline admission gate")
 register_site("overload.preempt", "slot-preemption attempt")

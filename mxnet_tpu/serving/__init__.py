@@ -35,10 +35,13 @@ from .engine import InferenceEngine, InferenceFuture, Request
 from .errors import (DeadlineExceededError, DeadlineInfeasibleError,
                      EngineCrashedError, EngineStoppedError,
                      FleetSaturatedError, InvalidRequestError,
+                     MigrationDigestError, MigrationError,
                      NoHealthyReplicaError, NonFiniteOutputError,
                      QueueFullError, RequestCancelledError,
                      RequestTimeoutError, ServingError)
 from .kv_pages import PagedPrefixCache, PagedPrefixEntry, PagePool
+from .migration import (MIGRATION_SCHEMA_VERSION, MigrationBundle,
+                        bundle_digest, export_bundle, verify_bundle)
 from .kv_slots import SlotAllocator, SlotState
 from .metrics import LatencyHistogram, ServingMetrics
 from .overload import (PRIORITIES, CircuitBreaker, OverloadController,
@@ -61,5 +64,7 @@ __all__ = [
     "EngineStoppedError", "EngineCrashedError",
     "InvalidRequestError", "NonFiniteOutputError",
     "NoHealthyReplicaError", "RequestCancelledError",
-    "FleetSaturatedError",
+    "FleetSaturatedError", "MigrationError", "MigrationDigestError",
+    "MigrationBundle", "MIGRATION_SCHEMA_VERSION",
+    "export_bundle", "bundle_digest", "verify_bundle",
 ]

@@ -154,7 +154,7 @@ from ..resilience.faults import (RetryableFault, inject as _inject,
 from .batcher import BucketLattice, DynamicBatcher
 from .errors import (DeadlineInfeasibleError, EngineCrashedError,
                      EngineStoppedError, InvalidRequestError,
-                     NonFiniteOutputError, QueueFullError,
+                     MigrationError, NonFiniteOutputError, QueueFullError,
                      RequestCancelledError, RequestTimeoutError,
                      ServingError)
 from .kv_pages import PagedPrefixCache, PagePool
@@ -251,14 +251,20 @@ class Request:
                  "eos_id", "deadline", "future", "t_submit", "t_enqueue",
                  "t_schedule", "shape_key", "retries_left", "trace_id",
                  "priority", "preempted", "temperature", "top_k", "top_p",
-                 "seed", "key")
+                 "seed", "key", "route_hint")
 
     _ids = itertools.count()
 
     def __init__(self, kind, payload, max_new_tokens=0, eos_id=None,
                  deadline=None, priority=PRIORITY_BATCH,
-                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0,
+                 route_hint=None):
         self.retries_left = 0     # engine grants the budget at submit
+        # opaque routing cookie (a fleet affinity key): the engine never
+        # reads it, it rides the request into the migration bundle so a
+        # disaggregated router can place the decode half by the SAME
+        # family key it routed the prefill by (docs/fleet.md)
+        self.route_hint = None if route_hint is None else bytes(route_hint)
         # trace-id propagation crosses the scheduler thread boundary BY
         # VALUE on the request itself (no thread-locals to lose)
         self.trace_id = None
@@ -469,6 +475,7 @@ class InferenceEngine:
                  draft_layers: int = 1,
                  mesh=None,
                  mesh_axes="tp",
+                 role: str = "unified",
                  name: str = "serving"):
         if mode is None:
             mode = "decode" if hasattr(net, "decode_step") and \
@@ -500,6 +507,24 @@ class InferenceEngine:
                                "(forward mode has no KV cache to page)")
         self.kv_layout = kv_layout
         self._paged = self.kv_layout == "paged"
+        # disaggregated serving (docs/serving.md "Disaggregated
+        # serving"): a prefill-role engine hands each request off at
+        # end-of-prefill to its migration target (falling back to
+        # finishing it locally when the handoff faults); a decode-role
+        # engine additionally accepts migrated requests via adopt().
+        # Roles only steer the PREFERRED path — both roles remain
+        # complete engines, which is what makes colocated fallback a
+        # degradation instead of a failure.
+        if role not in ("prefill", "decode", "unified"):
+            raise ServingError(f"role must be 'prefill'|'decode'|"
+                               f"'unified', got {role!r}")
+        if role != "unified" and mode != "decode":
+            raise ServingError(
+                f"role={role!r} is a decode-mode concept (prefill/decode "
+                f"disaggregation splits LM phases; forward mode has "
+                f"neither)")
+        self.role = role
+        self._migrate_target = None
 
         if mode == "decode":
             self.max_length = int(max_length or net.max_length)
@@ -1373,7 +1398,7 @@ class InferenceEngine:
         not block on the (possibly hung) scheduler."""
         exc = EngineCrashedError(
             f"serving scheduler failed: {reason} — all pending requests "
-            "failed; build a fresh InferenceEngine")
+            "failed; build a fresh InferenceEngine", engine=self.name)
         self._crashed = exc
         self.metrics.count("watchdog_trips")
         self.metrics.mark("watchdog_trip")
@@ -1595,7 +1620,8 @@ class InferenceEngine:
                eos_id: Optional[int] = None,
                priority: Optional[str] = None,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> InferenceFuture:
+               top_p: float = 1.0, seed: int = 0,
+               route_hint: Optional[bytes] = None) -> InferenceFuture:
         """Enqueue one request; returns its future.
 
         ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` are the
@@ -1630,6 +1656,12 @@ class InferenceEngine:
         preemptible mid-decode; a queued lower-class request may be
         EVICTED by a higher-class arrival (its future fails with
         :class:`QueueFullError`).  See docs/overload.md.
+
+        ``route_hint`` is an opaque routing cookie (decode mode): the
+        engine never interprets it, but a prefill-role engine copies it
+        into the migration bundle so a disaggregated fleet router can
+        place the decode half by the same affinity key it routed the
+        prefill by (docs/fleet.md "Disaggregated serving").
         """
         try:
             pr = self.default_priority if priority is None \
@@ -1645,7 +1677,8 @@ class InferenceEngine:
             self._reject("invalid", InvalidRequestError(str(e)))
         if self._crashed is not None:
             self._reject("crashed",
-                         EngineCrashedError(str(self._crashed)),
+                         EngineCrashedError(str(self._crashed),
+                                            engine=self.name),
                          priority=priority_name(pr))
         timeout = self.default_timeout if timeout is None else timeout
         now = time.monotonic()
@@ -1703,7 +1736,7 @@ class InferenceEngine:
                           self.eos_id if eos_id is None else eos_id,
                           deadline, priority=pr,
                           temperature=temperature, top_k=top_k,
-                          top_p=top_p, seed=seed)
+                          top_p=top_p, seed=seed, route_hint=route_hint)
         else:
             if temperature or top_k or top_p != 1.0 or seed:
                 self._reject("invalid", InvalidRequestError(
@@ -1924,6 +1957,172 @@ class InferenceEngine:
                                   self._jit_forward, params, xs)
             return self.metrics.counters["compiles"] - before
 
+    # ------------------------------------------------- disaggregated serving
+    def migrate_to(self, target) -> "InferenceEngine":
+        """Attach this prefill-role engine's migration egress
+        (docs/serving.md "Disaggregated serving").  ``target`` is a
+        callable ``(bundle, future) -> None`` — typically a decode-role
+        engine's :meth:`adopt` or the fleet router's decode-placement
+        shim — that must RAISE to refuse the handoff; any refusal makes
+        the prefill engine finish that request itself (colocated
+        fallback).  Returns ``self`` for chaining."""
+        if self.role != "prefill":
+            raise ServingError(
+                f"migrate_to() is the prefill-role egress; engine "
+                f"{self.name!r} has role={self.role!r}")
+        self._migrate_target = target
+        return self
+
+    def adopt(self, bundle, future=None):
+        """Decode-side ingress of a migrated request: verify the
+        bundle's tree digest, claim a KV slot (+ pages under the paged
+        layout), install the prefilled K/V into this engine's own
+        storage, and resume the request at its accepted position —
+        token-identically, because every sampling draw folds the
+        request's seeded key with its ABSOLUTE position.  Runs on the
+        CALLER's thread under ``_step_lock`` (the ``warmup()``
+        precedent for caller-thread engine mutation); the resumed slot
+        joins the scheduler's next decode cycle like any other.
+
+        ``future`` (optional) is the origin request's
+        :class:`InferenceFuture` — passing it makes the original
+        submitter's handle resolve with the migrated result.  Returns
+        the future that will carry ``prompt + generated``.
+
+        Every refusal is typed and claims nothing it doesn't release:
+        :class:`MigrationDigestError` for a torn bundle (checked FIRST
+        — the pool is untouched), :class:`MigrationError` for
+        role/layout/capacity mismatches.  The prefill side catches all
+        of them and degrades to colocated."""
+        from .migration import verify_bundle
+        if self.role == "prefill":
+            raise ServingError(
+                f"adopt() is the decode-side ingress; engine "
+                f"{self.name!r} has role='prefill'")
+        if self.mode != "decode":
+            raise ServingError("adopt() is a decode-mode surface "
+                               "(forward mode has no KV to adopt)")
+        if self._crashed is not None:
+            raise EngineCrashedError(str(self._crashed), engine=self.name)
+        if self._stopping or self._batcher.closed:  # raceguard: unguarded(advisory early refusal: atomic bool read; a stop racing past it just means the adopted rider is swept typed at the drain like any in-flight request — failed over by a fleet, never lost)
+            raise EngineStoppedError(
+                f"engine {self.name!r} is stopping — cannot adopt")
+        # digest FIRST: a torn transfer is refused before any claim,
+        # so rejection has nothing to undo
+        verify_bundle(bundle)
+        if bundle.layout != self.kv_layout:
+            raise MigrationError(
+                f"bundle layout {bundle.layout!r} != engine kv_layout "
+                f"{self.kv_layout!r} — KV bytes are not portable "
+                f"across layouts")
+        if self._paged and bundle.page_size != self.page_size:
+            raise MigrationError(
+                f"bundle page_size={bundle.page_size} != engine "
+                f"page_size={self.page_size}")
+        if bundle.prompt_len + bundle.max_new_tokens > self.max_length:
+            raise MigrationError(
+                f"prompt len {bundle.prompt_len} + "
+                f"{bundle.max_new_tokens} new tokens does not fit the "
+                f"KV length ({self.max_length})")
+        with self._step_lock:
+            # the fault site guards the whole ingress: an injected
+            # fault refuses the bundle before any claim and the
+            # prefill side serves the request colocated
+            _inject("serving.migrate_in", scope=self.name)
+            if self._alloc.free_count == 0:
+                raise MigrationError(
+                    f"no free KV slot on {self.name!r}")
+            self._ensure_caches()
+            req = Request("decode", bundle.prompt,
+                          bundle.max_new_tokens, bundle.eos_id,
+                          bundle.deadline, priority=bundle.priority,
+                          temperature=bundle.temperature,
+                          top_k=bundle.top_k, top_p=bundle.top_p,
+                          seed=bundle.seed)
+            req.trace_id = bundle.trace_id
+            if future is not None:
+                req.future = future
+            req.retries_left = self.max_request_retries
+            st = SlotState(req, req.prompt_len, req.max_new_tokens,
+                           tokens=req.payload)
+            slot = self._alloc.alloc(st)
+            try:
+                self._install_kv(slot, st, bundle)
+            except BaseException:
+                self._release(slot)
+                raise
+            # adoption IS this engine's admission: same counters, so
+            # shed/served rates keep their submitted denominator
+            self.metrics.count("submitted")
+            self.metrics.count("admitted")
+            self.metrics.count("prompt_tokens", st.prompt_len)
+            now = time.monotonic()
+            req.t_schedule = now
+            st.filled = st.prompt_len
+            st.t_first = now
+            # donate the adopted prompt to the LOCAL prefix cache:
+            # this is what turns a decode replica into the residency
+            # the fleet directory advertises — followers of a hot
+            # family land here and hit
+            self._prefix_insert(st, slot)
+            st.advance(bundle.first_token)
+            self.metrics.count("migrations_in")
+            self.metrics.count("migrated_pages", bundle.n_pages)
+            self.metrics.count_migration("in", "ok")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.migrate_in", engine=self.name,
+                          request=req.id, source=bundle.source,
+                          pages=bundle.n_pages,
+                          prompt_len=bundle.prompt_len,
+                          trace_id=req.trace_id)
+            self._finish_if_done(slot, st)
+        with self._cond:
+            self._cond.notify_all()   # wake an idle scheduler
+        return req.future
+
+    def _install_kv(self, slot: int, st: SlotState, bundle):  # guarded-by: _step_lock
+        """Write a migrated bundle's arrays into this engine's own KV
+        storage.  Paged: claim exactly the pages the prompt needs
+        (evicting zero-reader prefix entries under pressure, like
+        admission), scatter each cache leaf's page rows, point the
+        slot's page-table row at the new pages.  Dense: set the slot's
+        first ``prompt_len`` rows.  All writes are EAGER jax ops
+        (``.at[].set`` + re-placement) — the :meth:`_scrub_pages`
+        cache-surgery idiom — so adoption adds zero entries to the
+        compile cache and the post-warmup freeze holds on both roles."""
+        import jax
+        import jax.numpy as jnp
+        flat, treedef = jax.tree_util.tree_flatten(self._caches)
+        if len(flat) != len(bundle.arrays):
+            raise MigrationError(
+                f"bundle carries {len(bundle.arrays)} cache leaves, "
+                f"engine has {len(flat)} — model mismatch")
+        if self._paged:
+            need = self._pool.pages_for(bundle.prompt_len)
+            if need != bundle.n_pages:
+                raise MigrationError(
+                    f"bundle carries {bundle.n_pages} pages but "
+                    f"prompt_len={bundle.prompt_len} needs {need} at "
+                    f"page_size={self.page_size}")
+            pages = self._claim_pages(need)
+            if pages is None:
+                self.metrics.count("page_faults")
+                raise MigrationError(
+                    f"page pool on {self.name!r} cannot cover {need} "
+                    f"pages (free + evictable short)")
+            st.pages.extend(pages)
+            pids = jnp.asarray(onp.asarray(pages, "int32"))
+            new = [leaf.at[pids].set(jnp.asarray(arr))
+                   for leaf, arr in zip(flat, bundle.arrays)]
+            self._page_table[slot, :need] = pages
+            self._table_dirty()
+        else:
+            new = [leaf.at[slot, :bundle.prompt_len].set(jnp.asarray(arr))
+                   for leaf, arr in zip(flat, bundle.arrays)]
+        self._caches = self._place_caches(
+            jax.tree_util.tree_unflatten(treedef, new))
+
     # ------------------------------------------------------------------- stats
     def stats(self) -> dict:
         s = self.metrics.stats()
@@ -1948,6 +2147,8 @@ class InferenceEngine:
             "deadline_admission": self.deadline_admission,
             "spec_tokens": self.spec_tokens,
             "draft_layers": self.draft_layers,
+            "role": self.role,
+            "migrate_target": self._migrate_target is not None,  # raceguard: unguarded(stats snapshot: atomic ref read, staleness bounded by one cycle)
         }
         # KV capacity accounting (docs/serving.md "Paged KV"): slot
         # occupancy always; page-pool occupancy under the paged layout
@@ -2028,9 +2229,16 @@ class InferenceEngine:
                             self._forward_cycle()
                     finally:
                         self._cycle_busy = False
-            except BaseException as e:  # defensive: never leave futures hung
+            except Exception as e:  # defensive: never leave futures hung
                 with self._step_lock:
                     self._fail_inflight(e)
+            # a BaseException (SimulatedPreemption, interpreter
+            # shutdown) escapes on purpose: recovery code that catches
+            # plain Exception must not "survive" a kill.  The dying
+            # scheduler thread is what the watchdog's dead-thread
+            # detection exists for — it condemns the engine and fails
+            # every rider with the typed EngineCrashedError a fleet
+            # can fail over on.
 
     def _run_step(self, site: str, key, fn, args, reqs):
         """One compiled call with the injection site + bounded retry for
@@ -2941,7 +3149,7 @@ class InferenceEngine:
                 self._fail_nonfinite(slot, st, "prefill")
                 continue
             st.filled = st.prompt_len
-            self._first_token(slot, st, int(first[i]))
+            self._finish_prefill(slot, st, int(first[i]))
 
     def _prefill_chunk_batch(self, rows):  # guarded-by: _step_lock
         """One chunked/offset prefill call over up to max_batch
@@ -2997,7 +3205,64 @@ class InferenceEngine:
                 continue
             st.filled += take[i]
             if st.filled == st.prompt_len:
-                self._first_token(slot, st, int(first[i]))
+                self._finish_prefill(slot, st, int(first[i]))
+
+    def _finish_prefill(self, slot: int, st: SlotState, token: int):  # guarded-by: _step_lock
+        """A request's prefill just completed (full or last chunk).  A
+        prefill-role engine with an attached migration target hands the
+        request off to its decode-role peer; everything else — unified
+        engines, no target attached, or a handoff that faulted — enters
+        decode locally via :meth:`_first_token`.  The fallback is the
+        degradation contract of the ``serving.migrate_out`` fault site:
+        the request is served colocated, never lost."""
+        if self.role == "prefill" and self._migrate_target is not None:
+            if self._migrate_out(slot, st, token):
+                return
+        self._first_token(slot, st, token)
+
+    def _migrate_out(self, slot: int, st: SlotState, token: int) -> bool:  # guarded-by: _step_lock
+        """Export this slot's KV state and hand the request to the
+        migration target.  Returns True iff the peer accepted — the
+        request's future now belongs to the decode side and the local
+        slot is released.  ANY failure (injected fault, digest refusal,
+        peer out of slots/pages, peer dead) returns False and the
+        caller finishes the request colocated.  Deliberately NOT routed
+        through :meth:`_run_step`: migration is an optimization with a
+        built-in fallback, so a fault here must not charge any rider's
+        retry budget (``riders=()`` discipline, docs/resilience.md)."""
+        from .migration import export_bundle
+        req = st.request
+        t0 = time.monotonic()
+        fr = _fr_active()
+        bundle = None
+        try:
+            # inject BEFORE the host copy: a faulted migration leaves
+            # the slot exactly as prefill left it, so the colocated
+            # fallback resumes with zero cleanup
+            _inject("serving.migrate_out", scope=self.name)
+            bundle = export_bundle(self, slot, st, token)
+            self._migrate_target(bundle, req.future)
+        except Exception as e:
+            self.metrics.count("migrate_faults")
+            self.metrics.count_migration("out", "fallback")
+            if fr is not None:
+                fr.record("serving.migrate_out", engine=self.name,
+                          request=req.id, outcome="fallback",
+                          error=type(e).__name__, trace_id=req.trace_id)
+            return False
+        # handoff accepted: the decode peer owns the request (and its
+        # future) now; the bundle holds host copies, so the local pages
+        # can go back to the pool immediately
+        self._release(slot)
+        self.metrics.count("migrations_out")
+        self.metrics.count("migrated_pages", bundle.n_pages)
+        self.metrics.count_migration("out", "ok")
+        self.metrics.observe_migration(time.monotonic() - t0)
+        if fr is not None:
+            fr.record("serving.migrate_out", engine=self.name,
+                      request=req.id, outcome="ok", pages=bundle.n_pages,
+                      bytes=bundle.nbytes(), trace_id=req.trace_id)
+        return True
 
     def _first_token(self, slot: int, st: SlotState, token: int):
         """A request's prefill just completed: record TTFT, donate its

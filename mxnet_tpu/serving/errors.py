@@ -19,7 +19,8 @@ __all__ = ["ServingError", "QueueFullError", "RequestTimeoutError",
            "EngineStoppedError", "EngineCrashedError",
            "InvalidRequestError", "NonFiniteOutputError",
            "NoHealthyReplicaError", "RequestCancelledError",
-           "FleetSaturatedError"]
+           "FleetSaturatedError", "MigrationError",
+           "MigrationDigestError"]
 
 
 class ServingError(MXNetError):
@@ -68,7 +69,16 @@ class EngineCrashedError(ServingError):
     """The scheduler thread died or hung: the watchdog condemned the
     engine and failed every queued and in-flight request with this error
     so no caller blocks on a future that can never resolve.  The engine
-    cannot be restarted — build a fresh one."""
+    cannot be restarted — build a fresh one.
+
+    ``engine`` names the engine that actually crashed.  This matters in
+    a disaggregated fleet: a request routed to a prefill replica can
+    die on the DECODE replica that adopted it, and the router must mark
+    the right corpse dead when it fails the request over."""
+
+    def __init__(self, *args, engine=None):
+        super().__init__(*args)
+        self.engine = engine
 
 
 class InvalidRequestError(ServingError):
@@ -93,6 +103,25 @@ class FleetSaturatedError(QueueFullError):
     same) that additionally tells the caller the condition is
     fleet-wide: the router has triggered coordinated brownout on the
     replicas and scale-up, not retry, is the fix (docs/overload.md)."""
+
+
+class MigrationError(ServingError):
+    """A disaggregated prefill→decode handoff (docs/serving.md
+    "Disaggregated serving") could not be completed: the decode-role
+    engine refused the bundle (role/layout/capacity mismatch, no free
+    slot or pages) or the transport faulted.  The request is NOT lost —
+    the prefill-role engine catches this and finishes the request
+    itself (colocated fallback), so callers only ever see it from a
+    direct :meth:`InferenceEngine.adopt` call."""
+
+
+class MigrationDigestError(MigrationError):
+    """The migration bundle's BLAKE2b tree digest did not match its
+    payload: the transfer was torn or the arrays were mutated in
+    flight.  Raised BEFORE the decode engine claims any slot or page —
+    a corrupt bundle is never adopted and the decode pool is left
+    pristine (the checkpoint-integrity discipline of
+    docs/integrity.md applied to the KV plane)."""
 
 
 class NonFiniteOutputError(ServingError):

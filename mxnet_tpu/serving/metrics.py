@@ -170,7 +170,15 @@ class ServingMetrics:
                  # park-by-reference, never a failed request) and pages
                  # zeroed by scrub-on-NaN when their last reader freed
                  # them
-                 "page_faults", "pages_scrubbed")
+                 "page_faults", "pages_scrubbed",
+                 # disaggregated serving (docs/serving.md
+                 # "Disaggregated serving"): completed prefill→decode
+                 # handoffs by direction, KV pages moved, and contained
+                 # faults at the serving.migrate_* sites (each degrades
+                 # to colocated fallback — the prefill engine finishes
+                 # the request itself, nothing is lost)
+                 "migrations_out", "migrations_in", "migrated_pages",
+                 "migrate_faults")
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
@@ -182,6 +190,12 @@ class ServingMetrics:
         # the per-class accounting graceful degradation is judged by
         self.sheds_by = {}           # (reason, priority) -> count
         self.served_by = {}          # priority -> count
+        # disaggregated serving: handoffs keyed by (direction,
+        # outcome) — 'out'/'in' x 'ok'/'fallback' — plus the
+        # export→accept latency histogram (host copy + digest +
+        # adopt-side install)
+        self.migrations_by = {}      # (direction, outcome) -> count
+        self.migration = LatencyHistogram()
         self.queue = LatencyHistogram()
         self.prefill = LatencyHistogram()
         self.decode = LatencyHistogram()
@@ -236,6 +250,16 @@ class ServingMetrics:
                  "labels": {"engine": self.name, "priority": prio},
                  "value": v, "help": ""}
                 for prio, v in sorted(self.served_by.items()))
+            samples.extend(
+                {"name": "mxtpu_serving_migrations_total",
+                 "kind": "counter",
+                 "labels": {"engine": self.name, "direction": d,
+                            "outcome": outcome},
+                 "value": v, "help": ""}
+                for (d, outcome), v in sorted(self.migrations_by.items()))
+            samples.append(histogram_sample(
+                "mxtpu_serving_migration_latency_seconds",
+                self.migration, eng))
             for phase, h in (("queue", self.queue),
                              ("prefill", self.prefill),
                              ("decode", self.decode),
@@ -263,6 +287,19 @@ class ServingMetrics:
     def count_served(self, priority: str, n: int = 1):
         with self._lock:
             self.served_by[priority] = self.served_by.get(priority, 0) + n
+
+    def count_migration(self, direction: str, outcome: str, n: int = 1):
+        """One disaggregated handoff attempt, labeled by direction
+        (``out`` on the prefill engine, ``in`` on the decode engine)
+        and outcome (``ok`` / ``fallback``)."""
+        with self._lock:
+            k = (direction, outcome)
+            self.migrations_by[k] = self.migrations_by.get(k, 0) + n
+
+    def observe_migration(self, seconds: float):
+        """Latency of one accepted handoff, export through adopt."""
+        with self._lock:
+            self.migration.observe(seconds)
 
     # ---------------------------------------------------------- estimators
     def latency_estimates(self, min_count: int = 8):
@@ -321,6 +358,8 @@ class ServingMetrics:
             c = dict(self.counters)
             sheds_by = dict(self.sheds_by)
             served_by = dict(self.served_by)
+            migrations_by = dict(self.migrations_by)
+            migration_lat = self.migration.summary()
             lat = {"queue": self.queue.summary(),
                    "prefill": self.prefill.summary(),
                    "decode": self.decode.summary(),
@@ -373,6 +412,17 @@ class ServingMetrics:
                 "acceptance_rate": round(
                     c["spec_tokens_accepted"] / c["spec_tokens_proposed"],
                     4) if c["spec_tokens_proposed"] else None,
+            },
+            # disaggregated serving (docs/serving.md): handoff counts
+            # by (direction, outcome) plus the export→adopt latency
+            "migration": {
+                "migrations_out": c["migrations_out"],
+                "migrations_in": c["migrations_in"],
+                "migrated_pages": c["migrated_pages"],
+                "migrate_faults": c["migrate_faults"],
+                "by": {f"{d}/{outcome}": v for (d, outcome), v
+                       in sorted(migrations_by.items())},
+                "latency": migration_lat,
             },
             # per-class accounting of graceful degradation
             # (docs/overload.md); the engine overlays its controller

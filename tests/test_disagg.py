@@ -1,0 +1,358 @@
+"""Disaggregated prefill/decode serving (docs/serving.md, docs/fleet.md
+"Disaggregated serving").
+
+Contracts under test: a prefill-role engine hands finished prefills to
+its decode-role peer and the migrated requests are TOKEN-IDENTICAL to a
+colocated engine — dense and paged layouts, greedy and seeded sampling,
+speculation on and off, with the post-warmup compile freeze holding on
+BOTH roles; a tampered bundle is a typed digest rejection that leaves
+the decode pool pristine; faults at ``serving.migrate_out`` /
+``serving.migrate_in`` degrade to colocated fallback without charging
+any retry budget; the fleet directory turns a prompt family's replica
+residency into cross-replica prefix hits; role misuse raises typed.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.fleet import FleetDirectory, FleetRouter
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.serving import (InferenceEngine, MigrationDigestError,
+                               MigrationError, ServingError,
+                               bundle_digest, verify_bundle)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    # 2 layers: speculation needs a drafter strictly cheaper than the
+    # verify forward (draft_layers < num_layers)
+    n = get_gpt2("gpt2_124m", vocab_size=61, units=16, num_layers=2,
+                 num_heads=2, max_length=32, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1, vocab=61):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, vocab, (l,)).astype("int32") for l in lens]
+
+
+def _family(n, shared_len=10, tail_len=3, seed=2, vocab=61):
+    rs = onp.random.RandomState(seed)
+    shared = rs.randint(0, vocab, (shared_len,)).astype("int32")
+    return [onp.concatenate(
+        [shared, rs.randint(0, vocab, (tail_len,)).astype("int32")])
+        for _ in range(n)]
+
+
+_ENG = dict(num_slots=4, max_batch=4, seq_buckets=(8, 16),
+            default_max_new_tokens=6, watchdog_interval=0.05,
+            retry_backoff=0.001)
+_PAGED = dict(kv_layout="paged", page_size=8)
+
+
+def _engine(net, **kw):
+    cfg = dict(_ENG)
+    cfg.update(kw)
+    return InferenceEngine(net, **cfg)
+
+
+def _serve(eng_or_fleet, prompts, max_new=6):
+    """Submit all, gather all: request i is seeded i, odd i sampled."""
+    futs = [eng_or_fleet.submit(p, max_new_tokens=max_new, seed=i,
+                                temperature=0.5 if i % 2 else 0.0)
+            for i, p in enumerate(prompts)]
+    return [f.result(timeout=120) for f in futs]
+
+
+def _colocated(net, prompts, max_new=6, **kw):
+    with _engine(net, **kw) as eng:
+        eng.warmup()
+        return _serve(eng, prompts, max_new)
+
+
+# --------------------------------------------------------- role validation
+
+def test_role_validation_typed(net):
+    with pytest.raises(ServingError):
+        _engine(net, role="both")
+    # roles are a decode-mode concept
+    dense_head = mx.gluon.nn.Dense(4)
+    dense_head.initialize()
+    with pytest.raises(ServingError):
+        InferenceEngine(dense_head, mode="forward", role="prefill")
+    p = _engine(net, role="prefill", name="val_p")
+    d = _engine(net, role="decode", name="val_d")
+    with pytest.raises(ServingError):
+        p.adopt(object())          # adopt is the decode-side ingress
+    with pytest.raises(ServingError):
+        d.migrate_to(lambda b, f: None)   # egress is prefill-side
+    p.migrate_to(d.adopt)          # the valid wiring chains
+    p.stop(), d.stop()
+
+
+# ------------------------------------------------------- round-trip parity
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+@pytest.mark.parametrize("spec", [0, 2])
+def test_disagg_token_parity(net, layout, spec):
+    """1P+1D vs colocated: token-identical for greedy and seeded
+    requests, every request migrated (not fallback), compile counter
+    frozen after warmup on both roles."""
+    kw = dict(_PAGED) if layout == "paged" else {}
+    prompts = _prompts((5, 11, 7, 9), seed=3)
+    refs = _colocated(net, prompts, spec_tokens=spec, **kw)
+    p = _engine(net, role="prefill", name=f"par_p_{layout}{spec}", **kw)
+    d = _engine(net, role="decode", name=f"par_d_{layout}{spec}",
+                spec_tokens=spec, **kw)
+    p.migrate_to(d.adopt)
+    with p, d:
+        wp, wd = p.warmup(), d.warmup()
+        outs = _serve(p, prompts)
+        for r, o in zip(refs, outs):
+            onp.testing.assert_array_equal(r, o)
+        sp, sd = p.stats(), d.stats()
+        assert sp["migration"]["by"].get("out/ok") == len(prompts), \
+            sp["migration"]
+        assert sd["migration"]["by"].get("in/ok") == len(prompts)
+        assert sp["compile_cache"]["compiles"] == wp
+        assert sd["compile_cache"]["compiles"] == wd
+        if layout == "paged":
+            assert sp["migration"]["migrated_pages"] > 0
+        assert sp["migration"]["latency"]["count"] == len(prompts)
+
+
+def test_one_token_budget_migrates_and_completes(net):
+    """max_new_tokens=1: the migrated request is ALREADY done at adopt
+    (the first token is the whole generation) — the decode side must
+    complete it without a decode step and release the slot."""
+    prompts = _prompts((6, 9), seed=5)
+    refs = _colocated(net, prompts, max_new=1, **_PAGED)
+    p = _engine(net, role="prefill", name="one_p", **_PAGED)
+    d = _engine(net, role="decode", name="one_d", **_PAGED)
+    p.migrate_to(d.adopt)
+    with p, d:
+        p.warmup(), d.warmup()
+        outs = _serve(p, prompts, max_new=1)
+        for r, o in zip(refs, outs):
+            onp.testing.assert_array_equal(r, o)
+        assert d.stats()["engine"]["active_slots"] == 0
+
+
+# -------------------------------------------------------- bundle integrity
+
+def _capture_bundle(net, prompt, **kw):
+    """Run one request through a prefill engine whose target captures
+    the bundle and refuses — the request completes colocated, and the
+    caller gets a genuine digest-stamped bundle to abuse."""
+    captured = {}
+
+    def refuse(bundle, future):
+        captured["b"] = bundle
+        raise RuntimeError("capture only")
+
+    p = _engine(net, role="prefill", name="cap_p", **kw)
+    p.migrate_to(refuse)
+    with p:
+        p.warmup()
+        out = p.submit(prompt, max_new_tokens=4).result(timeout=120)
+        s = p.stats()
+        # fallback path: request served locally, fault counted, and —
+        # the rider contract — zero retries charged
+        assert s["migration"]["by"] == {"out/fallback": 1}
+        assert s["migration"]["migrate_faults"] == 1
+        assert s["resilience"]["retries"] == 0
+    return captured["b"], out
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_digest_mismatch_typed_pool_pristine(net, layout):
+    kw = dict(_PAGED) if layout == "paged" else {}
+    bundle, _ = _capture_bundle(net, _prompts((9,), seed=7)[0], **kw)
+    verify_bundle(bundle)                      # genuine bundle passes
+    with _engine(net, role="decode", name="dig_d", **kw) as d:
+        d.warmup()
+        # flip payload bits: typed rejection, nothing claimed
+        bundle.arrays[0] = bundle.arrays[0] + 1.0
+        with pytest.raises(MigrationDigestError):
+            d.adopt(bundle)
+        # tampered metadata mismatches exactly like tampered payload
+        bundle.arrays[0] = bundle.arrays[0] - 1.0
+        bundle.first_token = (bundle.first_token + 1) % 61
+        with pytest.raises(MigrationDigestError):
+            d.adopt(bundle)
+        # a stripped digest is refused, not trusted
+        bundle.digest = None
+        with pytest.raises(MigrationDigestError):
+            d.adopt(bundle)
+        s = d.stats()
+        assert s["engine"]["active_slots"] == 0
+        assert s["migration"]["migrations_in"] == 0
+        if layout == "paged":
+            assert d._pool.free_count == d.num_pages
+            assert all(r == 0 for r in d._pool._refs)
+
+
+def test_adopt_capacity_and_layout_refusals_typed(net):
+    bundle, _ = _capture_bundle(net, _prompts((9,), seed=8)[0], **_PAGED)
+    # layout mismatch: paged bundle into a dense engine
+    with _engine(net, role="decode", name="lay_d") as d:
+        d.warmup()
+        with pytest.raises(MigrationError):
+            d.adopt(bundle)
+    # page-size mismatch is typed too (KV bytes are not portable)
+    with _engine(net, role="decode", name="ps_d", kv_layout="paged",
+                 page_size=4) as d:
+        with pytest.raises(MigrationError):
+            d.adopt(bundle)
+    # budget that cannot fit the KV length
+    with _engine(net, role="decode", name="fit_d", **_PAGED) as d:
+        bundle.max_new_tokens = 1000
+        bundle.digest = bundle_digest(bundle)
+        with pytest.raises(MigrationError):
+            d.adopt(bundle)
+
+
+# ------------------------------------------------------- fault containment
+
+@pytest.mark.parametrize("site", ["serving.migrate_out",
+                                  "serving.migrate_in"])
+def test_migrate_site_fault_degrades_colocated(net, site):
+    """An injected fault at either migration site degrades THAT request
+    to colocated service on the prefill engine: token-correct, zero
+    lost, zero retries charged, decode pool untouched by the refused
+    bundle."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((5, 8, 6), seed=9)
+    refs = _colocated(net, prompts, **_PAGED)
+    p = _engine(net, role="prefill", name="flt_p", **_PAGED)
+    d = _engine(net, role="decode", name="flt_d", **_PAGED)
+    p.migrate_to(d.adopt)
+    plan = FaultPlan().raise_at(site, at=1)
+    with plan, p, d:
+        p.warmup(), d.warmup()
+        outs = _serve(p, prompts)
+        for r, o in zip(refs, outs):
+            onp.testing.assert_array_equal(r, o)
+        assert plan.fired(site) == 1
+        sp, sd = p.stats(), d.stats()
+        assert sp["migration"]["by"].get("out/fallback") == 1
+        assert sp["migration"]["by"].get("out/ok") == len(prompts) - 1
+        assert sd["migration"]["by"].get("in/ok") == len(prompts) - 1
+        # the rider contract: migration faults never charge retries
+        assert sp["resilience"]["retries"] == 0
+        assert sd["resilience"]["retries"] == 0
+        # nothing leaked on either pool: completed requests DONATE
+        # their pages to the paged prefix cache (parked entries), so
+        # drain it first — then every page must be free with zero refs
+        assert sp["engine"]["active_slots"] == 0
+        assert sd["engine"]["active_slots"] == 0
+        for eng in (p, d):
+            with eng._step_lock:
+                eng._prefix.evict_pages(eng.num_pages)
+            assert eng._pool.free_count == eng.num_pages
+            assert all(r == 0 for r in eng._pool._refs)
+
+
+# ---------------------------------------------------------- fleet directory
+
+def test_fleet_directory_unit():
+    d = FleetDirectory(entries=2)
+    k1, k2, k3 = b"fam1", b"fam2", b"fam3"
+    assert d.locate(k1) is None and d.misses == 1
+    d.publish(k1, "r0")
+    d.publish(None, "r0")              # unkeyed: no-op
+    assert d.locate(k1) == "r0" and d.hits == 1
+    d.publish(k2, "r1")
+    d.publish(k3, "r1")                # LRU capacity 2: k1 evicted
+    assert len(d) == 2 and d.evictions == 1
+    assert d.locate(k1) is None
+    # last writer wins: residency follows the freshest placement
+    d.publish(k2, "r0")
+    assert d.locate(k2) == "r0"
+    # death drops exactly the corpse's entries
+    assert d.forget_replica("r0") == 1
+    assert d.locate(k2) is None and d.locate(k3) == "r1"
+    s = d.stats()
+    assert s["entries"] == 1 and s["evictions"] == 1
+    d.reset()
+    assert len(d) == 0 and d.stats()["hits"] == 0
+
+
+def test_directory_cross_replica_prefix_hit(net):
+    """A prompt family's first request lands somewhere and publishes
+    its residency; every follower locates it through the directory and
+    lands on the SAME replica — prefix hits across replica boundaries
+    without HRW luck."""
+    # seed chosen so no two tails share a first token — a shared tail
+    # head would extend the radix match past the family prefix and key
+    # that member differently (legitimate, but noise for this test)
+    fams = _family(6, shared_len=10, tail_len=3, seed=1)
+
+    def factory(name):
+        return _engine(net, prefix_pool_rows=2, prefix_min_tokens=2,
+                       name=name)
+
+    fleet = FleetRouter(factory=factory, num_replicas=2,
+                        name="dirfleet", health_interval=0.05)
+    with fleet:
+        fleet.warmup()
+        outs = [fleet.submit(p, max_new_tokens=3).result(timeout=120)
+                for p in fams]
+        assert all(o is not None for o in outs)
+        s = fleet.stats()
+        # the family's FIRST member keys at its own full length (radix
+        # record-after-lookup), the second publishes the family key —
+        # every later member locates it: len - 2 hits
+        assert s["router"].get("directory_hits", 0) >= len(fams) - 2
+        assert s["fleet"]["directory"]["entries"] >= 1
+        # the family converged on one replica...
+        routed = [r["routed"] for r in s["replicas"].values()]
+        assert max(routed) >= len(fams) - 2
+        # ... which served the followers by prefix hit
+        assert s["aggregate"]["prefix_hits"] >= len(fams) - 2
+
+
+def test_disagg_fleet_parity_and_directory(net):
+    """Two-stage placement through the router: prefill by load on the
+    prefill replica, decode placement by directory affinity across TWO
+    decode replicas — token parity with colocated, every request
+    migrated, and the routing-stage affinity key (threaded through the
+    bundle as ``route_hint``) converges the family's decode residency
+    on ONE decode pool instead of scattering it by HRW luck."""
+    # same distinct-tail-head seed rationale as the unified test above
+    fams = _family(5, shared_len=10, tail_len=3, seed=1)
+    refs = _colocated(net, fams, max_new=4,
+                      prefix_pool_rows=2, prefix_min_tokens=2, **_PAGED)
+
+    def factory(name):
+        role = "prefill" if name.endswith("r0") else "decode"
+        return _engine(net, role=role, prefix_pool_rows=2,
+                       prefix_min_tokens=2, name=name, **_PAGED)
+
+    fleet = FleetRouter(factory=factory, num_replicas=3,
+                        name="disfleet", health_interval=0.05)
+    with fleet:
+        fleet.warmup()
+        # sequential on purpose: residency publishes at ADOPT time, so
+        # a follower racing its predecessor's migration could miss the
+        # directory legitimately — serialize to pin the hit count
+        outs = [fleet.submit(pr, max_new_tokens=4, seed=i,
+                             temperature=0.5 if i % 2 else 0.0
+                             ).result(timeout=120)
+                for i, pr in enumerate(fams)]
+        for r, o in zip(refs, outs):
+            onp.testing.assert_array_equal(r, o)
+        s = fleet.stats()
+        assert s["fleet"]["disaggregated"] is True
+        assert s["fleet"]["roles"] == {"disfleet-r0": "prefill",
+                                       "disfleet-r1": "decode",
+                                       "disfleet-r2": "decode"}
+        assert s["router"].get("migrations") == len(fams)
+        assert s["router"].get("directory_hits", 0) >= len(fams) - 2
+        assert s["fleet"]["directory"]["entries"] >= 1
+        # family members 2..N adopted on the SAME decode replica
+        adopted = [s["replicas"][n]["routed"]
+                   for n in ("disfleet-r1", "disfleet-r2")]
+        assert max(adopted) >= len(fams) - 1

@@ -119,11 +119,11 @@ def test_affinity_fallback_to_least_loaded_when_saturated(net):
                         spill_queue_depth=3)
     p = _family(1, shared_len=12, tail_len=3, seed=9)[0]
     # unstarted engines: submits queue up deterministically
-    order0 = fleet._order_candidates(p)
+    order0, _ = fleet._order_candidates(p)
     target = order0[0]
     for _ in range(3):
         target.engine.submit(p, max_new_tokens=2)
-    order1 = fleet._order_candidates(p)
+    order1, _ = fleet._order_candidates(p)
     assert order1[-1] is target and order1[0] is not target
     with fleet._counters_lock:
         c = dict(fleet._counters)

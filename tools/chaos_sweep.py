@@ -180,6 +180,8 @@ def serving_scenarios(net):
         ("overload_storm", lambda: serving_overload_storm(net)),
         ("retry_storm", lambda: fleet_retry_storm(net)),
         ("gray_replica", lambda: fleet_gray_replica(net)),
+        ("disagg_prefill_kill", lambda: disagg_prefill_kill(net)),
+        ("disagg_decode_kill", lambda: disagg_decode_kill(net)),
     ]
 
 
@@ -1072,6 +1074,135 @@ def fleet_gray_replica(net):
     }
 
 
+def _disagg_kill(net, label, role_of, site, at, prompts):
+    """Shared body for the disaggregated kill scenarios (docs/fleet.md
+    "Disaggregated serving"): a role-split paged fleet loses one replica
+    to an injected kill at ``site`` (scoped to a specific victim) while
+    family traffic flows.  Invariants: ZERO lost requests (the dead
+    replica's riders fail over and re-enter the two-stage flow), every
+    output token-correct, the monitor rebuilds the corpse AND re-wires
+    its migration egress, the survivors' compile counters stay frozen
+    (neither export nor ``adopt()`` compiles), and a full prefix
+    eviction returns every page of every pool with zero refs."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.fleet import FleetRouter
+    from mxnet_tpu.resilience import FaultPlan
+
+    refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                         temperature=0).asnumpy()[0] for p in prompts]
+
+    def factory(nm):
+        return _engine(net, name=nm, kv_layout="paged", page_size=8,
+                       prefix_pool_rows=2, prefix_min_tokens=2,
+                       role=role_of(nm))
+
+    fleet = FleetRouter(factory=factory, num_replicas=3, name=label,
+                        health_interval=0.03, probation=0.3)
+    fleet.warmup()
+    warm = {h.name: h.engine.stats()["compile_cache"]["compiles"]
+            for h in fleet._handles}
+    plan = FaultPlan().kill_at(site, at=at)
+    lost = mismatched = 0
+    with plan:
+        with fleet:
+            futs = [fleet.submit(p, max_new_tokens=3) for p in prompts]
+            for ref, f in zip(refs, futs):
+                try:
+                    out = f.result(timeout=60)
+                    if not onp.array_equal(out, ref):
+                        mismatched += 1
+                except Exception:
+                    lost += 1
+            mid = fleet.stats()["router"]
+            deaths = mid.get("replica_deaths", 0)
+            mig_before = mid.get("migrations", 0)
+            deadline = time.monotonic() + 20
+            while len(fleet._healthy()) < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            recovered = len(fleet._healthy()) == 3
+            # post-recovery wave: the rebuilt replica is back in the
+            # two-stage flow — in particular a rebuilt PREFILL engine
+            # must be re-wired or it silently serves colocated
+            for ref, p in zip(refs, prompts):
+                try:
+                    out = fleet.infer(p, max_new_tokens=3)
+                    if not onp.array_equal(out, ref):
+                        mismatched += 1
+                except Exception:
+                    lost += 1
+            s = fleet.stats()
+            mig_after = s["router"].get("migrations", 0)
+            restarted = {h.name for h in fleet._handles if h.restarts}
+            rewired = all(h.engine.stats()["engine"]["migrate_target"]
+                          for h in fleet._handles if h.role == "prefill")
+            frozen = all(h.engine.stats()["compile_cache"]["compiles"]
+                         == warm[h.name]
+                         for h in fleet._handles
+                         if h.name not in restarted)
+            # refcount audit: drain every pool's parked prefix entries,
+            # then every page must be free with zero readers
+            clean = True
+            for h in fleet._handles:
+                eng = h.engine
+                with eng._step_lock:
+                    eng._prefix.evict_pages(eng.num_pages)
+                clean = clean and (
+                    eng._pool.free_count == eng.num_pages
+                    and all(r == 0 for r in eng._pool._refs))
+    _join_zombies()
+    passed = (lost == 0 and mismatched == 0 and deaths >= 1 and recovered
+              and plan.fired(site) >= 1 and mig_after > mig_before
+              and mig_before > 0 and rewired and frozen and clean)
+    return {
+        "name": f"fleet/{label.replace('chaos_', 'disagg_')}",
+        "passed": bool(passed),
+        "detail": {"requests": 2 * len(prompts), "lost": lost,
+                   "mismatched": mismatched, "replica_deaths": deaths,
+                   "readmitted": recovered, "rewired": rewired,
+                   "compile_frozen": frozen, "pools_refcount_clean": clean,
+                   "migrations_before_kill_wave": mig_before,
+                   "migrations_total": mig_after,
+                   "restarted": sorted(restarted),
+                   "roles": s["fleet"]["roles"],
+                   "directory": s["fleet"]["directory"],
+                   "router": s["router"],
+                   "faults_fired": plan.fired()},
+    }
+
+
+def disagg_prefill_kill(net):
+    """Kill a PREFILL replica mid-migration (the kill fires at its
+    ``serving.migrate_out`` site, BaseException-level so the colocated
+    fallback cannot contain it): riders fail over to the surviving
+    prefill replica and keep migrating to the decode pool."""
+    import numpy as onp
+    rs = onp.random.RandomState(6)
+    shared = rs.randint(0, 61, (10,)).astype("int32")
+    prompts = [onp.concatenate([shared,
+                                rs.randint(0, 61, (3,)).astype("int32")])
+               for _ in range(10)]
+    return _disagg_kill(
+        net, "chaos_pkill",
+        role_of=lambda nm: "decode" if nm.endswith("r2") else "prefill",
+        site="serving.migrate_out@chaos_pkill-r0", at=1, prompts=prompts)
+
+
+def disagg_decode_kill(net):
+    """Kill a DECODE replica mid-stream (second decode cycle after it
+    adopted migrated requests): its riders fail over, re-prefill on the
+    prefill replica, and re-migrate to the surviving decode pool —
+    token-identical, because sampling folds absolute positions."""
+    # varied (non-family) prompts so decode placement HRW-spreads over
+    # BOTH decode replicas and the scoped victim is guaranteed traffic
+    prompts = _prompts(tuple(range(4, 14)), seed=6)
+    return _disagg_kill(
+        net, "chaos_dkill",
+        role_of=lambda nm: "prefill" if nm.endswith("r0") else "decode",
+        site="serving.decode_step@chaos_dkill-r1", at=2, prompts=prompts)
+
+
 # ------------------------------------------------------- training scenarios
 
 def _make_trainer(**kw):
@@ -1540,6 +1671,10 @@ FORENSICS_AUTO = {
                      "serving.crash"),
     "retry_storm": ("fleet.replica_death", "watchdog.trip",
                     "serving.crash"),
+    "disagg_prefill_kill": ("fleet.replica_death", "watchdog.trip",
+                            "serving.crash"),
+    "disagg_decode_kill": ("fleet.replica_death", "watchdog.trip",
+                           "serving.crash"),
 }
 
 
