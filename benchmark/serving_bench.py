@@ -406,6 +406,147 @@ def bench_fleet(n_replicas: int = 3, groups: int = 3, per_group: int = 16,
              fleet_registry=last_aff["registry"]))
 
 
+def bench_elastic(trials: int = 3, max_replicas: int = 3):
+    """Elastic-fleet flash-spike workload (docs/fleet.md "Elastic
+    fleet"): replay the SAME deterministic loadgen flash-spike trace
+    (10x arrival-rate step) against three arms — autoscaler-on
+    (start 1, grow to ``max_replicas``), fixed-1, and
+    fixed-``max_replicas`` — and compare completed throughput and
+    interactive outcomes.  A uniform decode-step delay is injected
+    identically into every arm: the tiny CPU sanity model would
+    otherwise out-serve any spike, and the delay stands in for a model
+    whose decode step is nontrivial (the regime elasticity exists
+    for).  The headline: the autoscaler arm should approach
+    fixed-``max_replicas`` throughput through the spike while spending
+    fixed-1-like capacity outside it — replica-seconds is the cost
+    column."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.fleet import FleetAutoscaler, FleetRouter
+    from mxnet_tpu.resilience import FaultPlan
+    from mxnet_tpu.serving import InferenceEngine
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import loadgen
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        net, shared_len, tail_len, seq_buckets = _build_prefix_net(True)
+    else:
+        # CPU sanity wants the SMALLEST net that still serves: a
+        # newcomer's warmup (factory build + compiles) must land inside
+        # the replay window or the auto arm can never express added
+        # capacity — the injected decode delay supplies the load, not
+        # model size
+        from mxnet_tpu.models import get_gpt2
+        net = get_gpt2("gpt2_124m", vocab_size=61, units=16,
+                       num_layers=1, num_heads=2, max_length=32,
+                       dropout=0.0)
+        net.initialize()
+        shared_len, tail_len, seq_buckets = 10, 3, (16,)
+    trace = loadgen.flash_spike(
+        duration=6.0, base_rps=8.0, spike_factor=10.0,
+        spike_start=0.25, spike_len=0.3, seed=11, families=3,
+        shared_len=shared_len, tail_len=tail_len,
+        vocab=net.vocab_size, max_new_tokens=2, interactive_frac=0.5)
+
+    def factory_for(tag):
+        def factory(name):
+            return InferenceEngine(
+                net, num_slots=2, max_batch=2, seq_buckets=seq_buckets,
+                default_max_new_tokens=2, prefix_pool_rows=4,
+                prefix_min_tokens=8, queue_depth=256, name=name)
+        return factory
+
+    def one_trial(tag, n_start, scaler_on):
+        import gc
+
+        from mxnet_tpu.observability import flatten
+        fleet = FleetRouter(factory=factory_for(tag), num_replicas=n_start,
+                            name=tag, health_interval=0.05,
+                            breaker_threshold=100)
+        fleet.warmup()
+        gc.collect()
+        scaler = FleetAutoscaler(
+            fleet, min_replicas=1, max_replicas=max_replicas,
+            interval=0.03, queue_high=3, queue_low=1, util_low=0.9,
+            up_cycles=2, down_cycles=20, up_cooldown=0.4,
+            down_cooldown=0.4) if scaler_on else None
+        # replica-seconds: integrate fleet size over the replay — the
+        # capacity bill each arm pays for its throughput
+        sizes = []
+
+        def on_tick(_t):
+            sizes.append(len(fleet._healthy()))
+        plan = FaultPlan().delay_at("serving.decode_step", 0.02, every=1)
+        with fleet:
+            if scaler is not None:
+                scaler.start()
+            try:
+                with plan:
+                    rep = loadgen.replay(trace, fleet, timeout=120.0,
+                                         on_tick=on_tick)
+            finally:
+                if scaler is not None:
+                    scaler.stop()
+            s = fleet.stats()
+            s["registry"] = flatten(prefix="mxtpu_fleet")
+        wall = rep["wall_seconds"]
+        mean_replicas = (sum(sizes) / len(sizes)) if sizes else n_start
+        rep["replica_seconds"] = round(mean_replicas * wall, 2)
+        rep["mean_replicas"] = round(mean_replicas, 3)
+        rep["scale_ups"] = s["router"].get("scale_ups", 0)
+        rep["scale_downs"] = s["router"].get("scale_downs", 0)
+        if scaler is not None:
+            rep["autoscaler"] = scaler.stats()
+        rep["stats"] = s
+        return rep["throughput_rps"], rep
+
+    arms = {"auto": [], "fixed1": [], "fixedN": []}
+    last = {}
+    for t in range(max(1, trials)):
+        for tag, n0, on in (("auto", 1, True), ("fixed1", 1, False),
+                            ("fixedN", max_replicas, False)):
+            rps, rep = one_trial(f"elastic_{tag}_t{t}", n0, on)
+            arms[tag].append(rps)
+            last[tag] = rep
+
+    med = {k: statistics.median(v) for k, v in arms.items()}
+    base = {"trace_events": len(trace), "max_replicas": max_replicas,
+            "spike_factor": 10.0}
+    yield _record("serving_elastic_rps_fixed1", arms["fixed1"], "req/s",
+                  None, dict(base,
+                             interactive=last["fixed1"]["by_priority"]
+                             .get("interactive", {}),
+                             replica_seconds=last["fixed1"]
+                             ["replica_seconds"]))
+    yield _record("serving_elastic_rps_fixedN", arms["fixedN"], "req/s",
+                  round(med["fixedN"] / med["fixed1"], 4)
+                  if med["fixed1"] else None,
+                  dict(base,
+                       interactive=last["fixedN"]["by_priority"]
+                       .get("interactive", {}),
+                       replica_seconds=last["fixedN"]["replica_seconds"]))
+    yield _record(
+        "serving_elastic_rps_autoscaler", arms["auto"], "req/s",
+        round(med["auto"] / med["fixed1"], 4) if med["fixed1"] else None,
+        dict(base,
+             vs_fixedN=round(med["auto"] / med["fixedN"], 4)
+             if med["fixedN"] else None,
+             interactive=last["auto"]["by_priority"].get(
+                 "interactive", {}),
+             lost=last["auto"]["lost"],
+             replica_seconds=last["auto"]["replica_seconds"],
+             mean_replicas=last["auto"]["mean_replicas"],
+             scale_ups=last["auto"]["scale_ups"],
+             scale_downs=last["auto"]["scale_downs"],
+             autoscaler=last["auto"].get("autoscaler"),
+             fleet_registry=last["auto"]["stats"]["registry"]))
+
+
 def _build_overload_net(on_tpu: bool):
     from mxnet_tpu.models import get_gpt2
 
@@ -1084,7 +1225,8 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
-                             "paged", "speculative", "sharded", "disagg"),
+                             "paged", "speculative", "sharded", "disagg",
+                             "elastic"),
                     default="decode")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="device count for --workload sharded "
@@ -1123,6 +1265,8 @@ def main():
                              mesh_devices=args.mesh_devices)
     elif args.workload == "disagg":
         recs = bench_disagg(trials=args.trials)
+    elif args.workload == "elastic":
+        recs = bench_elastic(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -28,6 +28,7 @@ Quick start::
 """
 from ..serving.errors import FleetSaturatedError, NoHealthyReplicaError
 from ..serving.overload import CircuitBreaker, RetryBudget
+from .autoscaler import FleetAutoscaler
 from .directory import FleetDirectory
 from .policy import RoutingPolicy, rendezvous_hash, rendezvous_rank
 from .replica import (DEAD, DRAINING, HEALTHY, STOPPED, SUSPECT,
@@ -36,7 +37,7 @@ from .router import FleetFuture, FleetRouter
 
 __all__ = [
     "FleetRouter", "FleetFuture", "ReplicaHandle", "RoutingPolicy",
-    "FleetDirectory",
+    "FleetDirectory", "FleetAutoscaler",
     "rendezvous_hash", "rendezvous_rank",
     "NoHealthyReplicaError", "FleetSaturatedError",
     "RetryBudget", "CircuitBreaker",

@@ -124,6 +124,30 @@ class RoutingPolicy:
             key_len = min(match, self.affinity_window)
         else:
             key_len = min(n, self.affinity_window)
+        return self._hash_head(tokens, key_len)
+
+    def peek_key(self, tokens) -> Optional[bytes]:
+        """The affinity key ``tokens`` WOULD get — without recording
+        the prompt in the tracker.  The scale-down seeding path uses
+        this to key exported prefix entries: the entry's tokens were
+        already routed once (recording them again would be a no-op at
+        best and, for a fresh tracker, would self-match later traffic
+        at full length), so the peek computes the same key the family's
+        followers carry while leaving the tracker untouched."""
+        n = len(tokens)
+        if n < self.min_tokens:
+            return None
+        with self._lock:
+            hit = self._tree.lookup(tokens)
+        match = hit[0] if hit is not None else 0
+        if match >= self.min_tokens:
+            key_len = min(match, self.affinity_window)
+        else:
+            key_len = min(n, self.affinity_window)
+        return self._hash_head(tokens, key_len)
+
+    @staticmethod
+    def _hash_head(tokens, key_len: int) -> bytes:
         head = [int(t) for t in tokens[:key_len]]
         h = hashlib.blake2b(digest_size=16)
         h.update(",".join(map(str, head)).encode("ascii"))

@@ -98,6 +98,11 @@ class ReplicaHandle:
         self.suspects = 0            # consecutive gray ejections (ladder)
         self.total_suspects = 0
         self.suspect_until: Optional[float] = None
+        # set by the router's MANUAL drain()/rolling_restart() path and
+        # cleared on restart()/replace(): the autoscaler must never
+        # read a deliberately-draining replica as shrink headroom or
+        # its (expectedly rising) queue as scale-up evidence
+        self.manual_drain = False
         self._lock = _named_lock("fleet.replica",
                                  "replica lifecycle state")
 

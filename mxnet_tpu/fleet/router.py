@@ -510,16 +510,26 @@ class FleetRouter:
             raise ServingError("all replicas must share one mode "
                                "(decode or forward)")
         self.mode = mode
+        # kept for elastic scale-up: a newcomer's handle must ride the
+        # same probation/backoff/warmup contract as the founders
+        self._handle_kw = dict(probation=probation,
+                               probation_backoff=probation_backoff,
+                               probation_max=probation_max,
+                               restart_warmup=restart_warmup,
+                               latency_window=self.gray_window)
         self._handles = [
-            ReplicaHandle(n, e, factory=factory, probation=probation,
-                          probation_backoff=probation_backoff,
-                          probation_max=probation_max,
-                          restart_warmup=restart_warmup,
+            ReplicaHandle(n, e, factory=factory,
                           breaker=CircuitBreaker(self._breaker_threshold,
                                                  self._breaker_cooldown),
-                          latency_window=self.gray_window)
+                          **self._handle_kw)
             for n, e in zip(names, engines)]
         self._by_name = {h.name: h for h in self._handles}
+        # serializes scale_up/scale_down against each other and against
+        # drain/stop; routing threads read _handles/_by_name without it
+        # (mutation is copy-then-atomic-reassign, never in place)
+        self._scale_lock = _named_lock("fleet.router.scale",
+                                       "elastic membership changes")
+        self._scale_seq = len(self._handles)
         self.spill_queue_depth = int(spill_queue_depth) \
             if spill_queue_depth is not None \
             else max(2, 2 * engines[0].num_slots)
@@ -572,7 +582,7 @@ class FleetRouter:
         if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
             raise ServingError("router cannot be restarted once stopped "
                                "— build a fresh FleetRouter")
-        for h in self._handles:
+        for h in self._members:
             if h.engine._thread is None:
                 h.engine.start()
         self._monitor = threading.Thread(target=self._monitor_loop,
@@ -586,7 +596,7 @@ class FleetRouter:
         ``{replica_name: programs_compiled}``.  After this, each
         replica's ``compiles`` counter must stay frozen on traffic —
         the same contract as the single engine."""
-        return {h.name: h.engine.warmup(**kw) for h in self._handles}
+        return {h.name: h.engine.warmup(**kw) for h in self._members}
 
     def __enter__(self):
         if self._monitor is None:
@@ -616,7 +626,7 @@ class FleetRouter:
             deadline = None if timeout is None else \
                 time.monotonic() + float(timeout)
             workers = []
-            for h in self._handles:
+            for h in self._members:
                 with h._lock:
                     if h.state in (HEALTHY, DRAINING, SUSPECT):
                         # SUSPECT replicas drain too: slow, not dead —
@@ -696,6 +706,11 @@ class FleetRouter:
                 raise ServingError(f"replica {replica!r} is {h.state}, "
                                    "not drainable")
             h.state = DRAINING
+            # flag the drain as DELIBERATE: the autoscaler must not
+            # read this replica as shrink headroom, nor its rising
+            # queue as saturation evidence (docs/fleet.md "Elastic
+            # fleet" — the drain-vs-autoscaler race)
+            h.manual_drain = True
         self._count("drains")
         deadline = None if timeout is None else time.monotonic() + timeout
         self._shutdown_replica(h, True, deadline)
@@ -716,13 +731,15 @@ class FleetRouter:
         if not h.rebuild():
             raise ServingError(f"replica {replica!r} rebuild failed: "
                                f"{h.last_error}")
+        h.manual_drain = False
+        self._wire_migration(h)
         self._count("restarts")
         return True
 
     def rolling_restart(self, timeout: Optional[float] = None):
         """Zero-downtime fleet restart: drain + rebuild each replica in
         sequence while the rest keep serving."""
-        for h in list(self._handles):
+        for h in list(self._members):
             self.drain(h.name, timeout=timeout)
             self.restart(h.name)
 
@@ -741,14 +758,252 @@ class FleetRouter:
             h.restarts += 1
             h.probation_until = None
             h.suspect_until = None
+            h.manual_drain = False
         h.latency.reset()
 
+    # Membership is copy-on-write: scale_up/scale_down build a NEW
+    # list/dict under _scale_lock and reassign the reference, so a
+    # lock-free reader sees either the old or the new membership —
+    # never a half-built one — and a stale snapshot is benign (routing
+    # re-checks replica state; stats lag at most one scaling action).
+    # Every lock-free read goes through these two accessors so the
+    # contract lives in exactly one place.
+    @property
+    def _members(self) -> List[ReplicaHandle]:
+        return self._handles  # raceguard: unguarded(copy-on-write membership: writers reassign a fresh list under _scale_lock; a reference read is atomic and a stale snapshot benign)
+
+    @property
+    def _name_map(self) -> dict:
+        return self._by_name  # raceguard: unguarded(copy-on-write membership: writers reassign a fresh dict under _scale_lock; a reference read is atomic and a stale snapshot benign)
+
     def _require(self, replica: str) -> ReplicaHandle:
-        h = self._by_name.get(replica)
+        h = self._name_map.get(replica)
         if h is None:
             raise ServingError(f"unknown replica {replica!r} — have "
-                               f"{sorted(self._by_name)}")
+                               f"{sorted(self._name_map)}")
         return h
+
+    # ------------------------------------------------------ elastic scaling
+    def draining(self) -> List[str]:
+        """Replicas currently in a DELIBERATE drain (manual ``drain()``
+        / ``rolling_restart()`` in flight) — the autoscaler holds its
+        decisions while one exists: the shrinking fleet and the
+        victim's rising queue are expected, not evidence."""
+        return [h.name for h in self._members
+                if h.manual_drain and h.state in (DRAINING, STOPPED)]
+
+    def _next_replica_name(self) -> str:  # guarded-by: _scale_lock
+        while True:
+            name = f"{self.name}-r{self._scale_seq}"
+            self._scale_seq += 1
+            if name not in self._by_name:
+                return name
+
+    def scale_up(self, name: Optional[str] = None,
+                 signals: Optional[dict] = None) -> Optional[str]:
+        """Grow the fleet by one factory-built replica (docs/fleet.md
+        "Elastic fleet").  The newcomer is started and **warmed before
+        it joins the routing tables**, so it never compiles on live
+        traffic — the same re-warm contract as probation rebuilds.  HRW
+        placement then remaps only ~1/N of the keyspace, all of it onto
+        the newcomer.
+
+        A fault injected at ``fleet.scale_up`` degrades the action to a
+        counted no-op BEFORE any engine is built — the fleet is left
+        exactly as it was.  Returns the new replica's name, or ``None``
+        on a faulted/no-op action."""
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
+            raise ServingError("fleet router is stopped")
+        if self.factory is None:
+            raise ServingError("scale_up() needs an engine factory — "
+                               "construct the FleetRouter with factory=")
+        with self._scale_lock:
+            try:
+                _inject("fleet.scale_up")
+            except BaseException:
+                self._count("scale_up_faults")
+                return None
+            new_name = name if name is not None \
+                else self._next_replica_name()
+            if new_name in self._by_name:
+                raise ServingError(
+                    f"replica name {new_name!r} already in the fleet")
+            try:
+                eng = self.factory(new_name)
+                if eng.mode != self.mode:
+                    raise ServingError(
+                        f"factory built a {eng.mode}-mode engine for a "
+                        f"{self.mode}-mode fleet")
+                if eng._thread is None:
+                    eng.start()
+                # warm BEFORE taking traffic: the compile freeze must
+                # hold from the newcomer's first routed request
+                eng.warmup()
+            except ServingError:
+                raise
+            except Exception as e:
+                try:
+                    eng.stop(drain=False, timeout=1.0)
+                except Exception:
+                    pass
+                self._count("scale_up_failures")
+                raise ServingError(
+                    f"scale_up: building replica {new_name!r} failed: "
+                    f"{e!r}") from e
+            if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
+                # the fleet stopped while the newcomer warmed: joining
+                # now would strand a live engine no shutdown walks —
+                # discard it and degrade to a counted no-op
+                try:
+                    eng.stop(drain=False, timeout=1.0)
+                except Exception:
+                    pass
+                self._count("scale_up_aborts")
+                return None
+            h = ReplicaHandle(
+                new_name, eng, factory=self.factory,
+                breaker=CircuitBreaker(self._breaker_threshold,
+                                       self._breaker_cooldown),
+                **self._handle_kw)
+            self._wire_migration(h)
+            # copy-then-reassign: routing threads iterate _handles /
+            # read _by_name without the scale lock, so membership must
+            # flip atomically, never mutate in place
+            self._by_name = {**self._by_name, new_name: h}
+            self._handles = self._handles + [h]
+            self._count("scale_ups")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("fleet.scale_up", fleet=self.name,
+                          replica=new_name,
+                          replicas=len(self._handles),
+                          **(signals or {}))
+            return new_name
+
+    def scale_down(self, replica: Optional[str] = None,
+                   timeout: Optional[float] = None, reseed: bool = True,
+                   signals: Optional[dict] = None) -> Optional[str]:
+        """Shrink the fleet by one replica, loss-free (docs/fleet.md
+        "Elastic fleet"): the victim (named, or the least-loaded
+        healthy replica) stops taking new traffic immediately, its
+        queued and in-flight requests DRAIN to completion, its hot
+        prefix entries are exported and re-seeded onto the survivors
+        (HRW-targeted per family, via the ordinary prefix-insert path —
+        under paged KV a refcount-claim handoff), the fleet directory
+        forgets it, and only then does it leave the membership.  Warm
+        prompt families stay warm; zero requests are lost.
+
+        A fault injected at ``fleet.scale_down`` degrades the action to
+        a counted no-op BEFORE the victim starts draining — a faulted
+        scale action never strands a replica half-drained.  Returns the
+        removed replica's name, or ``None`` on a faulted/no-op
+        action."""
+        if self._stopping:  # raceguard: unguarded(one-way stop flag: atomic bool read; the stop path itself serializes under _stop_lock)
+            raise ServingError("fleet router is stopped")
+        with self._scale_lock:
+            healthy = self._healthy()
+            if replica is None:
+                candidates = [h for h in healthy]
+                if not candidates:
+                    raise NoHealthyReplicaError(
+                        f"fleet {self.name!r}: no healthy replica to "
+                        f"scale down")
+                h = min(candidates, key=lambda c: (c.load(), c.name))
+            else:
+                h = self._require(replica)
+            survivors = [s for s in healthy if s is not h]
+            if not survivors:
+                raise ServingError(
+                    f"scale_down would leave fleet {self.name!r} with "
+                    f"no healthy replica — refusing")
+            try:
+                _inject("fleet.scale_down")
+            except BaseException:
+                # degrade to no-op: the victim has not been touched —
+                # it keeps serving, nothing is half-drained
+                self._count("scale_down_faults")
+                return None
+            with h._lock:
+                if h.state not in (HEALTHY, SUSPECT):
+                    raise ServingError(
+                        f"replica {h.name!r} is {h.state}, not "
+                        f"removable — scale_down wants a live victim")
+                h.state = DRAINING
+            # 1) loss-free drain: queued + in-flight requests complete
+            #    (the SIGTERM drain path; a hang is condemned, typed)
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            self._shutdown_replica(h, True, deadline)
+            # 2) harvest the victim's warm families off the stopped
+            #    engine (its caches are still resident; best-effort)
+            seeds = []
+            if reseed:
+                try:
+                    seeds = h.engine.export_prefix_seeds()
+                except Exception:
+                    seeds = []
+            # 3) membership flip (atomic reassign) + the directory
+            #    forgets the corpse so no placement steers at it — a
+            #    stale locate degrades to directory-second placement,
+            #    but why pay the typed miss at all
+            self._handles = [x for x in self._handles if x is not h]
+            self._by_name = {n: x for n, x in self._by_name.items()
+                             if x is not h}
+            forgotten = self._directory.forget_replica(h.name)
+            # 4) re-seed survivors: each family lands on its HRW winner
+            #    among the remaining replicas — exactly where the
+            #    router will place its next member
+            planted = self._reseed(seeds)
+            self._count("scale_downs")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("fleet.scale_down", fleet=self.name,
+                          replica=h.name,
+                          replicas=len(self._handles),
+                          seeds_exported=len(seeds),
+                          seeds_planted=planted,
+                          directory_forgotten=forgotten,
+                          **(signals or {}))
+            return h.name
+
+    def _reseed(self, seeds) -> int:
+        """Plant exported prefix seeds on the survivors: HRW-target
+        each family's winner first (that is where followers will
+        route), spilling down the rank on refusal.  Residency is
+        published to the directory wherever a seed lands, so the next
+        family member gets a directory hit, not a cold miss.  Returns
+        the number of seeds planted."""
+        planted = 0
+        for seed in seeds:
+            candidates = self._healthy()
+            if not candidates:
+                break
+            key = None
+            try:
+                key = self._policy.peek_key(seed.tokens)
+            except Exception:
+                pass
+            if key is not None:
+                ranked = self._policy.rank(key,
+                                           [c.name for c in candidates])
+                order = [self._name_map[n] for n in ranked
+                         if n in self._name_map]
+            else:
+                order = sorted(candidates,
+                               key=lambda c: (c.load(), c.name))
+            for target in order:
+                try:
+                    if target.engine.seed_prefix(seed):
+                        planted += 1
+                        self._directory.publish(key, target.name)
+                        break
+                except ServingError:
+                    continue       # typed refusal: offer the next survivor
+                except Exception:
+                    continue
+        if planted:
+            self._count("seeds_migrated", planted)
+        return planted
 
     # --------------------------------------------------------- SIGTERM
     def install_signal_handlers(self, signals=(_signal.SIGTERM,)):
@@ -882,7 +1137,7 @@ class FleetRouter:
     # ----------------------------------------------------------- monitor
     def _monitor_loop(self):
         while not self._mon_stop.wait(self.health_interval):
-            for h in self._handles:
+            for h in self._members:
                 try:
                     if h.probe():
                         self._replica_death(h, h.last_error
@@ -935,7 +1190,7 @@ class FleetRouter:
         resets the death ladder."""
         if not self.gray_ejection:
             return
-        snaps = [(h, h.latency.snapshot()) for h in self._handles
+        snaps = [(h, h.latency.snapshot()) for h in self._members
                  if h.state == HEALTHY]
         eligible = [(h, s) for h, s in snaps
                     if s["count"] >= self.gray_min_samples]
@@ -973,7 +1228,7 @@ class FleetRouter:
 
     # ------------------------------------------------------------ routing
     def _healthy(self) -> List[ReplicaHandle]:
-        return [h for h in self._handles if h.routable()]
+        return [h for h in self._members if h.routable()]
 
     def _order_candidates(self, payload
                           ) -> Tuple[List[ReplicaHandle],
@@ -994,7 +1249,7 @@ class FleetRouter:
             raise NoHealthyReplicaError(
                 f"fleet {self.name!r}: no healthy "
                 f"{'prefill-capable ' if self.disaggregated else ''}"
-                f"replica ({ {h.name: h.state for h in self._handles} })")
+                f"replica ({ {h.name: h.state for h in self._members} })")
         key, faulted = None, False
         try:
             _inject("fleet.route")
@@ -1025,7 +1280,7 @@ class FleetRouter:
         # wins even when the fleet membership changed since — HRW only
         # decides for families the directory has never seen
         loc = self._directory.locate(key)
-        target = self._by_name.get(loc) if loc is not None else None
+        target = self._name_map.get(loc) if loc is not None else None
         if target is not None and target in healthy and \
                 not target.saturated(self.spill_queue_depth):
             self._count("directory_hits")
@@ -1035,7 +1290,7 @@ class FleetRouter:
         # saturated) — fall through to the stateless rank
         self._count("directory_misses")
         ranked = self._policy.rank(key, [h.name for h in healthy])
-        target = self._by_name[ranked[0]]
+        target = self._name_map[ranked[0]]
         rest = [h for h in by_load if h is not target]
         if target.saturated(self.spill_queue_depth):
             self._count("affinity_spills")
@@ -1268,7 +1523,7 @@ class FleetRouter:
 
     def health(self) -> dict:
         reps = {}
-        for h in self._handles:
+        for h in self._members:
             try:
                 eh = h.engine.health()
             except Exception as e:
@@ -1292,7 +1547,7 @@ class FleetRouter:
         replicas, agg = {}, {"submitted": 0, "completed": 0,
                              "tokens_generated": 0, "prefix_hits": 0,
                              "prefix_misses": 0, "prefix_tokens_saved": 0}
-        for h in self._handles:
+        for h in self._members:
             try:
                 s = h.engine.stats()
             except Exception as e:
@@ -1314,13 +1569,13 @@ class FleetRouter:
             if looked else None
         return {
             "fleet": {"name": self.name, "routing": self.routing,
-                      "replicas": len(self._handles),
+                      "replicas": len(self._members),
                       "healthy": len(self._healthy()),
                       "spill_queue_depth": self.spill_queue_depth,
                       "max_failovers": self.max_failovers,
                       "tracked_prefixes": len(self._policy),
                       "disaggregated": self.disaggregated,
-                      "roles": {h.name: h.role for h in self._handles},
+                      "roles": {h.name: h.role for h in self._members},
                       "directory": self._directory.stats(),
                       "gray": {"ejection": self.gray_ejection,
                                "multiplier": self.gray_multiplier,
@@ -1333,7 +1588,7 @@ class FleetRouter:
                           "rate": self._retry_budget.rate,
                           "denied": self._retry_budget.denied},
                       "breakers": {h.name: h.breaker.state
-                                   for h in self._handles}},
+                                   for h in self._members}},
             "router": router,
             "aggregate": agg,
             "replicas": replicas,
@@ -1367,7 +1622,7 @@ class FleetRouter:
             for k, v in sorted(counters.items())]
         healthy = 0
         hits = misses = 0
-        for h in self._handles:
+        for h in self._members:
             up = 1 if h.routable() else 0
             healthy += up
             rlbl = {"fleet": self.name, "replica": h.name}
@@ -1406,6 +1661,9 @@ class FleetRouter:
                 misses += c["prefix_misses"]
             except Exception:
                 pass
+        samples.append({"name": "mxtpu_fleet_replicas",
+                        "kind": "gauge", "labels": dict(lbl),
+                        "value": len(self._members), "help": ""})
         samples.append({"name": "mxtpu_fleet_replicas_healthy",
                         "kind": "gauge", "labels": dict(lbl),
                         "value": healthy, "help": ""})
@@ -1425,5 +1683,5 @@ class FleetRouter:
 
     def __repr__(self):
         return (f"FleetRouter({self.name!r}, routing={self.routing}, "
-                f"replicas={len(self._handles)}, "
+                f"replicas={len(self._members)}, "
                 f"healthy={len(self._healthy())})")

@@ -212,6 +212,10 @@ register_site("kvstore.pull", "kvstore pull RPC")
 register_site("fleet.route", "placement decision (degrades least-loaded)")
 register_site("fleet.failover", "one failover attempt (budget untouched)")
 register_site("fleet.drain", "replica drain (delay models a hang)")
+register_site("fleet.scale_up", "elastic scale-up action (degrades to "
+              "no-op before any engine is built)")
+register_site("fleet.scale_down", "elastic scale-down action (degrades "
+              "to no-op before the victim starts draining)")
 
 
 class FaultSpec:

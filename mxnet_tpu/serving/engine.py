@@ -1815,6 +1815,33 @@ class InferenceEngine:
                 fr.record("serving.brownout", engine=self.name,
                           reason=reason)
 
+    def coordinate_overload(self, factor_cap: Optional[float] = None,
+                            deadline_safety: Optional[float] = None
+                            ) -> None:
+        """Fleet-coordination surface (docs/fleet.md "Elastic fleet"):
+        an external controller with aggregate visibility — the fleet
+        autoscaler — drives this engine's brownout factor cap and
+        deadline-admission safety margin.  Both compose with the local
+        loops instead of replacing them: the effective brownout factor
+        is ``min(local AIMD factor, fleet cap)``, and the safety margin
+        scales the admission-time service estimate.  Safe from any
+        thread (GIL-atomic float writes — the same contract as the
+        controller's own submit-side queries)."""
+        if factor_cap is not None:
+            entered = self._overload.set_fleet_cap(factor_cap)
+            if entered:
+                self.metrics.count("brownouts")
+                self.metrics.mark("brownout", "fleet_coordinated")
+                fr = _fr_active()
+                if fr is not None:
+                    fr.record("serving.brownout", engine=self.name,
+                              reason="fleet_coordinated")
+        if deadline_safety is not None:
+            if deadline_safety <= 0:
+                raise ServingError(
+                    f"deadline_safety must be > 0, got {deadline_safety}")
+            self.deadline_safety = float(deadline_safety)
+
     def infer(self, x, max_new_tokens: Optional[int] = None,
               timeout: Optional[float] = None,
               eos_id: Optional[int] = None,
@@ -2122,6 +2149,166 @@ class InferenceEngine:
                    for leaf, arr in zip(flat, bundle.arrays)]
         self._caches = self._place_caches(
             jax.tree_util.tree_unflatten(treedef, new))
+
+    # ------------------------------------------------------- prefix seeding
+    @staticmethod
+    def _entry_tokens(entry):
+        """Reconstruct the token sequence a prefix-cache entry spells
+        by concatenating radix-node edges root→node — the tree stores
+        path-compressed edges, so the full sequence lives only on the
+        path."""
+        edges = []
+        node = entry.node
+        while node is not None:
+            edges.append(node.edge)
+            node = node.parent
+        toks: list = []
+        for e in reversed(edges):
+            toks.extend(e)
+        return toks
+
+    def export_prefix_seeds(self, limit: Optional[int] = None):
+        """Host-copy this engine's cached prefix entries as digest-
+        stamped :class:`~.migration.PrefixSeed` bundles, hottest (most
+        recently used) first — the scale-down drain path (docs/fleet.md
+        "Elastic fleet").  Runs on the CALLER's thread under
+        ``_step_lock`` (the :meth:`adopt` precedent), so it is safe
+        both against a live scheduler and on a drained/stopped engine
+        whose caches are still resident.  Best-effort by design: an
+        engine with no prefix cache, no entries, or dropped device
+        caches exports ``[]``."""
+        from .migration import PrefixSeed, seed_digest
+        if self.mode != "decode" or self._prefix is None:
+            return []
+        import jax
+        import jax.numpy as jnp
+        seeds = []
+        with self._step_lock:
+            if self._caches is None or not len(self._prefix):
+                return []
+            flat = jax.tree_util.tree_leaves(self._caches)
+            entries = sorted(self._prefix._entries,
+                             key=lambda e: e.last_used, reverse=True)
+            if limit is not None:
+                entries = entries[:int(limit)]
+            for entry in entries:
+                try:
+                    tokens = self._entry_tokens(entry)
+                    if self._paged:
+                        pids = jnp.asarray(
+                            onp.asarray(entry.pages, "int32"))
+                        arrays = [onp.asarray(leaf[pids])
+                                  for leaf in flat]
+                    else:
+                        arrays = [onp.asarray(leaf[entry.row,
+                                                   :entry.length])
+                                  for leaf in flat]
+                    s = PrefixSeed(
+                        source=self.name, layout=self.kv_layout,
+                        page_size=self.page_size if self._paged else 0,
+                        tokens=tokens, length=entry.length,
+                        arrays=arrays)
+                    s.digest = seed_digest(s)
+                    seeds.append(s)
+                except Exception:
+                    continue     # one unreadable entry must not void the rest
+        self.metrics.count("prefix_seeds_out", len(seeds))
+        return seeds
+
+    def seed_prefix(self, seed) -> bool:
+        """Plant one migrated :class:`~.migration.PrefixSeed` into this
+        engine's prefix cache — the survivor side of loss-free
+        scale-down.  Verifies the digest FIRST (nothing to undo on a
+        torn seed), then, under ``_step_lock``:
+
+        - **dense**: reserve a pool row through the ordinary
+          ``PrefixCache.insert`` path and install the K/V with eager
+          ``.at[row, :length].set`` writes (the :meth:`_install_kv`
+          cache-surgery idiom — zero compile-cache entries, so the
+          post-warmup freeze holds);
+        - **paged**: claim fresh pages, eager-write the seed's page
+          contents, then hand the claims to the cache — the
+          ``PagedPrefixCache.insert`` entry takes its OWN refcount on
+          every page and this method releases the allocation claims,
+          leaving the entry as sole owner (the refcount-claim handoff:
+          eviction later frees the pages exactly like any cached
+          prefix).
+
+        Returns True iff the seed now backs a cache entry.  Refusals
+        are typed (digest/layout mismatch) or False (already cached,
+        pool full of pinned entries, engine without a prefix cache) —
+        seeding is an optimization and must never fail a fleet
+        operation."""
+        from .migration import verify_seed
+        verify_seed(seed)
+        if self.mode != "decode" or self._prefix is None:
+            return False
+        if seed.layout != self.kv_layout:
+            raise MigrationError(
+                f"seed layout {seed.layout!r} != engine kv_layout "
+                f"{self.kv_layout!r} — KV bytes are not portable "
+                f"across layouts")
+        if self._paged and seed.page_size != self.page_size:
+            raise MigrationError(
+                f"seed page_size={seed.page_size} != engine "
+                f"page_size={self.page_size}")
+        if seed.length > self.max_length or \
+                seed.length < self.prefix_min_tokens:
+            return False
+        import jax
+        import jax.numpy as jnp
+        with self._step_lock:
+            if self._crashed is not None or not self._prefix_usable():
+                return False
+            self._ensure_caches()
+            flat, treedef = jax.tree_util.tree_flatten(self._caches)
+            if len(flat) != len(seed.arrays):
+                raise MigrationError(
+                    f"seed carries {len(seed.arrays)} cache leaves, "
+                    f"engine has {len(flat)} — model mismatch")
+            tokens = [int(t) for t in seed.tokens]
+            if self._paged:
+                need = self._pool.pages_for(seed.length)
+                if need != len(seed.arrays[0]):
+                    raise MigrationError(
+                        f"seed carries {len(seed.arrays[0])} pages but "
+                        f"length={seed.length} needs {need} at "
+                        f"page_size={self.page_size}")
+                pages = self._claim_pages(need)
+                if pages is None:
+                    self.metrics.count("page_faults")
+                    return False
+                pids = jnp.asarray(onp.asarray(pages, "int32"))
+                new = [leaf.at[pids].set(jnp.asarray(arr))
+                       for leaf, arr in zip(flat, seed.arrays)]
+                entry = self._prefix.insert(tokens, pages, seed.length)
+                # handoff: the entry's own refs (taken by insert) now
+                # carry the pages; the allocation claims drop either
+                # way — on a refused insert (family already cached)
+                # this releases the pages entirely
+                for pid in pages:
+                    self._pool.unref(pid)
+                if entry is None:
+                    return False
+            else:
+                entry = self._prefix.insert(tokens)
+                if entry is None:
+                    return False
+                new = [leaf.at[entry.row, :seed.length]
+                       .set(jnp.asarray(arr))
+                       for leaf, arr in zip(flat, seed.arrays)]
+            try:
+                self._caches = self._place_caches(
+                    jax.tree_util.tree_unflatten(treedef, new))
+            except BaseException:
+                # the mapping must never outlive a failed install — a
+                # tree pointing at a row/pages that do not hold what
+                # they promise is silent corruption
+                self._prefix.remove(entry)
+                raise
+            self.metrics.count("prefix_seeds_in")
+            self.metrics.count("prefix_inserts")
+        return True
 
     # ------------------------------------------------------------------- stats
     def stats(self) -> dict:

@@ -38,7 +38,9 @@ from ..resilience.integrity import TreeHasher
 from .errors import MigrationDigestError, MigrationError
 
 __all__ = ["MigrationBundle", "MIGRATION_SCHEMA_VERSION",
-           "export_bundle", "bundle_digest", "verify_bundle"]
+           "export_bundle", "bundle_digest", "verify_bundle",
+           "PrefixSeed", "PREFIX_SEED_SCHEMA_VERSION",
+           "seed_digest", "verify_seed"]
 
 #: bump when the bundle field layout changes — adopt() refuses bundles
 #: from a different schema instead of misinterpreting them
@@ -183,3 +185,88 @@ def export_bundle(eng, slot: int, st, first_token: int) -> MigrationBundle:
         trace_id=req.trace_id, route_hint=req.route_hint)
     b.digest = bundle_digest(b)
     return b
+
+
+# --------------------------------------------------- prefix-seed transport
+
+#: bump when the seed field layout changes — seed_prefix() refuses
+#: seeds from a different schema instead of misinterpreting them
+PREFIX_SEED_SCHEMA_VERSION = 1
+
+
+class PrefixSeed:
+    """One cached prefix entry's migratable state (docs/fleet.md
+    "Elastic fleet"): the token sequence a prefix-cache entry spells
+    plus a host copy of its K/V — dense: the pool row's first
+    ``length`` positions per cache leaf; paged: a gather of the entry's
+    whole pages.  A replica leaving the fleet exports its hot entries
+    as seeds and the router re-plants them on survivors via the
+    ordinary prefix-insert path, so warm prompt families survive
+    scale-down instead of going cold.
+
+    Same digest discipline as :class:`MigrationBundle`: a BLAKE2b-128
+    tree digest over a canonical header + every array's bytes, checked
+    by :func:`verify_seed` on the importing side BEFORE any row or
+    page is claimed."""
+
+    __slots__ = ("schema", "source", "layout", "page_size", "tokens",
+                 "length", "arrays", "digest")
+
+    def __init__(self, *, source: str, layout: str, page_size: int,
+                 tokens, length: int, arrays: List[onp.ndarray]):
+        self.schema = PREFIX_SEED_SCHEMA_VERSION
+        self.source = source
+        self.layout = layout
+        self.page_size = int(page_size)
+        self.tokens = onp.asarray(tokens, "int32")
+        self.length = int(length)
+        self.arrays = arrays
+        self.digest: Optional[str] = None
+
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.arrays)
+                   + self.tokens.nbytes)
+
+    def __repr__(self):
+        return (f"PrefixSeed(source={self.source!r}, "
+                f"layout={self.layout!r}, length={self.length}, "
+                f"leaves={len(self.arrays)}, {self.nbytes()} bytes)")
+
+
+def _seed_header_bytes(s: PrefixSeed) -> bytes:
+    head = (s.schema, s.layout, s.page_size, s.length,
+            tuple((tuple(a.shape), str(a.dtype)) for a in s.arrays))
+    return repr(head).encode()
+
+
+def seed_digest(s: PrefixSeed) -> str:
+    """BLAKE2b-128 tree digest over the canonical header, the token
+    sequence, and every array's contiguous bytes, in leaf order."""
+    h = TreeHasher()
+    h.update(_seed_header_bytes(s))
+    h.update(onp.ascontiguousarray(s.tokens).tobytes())
+    for a in s.arrays:
+        h.update(onp.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def verify_seed(s: PrefixSeed) -> None:
+    """Importing-side gate, mirroring :func:`verify_bundle`: schema
+    must match and the recomputed digest must equal the one stamped at
+    export — checked BEFORE any row/page claim, so a rotten seed can
+    never poison a survivor's pool."""
+    if getattr(s, "schema", None) != PREFIX_SEED_SCHEMA_VERSION:
+        raise MigrationError(
+            f"prefix seed schema {getattr(s, 'schema', None)!r} != "
+            f"{PREFIX_SEED_SCHEMA_VERSION} — refusing to reinterpret "
+            f"a foreign layout")
+    if not s.digest:
+        raise MigrationDigestError(
+            "prefix seed carries no digest — refusing an unverifiable "
+            "transfer")
+    got = seed_digest(s)
+    if got != s.digest:
+        raise MigrationDigestError(
+            f"prefix seed digest mismatch (want {s.digest}, got {got}):"
+            f" torn or corrupted transfer — seed NOT planted, prefix "
+            f"pool untouched")

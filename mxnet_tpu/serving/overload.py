@@ -134,6 +134,14 @@ class OverloadController:
         if not (0.0 < self.floor <= 1.0):
             raise ServingError(f"floor must be in (0, 1], got {self.floor}")
         self.factor = 1.0
+        # fleet-coordinated cap (docs/fleet.md "Elastic fleet"): an
+        # external controller with AGGREGATE visibility (the fleet
+        # autoscaler) can cap the effective factor across replicas.
+        # It composes with — never replaces — the local AIMD loop:
+        # effective_factor = min(factor, fleet_cap), so a hot replica
+        # still browns out alone, and the fleet only degrades together
+        # when the aggregate signals say so.
+        self.fleet_cap = 1.0
         self.brownouts = 0           # lifetime brownout entries
         self._last_change = 0.0
         self._last_pressure: Optional[float] = None
@@ -181,26 +189,55 @@ class OverloadController:
         self._last_pressure = now
         self._last_change = now
 
+    def set_fleet_cap(self, cap: float) -> bool:
+        """Externally cap the effective factor — the autoscaler's
+        fleet-coordinated brownout knob, driven from AGGREGATE signals
+        so one hot replica cannot drag idle siblings down.  Clamped to
+        ``[floor, 1.0]``; 1.0 releases the cap (local AIMD recovery is
+        untouched either way).  Returns True iff this call ENTERED
+        brownout (the caller counts entries, like ``update()``)."""
+        if not self.enabled:
+            return False
+        cap = min(1.0, max(self.floor, float(cap)))
+        was = self.brownout
+        self.fleet_cap = cap
+        entered = not was and self.brownout
+        if entered:
+            self.brownouts += 1
+        return entered
+
     # ------------------------------------------------------------- queries
     @property
+    def effective_factor(self) -> float:
+        """What the engine actually degrades by: the local AIMD factor
+        under the fleet-coordinated cap."""
+        return min(self.factor, self.fleet_cap)
+
+    @property
     def brownout(self) -> bool:
-        return self.factor < 1.0
+        return self.effective_factor < 1.0
 
     def cap_tokens(self, ordinal: int, requested: int) -> int:
-        """Brownout token cap: non-``interactive`` classes get
-        ``factor`` of their ask (never below 1).  Service degrades
-        before anything is refused."""
+        """Brownout token cap: non-``interactive`` classes get the
+        effective factor of their ask (never below 1).  Service
+        degrades before anything is refused."""
         if not self.brownout or ordinal == PRIORITY_INTERACTIVE:
             return requested
-        return max(1, int(round(requested * self.factor)))
+        return max(1, int(round(requested * self.effective_factor)))
 
     def shedding(self, ordinal: int,
                  now: Optional[float] = None) -> bool:
         """Hard brownout shedding: only the LOWEST class, only at the
         floor, only while pressure is recent — everything milder is
-        handled by degradation, not refusal."""
+        handled by degradation, not refusal.  A fleet cap AT the floor
+        sheds without the local-pressure recency test: the aggregate
+        signals already established standing pressure fleet-wide, and
+        an idle-looking replica must still refuse best-effort work the
+        fleet as a whole cannot afford."""
         if not self.enabled or ordinal != len(PRIORITIES) - 1:
             return False
+        if self.fleet_cap <= self.floor:
+            return True
         if self.factor > self.floor:
             return False
         now = time.monotonic() if now is None else now
@@ -217,6 +254,8 @@ class OverloadController:
     def snapshot(self) -> dict:
         return {"enabled": self.enabled,
                 "factor": round(self.factor, 4),
+                "fleet_cap": round(self.fleet_cap, 4),
+                "effective_factor": round(self.effective_factor, 4),
                 "brownout": self.brownout,
                 "brownouts": self.brownouts,
                 "floor": self.floor,

@@ -180,6 +180,7 @@ def serving_scenarios(net):
         ("overload_storm", lambda: serving_overload_storm(net)),
         ("retry_storm", lambda: fleet_retry_storm(net)),
         ("gray_replica", lambda: fleet_gray_replica(net)),
+        ("flash_spike", lambda: fleet_flash_spike(net)),
         ("disagg_prefill_kill", lambda: disagg_prefill_kill(net)),
         ("disagg_decode_kill", lambda: disagg_decode_kill(net)),
     ]
@@ -1070,6 +1071,143 @@ def fleet_gray_replica(net):
                    "rebuilds": s["replicas"]["chaos_gray-r1"]["restarts"],
                    "compiles_after_warmup": compiles - n_warm,
                    "suspect_reason": slow.last_error,
+                   "router": s["router"]},
+    }
+
+
+def fleet_flash_spike(net):
+    """Elastic-fleet chaos (docs/fleet.md "Elastic fleet"): a loadgen
+    flash-spike trace (10x arrival-rate step) replays against a
+    1-replica fleet with the autoscaler ON.  Invariants: the
+    interactive SLO budget survives the spike (ZERO interactive
+    requests lost; typed refusals land on best_effort — brownout
+    absorbs the front); the autoscaler grows the fleet off sustained
+    pressure and its decision events carry the justifying signals; a
+    scale-DOWN executed under live load loses zero requests and zero
+    tokens (drain + prefix re-seed); and no replica compiles on
+    traffic after its warmup — including newcomers, which warm BEFORE
+    joining the routing tables."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.fleet import FleetAutoscaler
+    from mxnet_tpu.observability import flightrecorder as _flightrec
+    from mxnet_tpu.resilience import FaultPlan
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import loadgen
+
+    trace = loadgen.flash_spike(
+        duration=6.0, base_rps=8.0, spike_factor=10.0,
+        spike_start=0.25, spike_len=0.3, seed=17, families=3,
+        shared_len=10, tail_len=3, vocab=61, max_new_tokens=3,
+        interactive_frac=0.5)
+
+    def spike_factory(name):
+        # deep admission queue: interactive absorbs the spike front by
+        # WAITING (brownout sheds best_effort); a shallow queue would
+        # refuse interactive on depth alone and blow the SLO budget
+        return _engine(net, name=name, prefix_pool_rows=2,
+                       prefix_min_tokens=2, queue_depth=256)
+
+    from mxnet_tpu.fleet import FleetRouter
+    fleet = FleetRouter(factory=spike_factory, num_replicas=1,
+                        name="chaos_spike", health_interval=0.03,
+                        probation=0.3, breaker_threshold=100)
+    fleet.warmup()
+    scaler = FleetAutoscaler(
+        fleet, min_replicas=1, max_replicas=3, interval=0.03,
+        queue_high=3, queue_low=1, util_low=0.9,
+        up_cycles=2, down_cycles=200,
+        up_cooldown=0.5, down_cooldown=0.5)
+    # an unscoped decode-step delay makes the tiny CPU model SLOW
+    # relative to the spike (the regime the autoscaler exists for);
+    # it applies to newcomers too, so added capacity is real capacity
+    plan = FaultPlan().delay_at("serving.decode_step", 0.02, every=1)
+    lost_post = mismatched = 0
+    with fleet:
+        with scaler:
+            with plan:
+                report = loadgen.replay(trace, fleet, timeout=120.0)
+        grew = fleet.stats()["router"].get("scale_ups", 0)
+        # decision events carry the justifying signals
+        fr = _flightrec.active()
+        ups = fr.events("fleet.scale_up") if fr is not None else []
+        signals_attached = all("sig_queue_max" in e.attrs for e in ups)
+        # scale-down UNDER LOAD: submit a live wave, then shrink while
+        # it is in flight — nothing may be lost or token-wrong
+        rs = onp.random.RandomState(29)
+        shared = rs.randint(0, 61, (10,)).astype("int32")
+        prompts = [onp.concatenate(
+            [shared, rs.randint(0, 61, (3,)).astype("int32")])
+            for _ in range(8)]
+        refs = [net.generate(mx.nd.array(p[None], dtype="int32"), 3,
+                             temperature=0).asnumpy()[0]
+                for p in prompts]
+        if len(fleet._healthy()) == 1:
+            # the tail already shrank the fleet — re-grow so the
+            # under-load scale-down below exercises the real path
+            fleet.scale_up(signals={"reason": "chaos_setup"})
+        futs = [fleet.submit(p, max_new_tokens=3,
+                             priority="interactive") for p in prompts]
+        removed = fleet.scale_down(signals={"reason": "chaos"})
+        for ref, f in zip(refs, futs):
+            try:
+                out = f.result(60)
+                if not onp.array_equal(out, ref):
+                    mismatched += 1
+            except Exception:
+                lost_post += 1
+        # compile freeze: a verification wave through the post-scale
+        # fleet adds ZERO compiles on any surviving replica
+        s0 = fleet.stats()
+        compiles0 = {n: rep["stats"]["compile_cache"]["compiles"]
+                     for n, rep in s0["replicas"].items()
+                     if "stats" in rep}
+        for ref, p in zip(refs, prompts):
+            try:
+                out = fleet.infer(p, max_new_tokens=3, timeout=30.0,
+                                  priority="interactive")
+                if not onp.array_equal(out, ref):
+                    mismatched += 1
+            except Exception:
+                lost_post += 1
+        s = fleet.stats()
+        compiles1 = {n: rep["stats"]["compile_cache"]["compiles"]
+                     for n, rep in s["replicas"].items()
+                     if "stats" in rep}
+        frozen = compiles1 == compiles0
+    _join_zombies()
+    inter = report["by_priority"].get("interactive",
+                                      {"issued": 0, "lost": 0,
+                                       "errors": 0, "rejected": 0})
+    issued = max(1, inter["issued"] + inter["rejected"])
+    inter_err_frac = (inter["lost"] + inter["errors"]
+                      + inter["rejected"]) / issued
+    passed = (report["lost"] == 0 and lost_post == 0
+              and mismatched == 0
+              and inter["lost"] == 0
+              and inter_err_frac <= 0.1          # SLO budget unblown
+              and grew >= 1 and signals_attached
+              and removed is not None
+              and s["router"].get("scale_downs", 0) >= 1
+              and frozen)
+    return {
+        "name": "fleet/flash_spike",
+        "passed": bool(passed),
+        "detail": {"trace_events": report["events"],
+                   "replay": {k: report[k] for k in
+                              ("issued", "completed", "rejected",
+                               "errors", "lost")},
+                   "interactive": inter,
+                   "interactive_error_fraction":
+                       round(inter_err_frac, 4),
+                   "scale_ups": grew,
+                   "scale_up_events_with_signals": signals_attached,
+                   "scaled_down_under_load": removed,
+                   "post_wave_lost": lost_post,
+                   "mismatched": mismatched,
+                   "compile_frozen_post_scale": frozen,
                    "router": s["router"]},
     }
 
