@@ -114,6 +114,20 @@ the freeze, the TPU run reuses it unchanged.  The record carries
 decode tokens/s, migration counters + latency, and the live registry
 snapshot).
 
+``--workload tiered`` runs the warm-family TTFT comparison for the
+tiered prefix cache (docs/serving.md "Tiered prefix cache"): a
+working set of shared-prefix families ~8-10x the device page pool,
+revisited with fresh tails after the pool thrashed them out.  Three
+arms — ``hbm`` (pool big enough to hold everything: the floor),
+``tiered`` (starved pool + host tier: revisits promote, with
+verify-on-promote inside the measured time), ``recompute`` (starved
+pool, tier off: revisits pay the shared-prefix prefill again).
+Greedy outputs are asserted token-identical across all three arms
+every trial, and each arm's compile counter is asserted frozen
+post-warmup.  It emits ``serving_tiered_ttft_{hbm,recompute,tiered}``
+(``vs_baseline`` on the tiered record is hbm/tiered; the record also
+carries ``vs_hbm_x`` / ``vs_recompute_x`` and the tier counters).
+
 Both paths pay their compiles during warmup (generate's jit cache /
 ``engine.warmup()``), then run >= 3 timed trials; the reported value is
 the median (bench.py trial hygiene).
@@ -856,6 +870,138 @@ def bench_paged(n_requests: int = 16, trials: int = 3):
              registry_live=last_paged["registry"]))
 
 
+def _build_tiered_net(on_tpu: bool):
+    from mxnet_tpu.models import get_gpt2
+
+    if on_tpu:
+        cfg = dict(max_length=2048, dropout=0.0)
+        name = "gpt2_124m"
+        shared_len, tail_len = 1024, 64
+        seq_buckets = (1024, 2048)
+        page_size, n_families = 128, 11
+    else:   # CPU sanity: like the prefix bench, the prefill must be
+        # COMPUTE-bound or the promotion copy costs more than the
+        # prefill it replaces and the arm ordering is meaningless
+        name = "gpt2_124m"
+        cfg = dict(vocab_size=512, units=256, num_layers=4, num_heads=8,
+                   max_length=272, dropout=0.0)
+        shared_len, tail_len = 240, 8
+        seq_buckets = (16, 32, 64, 128, 256)
+        page_size, n_families = 16, 11
+    net = get_gpt2(name, **cfg)
+    net.initialize()
+    return net, shared_len, tail_len, seq_buckets, page_size, n_families
+
+
+def bench_tiered(trials: int = 3, max_new: int = 1):
+    """Warm-family TTFT with a working set ~8-10x the device page
+    pool, three arms (docs/serving.md "Tiered prefix cache"):
+
+    - ``hbm``: a pool large enough that every family stays device-
+      resident — the floor the tier is chasing.
+    - ``tiered``: a starved pool + host tier — families demote under
+      pressure and revisits promote (verify-on-promote included in the
+      measured time).
+    - ``recompute``: the same starved pool, tier OFF — revisits pay
+      the full shared-prefix prefill again.
+
+    Per trial (fresh engines; serial requests for TTFT isolation):
+    warm every family once, then revisit each family with a NEW tail
+    and time the revisit.  Greedy outputs are asserted token-identical
+    across all three arms every trial — the numbers must compare the
+    same work."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    (net, shared_len, tail_len, seq_buckets, page_size,
+     n_families) = _build_tiered_net(on_tpu)
+    rs = onp.random.RandomState(15)
+    shared = [rs.randint(0, net.vocab_size, (shared_len,)).astype("int32")
+              for _ in range(n_families)]
+    warm_prompts = [onp.concatenate(
+        [s, rs.randint(0, net.vocab_size, (tail_len,)).astype("int32")])
+        for s in shared]
+    revisit_prompts = [onp.concatenate(
+        [s, rs.randint(0, net.vocab_size, (tail_len,)).astype("int32")])
+        for s in shared]
+    # worst case request = ceil((prompt + max_new) / page_size) pages;
+    # the starved pool holds ONE family plus two pages of headroom, so
+    # the working set is ~8-10x the pool and every warm insert evicts
+    per_req = -(-(shared_len + tail_len + max_new) // page_size)
+    starved_pages = per_req + 2
+    hbm_pages = n_families * (per_req + 1) + 2
+    working_x = round(n_families * per_req / starved_pages, 1)
+
+    def one_trial(arm):
+        kw = dict(num_pages=starved_pages)
+        if arm == "hbm":
+            kw = dict(num_pages=hbm_pages)
+        elif arm == "tiered":
+            kw["host_pool_bytes"] = 256 << 20
+        eng = InferenceEngine(
+            net, num_slots=1, max_batch=1, seq_buckets=seq_buckets,
+            default_max_new_tokens=max_new, kv_layout="paged",
+            page_size=page_size, prefix_min_tokens=8,
+            name=f"serving_tiered_{arm}", **kw)
+        n_warm = eng.warmup()
+        with eng:
+            for p in warm_prompts:
+                eng.infer(p, max_new_tokens=max_new)
+            lat, outs = [], []
+            for p in revisit_prompts:
+                t0 = time.perf_counter()
+                outs.append(eng.infer(p, max_new_tokens=max_new,
+                                      timeout=300))
+                lat.append(1000.0 * (time.perf_counter() - t0))
+            s = eng.stats()
+        if s["compile_cache"]["compiles"] != n_warm:
+            raise AssertionError(
+                f"{arm} arm compiled post-warmup — the revisit times "
+                f"would include tracing, not serving")
+        return statistics.median(lat), s, outs
+
+    arms = {"hbm": [], "tiered": [], "recompute": []}
+    last = {}
+    for _ in range(max(1, trials)):
+        trial_outs = {}
+        for arm in arms:
+            med, s, outs = one_trial(arm)
+            arms[arm].append(med)
+            last[arm] = s
+            trial_outs[arm] = outs
+        for arm in ("tiered", "recompute"):      # correctness gate
+            for a, b in zip(trial_outs["hbm"], trial_outs[arm]):
+                if not onp.array_equal(a, b):
+                    raise AssertionError(
+                        f"{arm} arm diverged from hbm — the TTFT "
+                        f"numbers would be comparing different work")
+    med_hbm = statistics.median(arms["hbm"])
+    med_tier = statistics.median(arms["tiered"])
+    med_rec = statistics.median(arms["recompute"])
+    base = {"n_families": n_families, "shared_prefix": shared_len,
+            "tail": tail_len, "max_new_tokens": max_new,
+            "page_size": page_size, "device_pool_pages": starved_pages,
+            "working_set_x_pool": working_x}
+    yield _record(
+        "serving_tiered_ttft_hbm", arms["hbm"], "ms", None,
+        dict(base, num_pages=hbm_pages,
+             prefix=last["hbm"]["prefix_cache"]))
+    yield _record(
+        "serving_tiered_ttft_recompute", arms["recompute"], "ms",
+        round(med_hbm / med_rec, 4),
+        dict(base, prefix=last["recompute"]["prefix_cache"]))
+    yield _record(
+        "serving_tiered_ttft_tiered", arms["tiered"], "ms",
+        round(med_hbm / med_tier, 4),
+        dict(base, vs_hbm_x=round(med_tier / med_hbm, 4),
+             vs_recompute_x=round(med_tier / med_rec, 4),
+             tier=last["tiered"]["tier"],
+             prefix=last["tiered"]["prefix_cache"]))
+
+
 def _build_spec_net(on_tpu: bool):
     """A net whose early-exit drafter TRACKS the full model — the
     regime speculation targets.  A trained LM's residual stream is
@@ -1226,7 +1372,7 @@ def main():
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
                              "paged", "speculative", "sharded", "disagg",
-                             "elastic"),
+                             "elastic", "tiered"),
                     default="decode")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="device count for --workload sharded "
@@ -1267,6 +1413,8 @@ def main():
         recs = bench_disagg(trials=args.trials)
     elif args.workload == "elastic":
         recs = bench_elastic(trials=args.trials)
+    elif args.workload == "tiered":
+        recs = bench_tiered(trials=args.trials)
     else:
         recs = bench_serving_decode(args.concurrency, args.max_new_tokens,
                                     args.trials)

@@ -191,6 +191,21 @@ register_site("serving.migrate_in",
               "any slot/page claim — a refused bundle leaves the "
               "decode pool pristine and the prefill side degrades to "
               "colocated fallback)")
+register_site("serving.tier_demote",
+              "tiered prefix cache device→host spill, on the tier "
+              "worker BEFORE the host copy (a failed demotion just "
+              "drops the bundle — the entry evicts exactly as without "
+              "the tier, nothing is lost)")
+register_site("serving.tier_promote",
+              "tiered prefix cache host→device promotion, on the tier "
+              "worker BEFORE the digest verify and upload (a failed "
+              "promotion degrades to a counted tier miss — the request "
+              "recomputes its prefill, tokens stay correct)")
+register_site("serving.tier_rot",
+              "poison: post-seal byte flips in a demoted KV bundle "
+              "(host-RAM bit rot; verify-on-promote rejects the bundle "
+              "— a rotted spill degrades to a counted miss, never a "
+              "poisoned slot)")
 # overload control (docs/overload.md) — degrades, never fails a request
 register_site("overload.admission", "priority/deadline admission gate")
 register_site("overload.preempt", "slot-preemption attempt")

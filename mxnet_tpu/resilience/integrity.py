@@ -51,7 +51,8 @@ from ..base import MXNetError
 
 __all__ = ["CheckpointCorruptError", "LatencyTracker", "MANIFEST_FILE",
            "MANIFEST_SCHEMA_VERSION", "TreeHasher", "file_digest",
-           "flip_bytes", "verify_step_dir", "write_manifest"]
+           "flip_array_bytes", "flip_bytes", "verify_step_dir",
+           "write_manifest"]
 
 MANIFEST_FILE = "MANIFEST.json"
 #: bump when the manifest layout changes; a manifest from a NEWER
@@ -296,6 +297,22 @@ def flip_bytes(path: str, count: int = 1, offset: Optional[int] = None):
         f.write(bytes(b ^ 0xFF for b in data))
         f.flush()
         os.fsync(f.fileno())
+
+
+def flip_array_bytes(arr, count: int = 1, offset: Optional[int] = None):
+    """In-memory counterpart of :func:`flip_bytes`: XOR ``count`` bytes
+    of a writable numpy array's buffer with 0xFF, mid-buffer by default
+    — the ``serving.tier_rot`` fault site's model of host-RAM rot in a
+    demoted KV bundle.  Mutates ``arr`` in place; no-op on an empty
+    array."""
+    import numpy as onp
+    flat = arr.view(onp.uint8).reshape(-1)
+    size = flat.shape[0]
+    if size == 0:
+        return
+    off = size // 2 if offset is None else min(int(offset), size - 1)
+    count = max(1, min(int(count), size - off))
+    flat[off:off + count] ^= 0xFF
 
 
 # one warning per process, not per restore: a long fallback chain of

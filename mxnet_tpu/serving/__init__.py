@@ -43,6 +43,7 @@ from .kv_pages import PagedPrefixCache, PagedPrefixEntry, PagePool
 from .migration import (MIGRATION_SCHEMA_VERSION, MigrationBundle,
                         bundle_digest, export_bundle, verify_bundle)
 from .kv_slots import SlotAllocator, SlotState
+from .kv_tiers import HostKVTier, TierHandle
 from .metrics import LatencyHistogram, ServingMetrics
 from .overload import (PRIORITIES, CircuitBreaker, OverloadController,
                        RetryBudget, priority_name, priority_ordinal)
@@ -54,6 +55,7 @@ __all__ = [
     "BucketLattice", "DynamicBatcher",
     "SlotAllocator", "SlotState",
     "PagePool", "PagedPrefixCache", "PagedPrefixEntry",
+    "HostKVTier", "TierHandle",
     "PrefixCache", "PrefixEntry",
     "LatencyHistogram", "ServingMetrics",
     "sample_tokens", "request_key",

@@ -159,6 +159,7 @@ from .errors import (DeadlineInfeasibleError, EngineCrashedError,
                      ServingError)
 from .kv_pages import PagedPrefixCache, PagePool
 from .kv_slots import SlotAllocator, SlotState
+from .kv_tiers import HostKVTier
 from .metrics import ServingMetrics
 from .overload import (OverloadController, PRIORITY_BATCH,
                        PRIORITY_BEST_EFFORT, PRIORITY_INTERACTIVE,
@@ -471,6 +472,9 @@ class InferenceEngine:
                  kv_layout: str = "dense",
                  page_size: int = 16,
                  num_pages: Optional[int] = None,
+                 host_pool_bytes: int = 0,
+                 tier_fault_limit: int = 3,
+                 disk_tier_dir: Optional[str] = None,
                  spec_tokens: int = 0,
                  draft_layers: int = 1,
                  mesh=None,
@@ -586,7 +590,8 @@ class InferenceEngine:
                 # is always on; prefix_pool_rows is a dense-only knob
                 self.prefix_pool_rows = 0
                 self._prefix = PagedPrefixCache(
-                    self._pool, min_tokens=self.prefix_min_tokens)
+                    self._pool, min_tokens=self.prefix_min_tokens,
+                    demote_hook=self._tier_demote)
             else:
                 self.page_size = None
                 self.num_pages = 0
@@ -643,6 +648,33 @@ class InferenceEngine:
                                    "speculate)")
             self.spec_tokens = 0
             self.draft_layers = int(draft_layers)
+        # tiered prefix cache (docs/serving.md "Tiered prefix cache"):
+        # a bounded host-RAM spill pool behind the PAGED prefix cache —
+        # evicted-at-zero-readers entries demote device→host instead of
+        # vanishing, and a later radix hit promotes them back.  Other
+        # layouts accept the knob but stay inert: demotion only exists
+        # where eviction frees pages.
+        self.host_pool_bytes = int(host_pool_bytes)
+        if self.host_pool_bytes < 0:
+            raise ServingError(f"host_pool_bytes must be >= 0, got "
+                               f"{host_pool_bytes}")
+        self.tier_fault_limit = int(tier_fault_limit)
+        self.disk_tier_dir = disk_tier_dir
+        self._tier = None
+        self._tier_pending: dict = {}  # PrefixEntry -> in-flight TierHandle
+        self._tier_timeout = 5.0       # s a slot waits on one promotion
+        self._tier_gather_fn = None    # fused demote gather (lazy jit)
+        self._tier_scatter_fn = None   # fused promote install (lazy jit)
+        self._tier_parked = 0          # slots waiting on a promotion
+        if self.host_pool_bytes and self._paged:
+            # started below, once the scheduler condition exists — the
+            # resolve hook pokes it, and the hook must be in place
+            # before the worker thread can resolve anything
+            self._tier = HostKVTier(
+                self.host_pool_bytes, page_size=self.page_size,
+                fault_limit=self.tier_fault_limit,
+                disk_dir=self.disk_tier_dir, scope=self.name,
+                metrics=self.metrics)
         # sharded decode (docs/serving.md "Sharded decode") — resolved
         # AFTER the layout knobs above: validation reads num_slots /
         # prefix_pool_rows / kv_layout
@@ -673,6 +705,10 @@ class InferenceEngine:
         self._cond = _named_condition(
             "serving.engine.cond", "admission queue + scheduler wakeups")
         self._batcher = DynamicBatcher(queue_depth, cond=self._cond)
+        if self._tier is not None:
+            # wake a parked scheduler the moment a promotion resolves
+            self._tier.on_resolve = self._tier_wake
+            self._tier.start()
         self._step_lock = _named_lock(
             "serving.engine.step", "in-flight state vs stop()/watchdog")
         self._stop_lock = _named_lock(
@@ -883,6 +919,21 @@ class InferenceEngine:
                        "duplicated row under the dense layout)",
                   fn=bound(lambda e: e._pool.shared_count
                            if e._pool is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_tier_host_bytes",
+                  help="host-RAM bytes held by the tiered prefix "
+                       "cache's demoted KV bundles (0 = tier off)",
+                  fn=bound(lambda e: e._tier.used_bytes
+                           if e._tier is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_tier_entries",
+                  help="demoted KV bundles resident in the host (and "
+                       "disk) tier",
+                  fn=bound(lambda e: len(e._tier)
+                           if e._tier is not None else 0), **lbl)
+        reg.gauge("mxtpu_serving_tier_disabled",
+                  help="1 once the tier self-disabled after its fault "
+                       "limit (the engine serves from HBM only)",
+                  fn=bound(lambda e: 1 if e._tier is not None
+                           and not e._tier.enabled else 0), **lbl)
         reg.gauge("mxtpu_serving_mesh_devices",
                   help="devices the engine's compiled programs span "
                        "(GSPMD sharded decode; 1 = unsharded "
@@ -1321,6 +1372,10 @@ class InferenceEngine:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self._tier is not None:
+            # after the scheduler is down: queued promotions fail (no
+            # slot is waiting anymore), queued demotions drop
+            self._tier.stop()
         # sweep: whatever survived the drain must resolve, never drop
         exc = self._crashed or EngineStoppedError(
             "engine stopped — request was never scheduled")
@@ -2384,6 +2439,11 @@ class InferenceEngine:
         # overlay the live controller state on the metrics' per-class
         # shed/served accounting (docs/overload.md)
         s["overload"]["controller"] = self._overload.snapshot()
+        # overlay the tier store's live state on the metrics' counters
+        # (docs/serving.md "Tiered prefix cache")
+        if self._tier is not None:
+            s["tier"]["store"] = self._tier.snapshot()
+            s["tier"]["enabled"] = self._tier.enabled
         return s
 
     # --------------------------------------------------------------- scheduler
@@ -2406,6 +2466,16 @@ class InferenceEngine:
                     self._overload_tick(time.monotonic())
                     self._cond.wait(0.05)
                     continue
+                parked = self._tier_parked  # raceguard: unguarded(advisory: a stale count only costs one 2ms tick)
+                if (self._batcher.empty() and not idle and parked
+                        and parked >= self._alloc.active_count):
+                    # EVERY live slot is waiting on an async tier
+                    # promotion: spinning here would hold the GIL in a
+                    # pure-Python loop and starve the tier worker of
+                    # the very upload the slots wait for.  Park on the
+                    # condition — the tier's resolve hook notifies —
+                    # then fall through and run the cycle either way.
+                    self._cond.wait(0.002)
             try:
                 with self._step_lock:
                     self._cycle_busy = True
@@ -2503,6 +2573,12 @@ class InferenceEngine:
             # forget its mappings or a later hit would copy ZEROED K/V
             # into a slot and silently serve wrong tokens.
             self._caches = None
+            # in-flight promotions target the dead buffers; waiters were
+            # already degraded by _release above, so just forget the
+            # handle map (the tier's own store survives — its bundles
+            # are host-side and still valid for the rebuilt caches)
+            self._tier_pending.clear()
+            self._tier_parked = 0
             if self._prefix is not None:
                 self._prefix.reset()
             if self._paged:
@@ -2571,6 +2647,7 @@ class InferenceEngine:
         Pages still referenced (shared prefix pages, parked entries)
         survive untouched."""
         st = self._alloc.free(slot)
+        self._tier_cancel(st)
         if st.pinned is not None:
             if self._prefix is not None:
                 self._prefix.unpin(st.pinned)
@@ -2818,6 +2895,14 @@ class InferenceEngine:
         if match < self.prefix_min_tokens:
             self.metrics.count("prefix_misses")
             return
+        if self._paged and entry.tier == 2:
+            # a host-tier claim (docs/serving.md "Tiered prefix cache"):
+            # its K/V must ride an async host→device promotion before
+            # any page can be shared — park the slot on a tier handle;
+            # _tier_poll re-runs this admission once the upload lands
+            if not self._tier_request(st, entry):
+                self.metrics.count("prefix_misses")
+            return
         if self._paged:
             self._prefix_admit_paged(st, slot, entry, match)
             return
@@ -2921,6 +3006,175 @@ class InferenceEngine:
         st.filled = filled
         self.metrics.count("prefix_hits")
         self.metrics.count("prefix_tokens_saved", filled)
+
+    # ---------------------------------------------------- tiered prefix
+    def _tier_demote(self, entry) -> bool:  # guarded-by: _step_lock
+        """Demotion gate for :class:`PagedPrefixCache.evict_pages` —
+        called at the moment an LRU sweep picked ``entry`` as a
+        zero-reader victim.  True downgrades the entry to a tier-2
+        claim (its pages still free); False evicts it outright, exactly
+        as without the tier.  The device→host copy itself runs on the
+        tier worker, OFF this scheduler thread — here we only gather
+        the victim's pages into per-layer bundles and hand them over.
+        Pages the pool marked dirty (a non-finite victim wrote them
+        while a reader pinned them alive) are refused outright: a
+        NaN-taintable page must never round-trip through host RAM."""
+        tier = self._tier
+        if tier is None or not tier.enabled or self._caches is None:
+            return False
+        if entry.length < self.prefix_min_tokens or not entry.pages:
+            return False
+        if any(pid in self._pool.dirty for pid in entry.pages):
+            self.metrics.count("tier_drops")
+            return False
+        try:
+            import jax
+            import jax.numpy as jnp
+            tokens = tuple(self._entry_tokens(entry))
+            pids = jnp.asarray(onp.asarray(entry.pages, "int32"))
+            if self._tier_gather_fn is None:
+                # ONE fused dispatch for the whole layer stack — the
+                # per-leaf loop costs a device round-trip per K/V leaf
+                # on the scheduler thread, which is the hot path the
+                # tier exists to keep clear
+                self._tier_gather_fn = jax.jit(
+                    lambda leaves, p: [leaf[p] for leaf in leaves])
+            arrays = self._tier_gather_fn(
+                jax.tree_util.tree_leaves(self._caches), pids)
+        except Exception:
+            self.metrics.count("tier_drops")
+            return False
+        return tier.offer(tokens, arrays, entry.length)
+
+    def _tier_request(self, st: SlotState, entry) -> bool:  # guarded-by: _step_lock
+        """Ask the tier to promote ``entry``'s bundle and park the slot
+        on the resulting handle.  False means the claim was stale (the
+        tier lost the bundle — rot, LRU pressure, self-disable): the
+        dead claim is pruned and the caller treats it as a miss."""
+        tier = self._tier
+        if tier is None:
+            self._tier_prune(entry)
+            return False
+        handle = self._tier_pending.get(entry)
+        if handle is None:
+            handle = tier.request(tuple(self._entry_tokens(entry)))
+            if handle is None:
+                self._tier_prune(entry)
+                return False
+            self._tier_pending[entry] = handle
+        self._prefix.pin(entry)      # the claim must survive the wait
+        if st.tier_promo is None:
+            self._tier_parked += 1
+        st.tier_promo = (entry, handle, time.monotonic())
+        return True
+
+    def _tier_wake(self):
+        """Tier worker resolve hook: poke the scheduler's condition so
+        a loop parked in the all-slots-waiting-on-promotion state picks
+        the result up immediately instead of a poll tick later."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def _tier_prune(self, entry):  # guarded-by: _step_lock
+        """Drop a tier-2 claim whose bundle is gone — the radix tree
+        must never keep promising K/V nobody can produce.  Only an
+        unreferenced claim is removed: concurrent waiters on the same
+        entry resolve their own handles first."""
+        self._tier_pending.pop(entry, None)
+        if entry.tier == 2 and entry.refs == 0 and not entry.pages:
+            self._prefix.remove(entry)
+
+    def _tier_poll(self, st: SlotState, slot: int) -> bool:  # guarded-by: _step_lock
+        """Resolve one slot's pending promotion.  True while the async
+        upload is still in flight (the slot sits this prefill cycle
+        out); False once resolved either way — on success the entry is
+        tier-1 again and admission re-runs to share its pages, on
+        failure/timeout the slot degrades to a full recompute."""
+        entry, handle, t0 = st.tier_promo
+        tier = self._tier
+        status, arrays = tier.poll(handle)
+        if status == "pending":
+            if time.monotonic() - t0 <= self._tier_timeout:
+                return True
+            tier.abandon(handle)     # counted as a tier miss
+            status = "failed"
+        st.tier_promo = None
+        self._tier_parked = max(0, self._tier_parked - 1)
+        self._prefix.unpin(entry)
+        self._tier_pending.pop(entry, None)
+        ok = False
+        if status == "ready":
+            if entry.tier == 2:
+                ok = self._tier_install(entry, handle, arrays)
+                if not ok:
+                    self.metrics.count("tier_misses")
+            else:
+                ok = entry.tier == 1   # a sibling waiter already installed
+        if ok:
+            self._prefix_admit(st, slot)
+            return False
+        self.metrics.count("prefix_misses")
+        if not tier.contains(handle.key):
+            self._tier_prune(entry)
+        return False
+
+    def _tier_install(self, entry, handle, arrays) -> bool:  # guarded-by: _step_lock
+        """Eager-install a verified bundle's pages and re-back the
+        tier-2 claim (the seed_prefix cache-surgery idiom: claim pages,
+        ``.at[pids].set``, re-place — zero compile-cache entries, the
+        post-warmup freeze holds).  The entry takes its own refcounts
+        via :meth:`PagedPrefixCache.upgrade`; the allocation claims are
+        dropped either way."""
+        import jax
+        import jax.numpy as jnp
+        length = int(handle.length)
+        need = self._pool.pages_for(length)
+        self._ensure_caches()
+        flat, treedef = jax.tree_util.tree_flatten(self._caches)
+        if (not arrays or len(arrays) != len(flat)
+                or int(arrays[0].shape[0]) != need
+                or length != entry.length):
+            return False
+        pages = self._claim_pages(need)
+        if not pages:
+            self.metrics.count("page_faults")
+            return False
+        pids = jnp.asarray(onp.asarray(pages, "int32"))
+        if self._tier_scatter_fn is None:
+            # ONE fused dispatch installs every leaf — and because the
+            # promoted bundle arrives as HOST arrays, the H2D transfer
+            # rides the same call instead of one upload per leaf
+            self._tier_scatter_fn = jax.jit(
+                lambda leaves, p, arrs: [
+                    leaf.at[p].set(arr)
+                    for leaf, arr in zip(leaves, arrs)])
+        new = self._tier_scatter_fn(flat, pids, arrays)
+        self._prefix.upgrade(entry, pages, length)
+        try:
+            self._caches = self._place_caches(
+                jax.tree_util.tree_unflatten(treedef, new))
+        except BaseException:
+            # the mapping must never outlive a failed install
+            self._prefix.remove(entry)
+            for pid in pages:
+                self._pool.unref(pid)
+            raise
+        for pid in pages:            # handoff: entry's refs keep them
+            self._pool.unref(pid)
+        return True
+
+    def _tier_cancel(self, st: SlotState):  # guarded-by: _step_lock
+        """Release a slot's promotion wait (request done/failed/preempted
+        mid-wait).  The in-flight upload itself is left to finish — a
+        sibling waiter, or the next radix hit, still wants it."""
+        if st.tier_promo is None:
+            return
+        entry, handle, _t0 = st.tier_promo
+        st.tier_promo = None
+        self._tier_parked = max(0, self._tier_parked - 1)
+        if self._prefix is not None:
+            self._prefix.unpin(entry)
+        self._tier_pending.pop(entry, None)
 
     def _prefix_insert(self, st: SlotState, slot: int):
         """After a request's prefill completes, cache its full prompt:
@@ -3262,6 +3516,8 @@ class InferenceEngine:
         for slot, st in self._alloc.items():
             if slot not in self._alloc or not st.prefilling:
                 continue               # a victim parked by an earlier
+            if st.tier_promo is not None and self._tier_poll(st, slot):
+                continue           # awaiting an async tier promotion
             if self._paged:            # _ensure_pages in this loop
                 # the pages a chunk will write must exist BEFORE the
                 # compiled call; a slot that cannot get them parks

@@ -187,13 +187,21 @@ class PagedPrefixCache(PrefixCache):
     needs its pages more.  ``pin``/``unpin`` (inherited) still guard a
     hitting slot's tail-copy source while its prefill is in flight."""
 
-    def __init__(self, pool: PagePool, min_tokens: int = 1):
+    def __init__(self, pool: PagePool, min_tokens: int = 1,
+                 demote_hook: Optional[Callable] = None):
         # no reserved-row segment to carve up: init only the shared
         # radix-tree/LRU state (super().__init__ requires rows)
         self.pool = pool
         self.pool_rows = 0
         self.row_base = -1
         self._free: List[int] = []
+        # tiered prefix cache (docs/serving.md "Tiered prefix cache"):
+        # called with each zero-reader eviction victim BEFORE its pages
+        # are released; True downgrades the entry to a page-less tier-2
+        # claim (it stays in the tree and can be promoted back) instead
+        # of detaching it.  The hook must not block — it snapshots and
+        # enqueues, the spill itself runs off-thread.
+        self.demote_hook = demote_hook
         self._init_tree(min_tokens)
 
     @property
@@ -231,6 +239,13 @@ class PagedPrefixCache(PrefixCache):
             return None
         node = self._insert_node(tokens)
         if node.entry is not None:
+            # a donor recomputed a family the tier holds only as a
+            # claim: re-back the claim with the donor's live pages —
+            # cheaper AND fresher than a host promotion would be
+            if node.entry.tier == 2:
+                return self.upgrade(node.entry, pages,
+                                    len(tokens) if length is None
+                                    else int(length))
             self._touch(node.entry)
             return None
         entry = PagedPrefixEntry(
@@ -242,22 +257,48 @@ class PagedPrefixCache(PrefixCache):
         self._touch(entry)
         return entry
 
+    def upgrade(self, entry, pages, length: Optional[int] = None):
+        """Re-back a tier-2 claim with device pages (the promotion
+        install, or a donor slot recomputing the same family): the
+        entry takes its OWN refcount on every page — exactly the
+        :meth:`insert` handoff — and returns to tier 1."""
+        if entry.tier != 2 or entry.pages:
+            raise ServingError(f"upgrade of non-tier-2 {entry!r}")
+        entry.pages = tuple(pages)
+        for pid in entry.pages:
+            self.pool.ref(pid)
+        entry.tier = 1
+        if length is not None:
+            entry.length = int(length)
+        self._touch(entry)
+        return entry
+
     # ------------------------------------------------------------ eviction
     def evict_pages(self, k: int) -> int:
         """Free >= ``k`` pages by evicting zero-reader entries in LRU
         order; returns the number actually freed (an entry whose pages
         are still shared with live slots frees fewer than it holds —
         SHARED PAGES ARE NEVER FREED WHILE REFERENCED, only the
-        entry's own claim drops)."""
+        entry's own claim drops).  With a ``demote_hook`` installed,
+        each victim it accepts DOWNGRADES to a page-less tier-2 claim
+        (same pages freed — the claim costs the pool nothing) instead
+        of leaving the tree; the hook runs BEFORE the release while the
+        victim's pages still hold valid K/V to snapshot."""
         freed = 0
         while freed < k:
             victim = self._lru_victim()
             if victim is None:
                 break
+            demote = (self.demote_hook is not None
+                      and self.demote_hook(victim))
             for pid in victim.pages:
                 if self.pool.unref(pid):
                     freed += 1
-            self._detach(victim)
+            if demote:
+                victim.pages = ()
+                victim.tier = 2
+            else:
+                self._detach(victim)
             self.evictions += 1
         return freed
 

@@ -40,7 +40,7 @@ class SlotState:
 
     __slots__ = ("request", "prompt_len", "pos", "last_token", "generated",
                  "max_new_tokens", "tokens", "filled", "pinned", "t_first",
-                 "pages", "pages_shared", "waiting")
+                 "pages", "pages_shared", "waiting", "tier_promo")
 
     def __init__(self, request, prompt_len: int, max_new_tokens: int,
                  tokens=None):
@@ -68,6 +68,11 @@ class SlotState:
         self.pages: List[int] = []
         self.pages_shared = 0
         self.waiting = False
+        # tiered prefix cache (docs/serving.md "Tiered prefix cache"):
+        # (entry, handle, t0) while this slot waits on an async
+        # host→device promotion of a tier-2 claim — the slot sits out
+        # prefill until the upload resolves (or times out → recompute)
+        self.tier_promo = None
 
     @property
     def done(self) -> bool:

@@ -178,7 +178,21 @@ class ServingMetrics:
                  # to colocated fallback — the prefill engine finishes
                  # the request itself, nothing is lost)
                  "migrations_out", "migrations_in", "migrated_pages",
-                 "migrate_faults")
+                 "migrate_faults",
+                 # tiered prefix cache (docs/serving.md "Tiered prefix
+                 # cache"): bundles demoted device→host / promoted
+                 # host→device, radix hits against tier-2 claims,
+                 # promotion misses (stale claim, verify failure, fault,
+                 # timeout — each degrades to recompute), seals that
+                 # failed verify-on-promote (rot caught BEFORE any
+                 # device byte moved), host-pool LRU evictions,
+                 # contained serving.tier_* faults, demotions dropped
+                 # (queue full / oversized / non-finite), and the
+                 # optional disk tier's spills / loads / quarantines
+                 "tier_demotes", "tier_promotes", "tier_hits",
+                 "tier_misses", "tier_verify_failures", "tier_evictions",
+                 "tier_faults", "tier_drops", "tier_disk_spills",
+                 "tier_disk_loads", "tier_quarantines")
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
@@ -424,6 +438,15 @@ class ServingMetrics:
                        in sorted(migrations_by.items())},
                 "latency": migration_lat,
             },
+            # tiered prefix cache (docs/serving.md "Tiered prefix
+            # cache"); the engine overlays its live store snapshot
+            # under stats()["tier"]["store"]
+            "tier": {k: c[k] for k in
+                     ("tier_demotes", "tier_promotes", "tier_hits",
+                      "tier_misses", "tier_verify_failures",
+                      "tier_evictions", "tier_faults", "tier_drops",
+                      "tier_disk_spills", "tier_disk_loads",
+                      "tier_quarantines")},
             # per-class accounting of graceful degradation
             # (docs/overload.md); the engine overlays its controller
             # snapshot under stats()["overload"]["controller"]

@@ -244,9 +244,12 @@ def seed_digest(s: PrefixSeed) -> str:
     sequence, and every array's contiguous bytes, in leaf order."""
     h = TreeHasher()
     h.update(_seed_header_bytes(s))
-    h.update(onp.ascontiguousarray(s.tokens).tobytes())
+    # zero-copy buffer views: tobytes() would duplicate every leaf on
+    # the hash path, and seal/verify sit on the tier's demote and
+    # promote critical paths
+    h.update(memoryview(onp.ascontiguousarray(s.tokens)).cast("B"))
     for a in s.arrays:
-        h.update(onp.ascontiguousarray(a).tobytes())
+        h.update(memoryview(onp.ascontiguousarray(a)).cast("B"))
     return h.hexdigest()
 
 

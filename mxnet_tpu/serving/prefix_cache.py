@@ -53,9 +53,16 @@ class _Node:
 
 class PrefixEntry:
     """One cached prefix: pool row ``row`` holds K/V for positions
-    ``[0, length)`` of the sequence spelled by the tree path."""
+    ``[0, length)`` of the sequence spelled by the tree path.
 
-    __slots__ = ("row", "length", "refs", "last_used", "node")
+    ``tier`` is which storage backs the K/V: 1 = device (a pool row
+    here; pool pages for the paged subclass), 2 = a page-less CLAIM on
+    the host spill tier (docs/serving.md "Tiered prefix cache") — the
+    entry keeps its place in the radix tree so lookups still match, but
+    serving it requires an async promotion first.  Dense entries are
+    always tier 1."""
+
+    __slots__ = ("row", "length", "refs", "last_used", "node", "tier")
 
     def __init__(self, row: int, length: int, node: _Node):
         self.row = row
@@ -63,6 +70,7 @@ class PrefixEntry:
         self.refs = 0           # in-flight readers (engine pin/unpin)
         self.last_used = 0      # LRU tick, monotone per cache
         self.node = node
+        self.tier = 1
 
     def __repr__(self):
         return (f"PrefixEntry(row={self.row}, len={self.length}, "
@@ -217,13 +225,15 @@ class PrefixCache:
         return node
 
     def _lru_victim(self) -> Optional[PrefixEntry]:
-        """Least-recently-used ZERO-reader entry (pinned entries are
-        never victims), or ``None`` — the one eviction policy shared
-        by the dense row allocator and the paged reclaim sweep."""
+        """Least-recently-used ZERO-reader TIER-1 entry (pinned entries
+        are never victims; tier-2 claims hold no device memory, so
+        evicting one frees nothing), or ``None`` — the one eviction
+        policy shared by the dense row allocator and the paged reclaim
+        sweep."""
         victim = None
         for e in self._entries:
-            if e.refs == 0 and (victim is None
-                                or e.last_used < victim.last_used):
+            if e.refs == 0 and e.tier == 1 and \
+                    (victim is None or e.last_used < victim.last_used):
                 victim = e
         return victim
 

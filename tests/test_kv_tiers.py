@@ -1,0 +1,452 @@
+"""Tiered KV prefix cache (docs/serving.md "Tiered prefix cache").
+
+Contracts under test: demotion spills a zero-reader eviction victim's
+pages device→host as an integrity-sealed bundle and downgrades the
+radix entry to a tier-2 claim; a later hit promotes host→device and
+tokens are IDENTICAL to the tier-off engine across layouts, sampling,
+and speculation; a rotted bundle (post-seal byte flips) fails
+verify-on-promote and degrades to a counted miss — it NEVER reaches a
+device slot; NaN-taintable pages are refused before demotion; the host
+pool is byte-bounded with LRU eviction (optionally spilling to disk
+with quarantine-on-corruption); repeated demote/promote faults
+self-disable the tier while the engine keeps serving from HBM; and the
+post-warmup compile freeze survives the whole tier lifecycle (the
+promotion install is eager cache surgery, never a new program).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.resilience.faults import FaultPlan
+from mxnet_tpu.serving import (HostKVTier, InferenceEngine,
+                               PagedPrefixCache, PagePool, ServingError)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _refs(net, prompts, max_new):
+    return [net.generate(mx.nd.array(p[None], dtype="int32"), max_new,
+                         temperature=0).asnumpy()[0] for p in prompts]
+
+
+def _arrays(n_pages=2, ps=4, seed=0):
+    rs = onp.random.RandomState(seed)
+    return [rs.rand(n_pages, ps, 2, 3).astype("float32") for _ in range(4)]
+
+
+def _tier(scope, pool_bytes=1 << 20, **kw):
+    kw.setdefault("page_size", 4)
+    return HostKVTier(pool_bytes, scope=scope, **kw).start()
+
+
+def _family(seed=3, shared=24, tails=3, tail=4):
+    """Prompts sharing a long warm prefix (the tier's unit of reuse)."""
+    rs = onp.random.RandomState(seed)
+    fam = rs.randint(0, 97, (shared,)).astype("int32")
+    return [onp.concatenate([fam,
+                             rs.randint(0, 97, (tail,)).astype("int32")])
+            for _ in range(tails)]
+
+
+def _fillers(n, length=16, seed=200):
+    return [onp.random.RandomState(seed + i)
+            .randint(0, 97, (length,)).astype("int32") for i in range(n)]
+
+
+# ------------------------------------------------------ HostKVTier unit
+
+def test_tier_roundtrip_valid_region_parity_and_tail_scrub():
+    t = _tier("u0")
+    try:
+        key, arrs = tuple(range(7)), _arrays(seed=1)
+        assert t.offer(key, arrs, 7)
+        t.drain()
+        assert t.contains(key) and len(t) == 1
+        h = t.request(key)
+        t.drain()
+        status, out = t.poll(h)
+        assert status == "ready"
+        for a, b in zip(arrs, out):
+            b = onp.asarray(b)
+            # positions [0, 7) match exactly; the tail page's positions
+            # past length were scrubbed to zero at demote time (they are
+            # never attended, and host RAM must not round-trip garbage)
+            onp.testing.assert_array_equal(a[0], b[0])
+            onp.testing.assert_array_equal(a[1, :3], b[1, :3])
+            assert onp.all(b[1, 3:] == 0)
+        assert t.counter("tier_demotes") == 1
+        assert t.counter("tier_promotes") == 1
+        assert t.counter("tier_verify_failures") == 0
+    finally:
+        t.stop()
+
+
+def test_tier_refuses_nonfinite_bundle():
+    t = _tier("u1")
+    try:
+        arrs = _arrays(seed=2)
+        arrs[1][0, 1, 0, 0] = onp.nan
+        assert t.offer((1, 2, 3, 4, 5), arrs, 5)   # accepted at enqueue
+        t.drain()
+        # ... but the worker refused the poisoned bundle: nothing stored
+        assert not t.contains((1, 2, 3, 4, 5)) and len(t) == 0
+        assert t.counter("tier_drops") == 1
+        assert t.counter("tier_faults") == 0       # hygiene, not a fault
+    finally:
+        t.stop()
+
+
+def test_tier_host_pool_lru_bounded():
+    t = None
+    probe = _arrays(seed=3)
+    per = sum(a.nbytes for a in probe)
+    t = _tier("u2", pool_bytes=int(per * 2.5))     # room for 2 bundles
+    try:
+        for i in range(4):
+            assert t.offer((100 + i,) * 5, _arrays(seed=10 + i), 7)
+            t.drain()
+        assert len(t) == 2 and t.used_bytes <= int(per * 2.5)
+        # LRU: the two OLDEST spilled out
+        assert not t.contains((100,) * 5) and not t.contains((101,) * 5)
+        assert t.contains((102,) * 5) and t.contains((103,) * 5)
+        assert t.counter("tier_evictions") == 2
+        # a request for an evicted key is a counted miss, not an error
+        assert t.request((100,) * 5) is None
+        assert t.counter("tier_misses") == 1
+    finally:
+        t.stop()
+
+
+def test_tier_rot_fails_verify_and_degrades_to_miss():
+    plan = FaultPlan()
+    plan.corrupt_at("serving.tier_rot", at=1)
+    with plan:
+        t = _tier("u3")
+        try:
+            key, arrs = tuple(range(8)), _arrays(seed=4)
+            assert t.offer(key, arrs, 8)
+            t.drain()
+            h = t.request(key)
+            t.drain()
+            status, out = t.poll(h)
+            # the flipped bundle NEVER comes back: verify-on-promote
+            # rejects it and the tier forgets the key
+            assert status == "failed" and out is None
+            assert t.counter("tier_verify_failures") == 1
+            assert t.counter("tier_misses") == 1
+            assert not t.contains(key)
+        finally:
+            t.stop()
+
+
+def test_tier_demote_faults_self_disable():
+    plan = FaultPlan()
+    plan.raise_at("serving.tier_demote", every=1)
+    with plan:
+        t = _tier("u4", fault_limit=3)
+        try:
+            for i in range(5):
+                t.offer((i,) * 5, _arrays(seed=i), 7)
+                t.drain()
+            assert not t.enabled
+            assert t.counter("tier_faults") == 3   # streak stops at limit
+            assert len(t) == 0
+            # disabled tier refuses new work outright (counted drops)
+            assert t.offer((99,) * 5, _arrays(seed=9), 7) is False
+            assert t.request((0,) * 5) is None
+        finally:
+            t.stop()
+
+
+def test_tier_promote_fault_contained_and_clean_op_resets_streak():
+    plan = FaultPlan()
+    plan.raise_at("serving.tier_promote", at=1)
+    with plan:
+        t = _tier("u5", fault_limit=3)
+        try:
+            key = tuple(range(6))
+            assert t.offer(key, _arrays(seed=5), 6)
+            t.drain()
+            h = t.request(key)
+            t.drain()
+            status, out = t.poll(h)
+            assert status == "failed" and out is None
+            assert t.enabled and t.counter("tier_faults") == 1
+            # the bundle survived the transient fault; a retry promotes
+            # cleanly and the clean op resets the streak
+            h2 = t.request(key)
+            t.drain()
+            status2, out2 = t.poll(h2)
+            assert status2 == "ready" and out2 is not None
+            assert t.snapshot()["fault_streak"] == 0
+        finally:
+            t.stop()
+
+
+def test_tier_disk_spill_load_and_quarantine(tmp_path):
+    probe = _arrays(seed=6)
+    per = sum(a.nbytes for a in probe)
+    t = HostKVTier(int(per * 1.5), page_size=4, scope="u6",
+                   disk_dir=str(tmp_path)).start()
+    try:
+        for i in range(3):
+            assert t.offer((50 + i,) * 5, _arrays(seed=20 + i), 7)
+            t.drain()
+        s = t.snapshot()
+        assert s["entries"] == 1 and s["disk_entries"] == 2
+        assert t.counter("tier_disk_spills") == 2
+        # promotion from disk works
+        h = t.request((50,) * 5)
+        t.drain()
+        status, out = t.poll(h)
+        assert status == "ready" and out is not None
+        assert t.counter("tier_disk_loads") >= 1
+        # a corrupted spill file is QUARANTINED (renamed, never served):
+        # rot every spilled file, then touch every spilled key
+        for p in os.listdir(tmp_path):
+            if not p.startswith("corrupt-"):
+                with open(tmp_path / p, "r+b") as f:
+                    f.seek(30)
+                    f.write(b"\xff" * 8)
+        for j in range(3):
+            hj = t.request((50 + j,) * 5)
+            if hj is not None:
+                t.drain()
+                t.poll(hj)
+        assert t.counter("tier_quarantines") >= 1
+        assert any(p.startswith("corrupt-") for p in os.listdir(tmp_path))
+    finally:
+        t.stop()
+
+
+def test_tier_validates_knobs():
+    with pytest.raises(ServingError):
+        HostKVTier(0, page_size=4)
+    with pytest.raises(ServingError):
+        HostKVTier(1 << 20, page_size=0)
+
+
+# --------------------------------------- PagedPrefixCache tier plumbing
+
+def test_paged_cache_demote_downgrades_and_upgrade_rebacks():
+    pool = PagePool(8, page_size=4)
+    cache = PagedPrefixCache(pool, min_tokens=1)
+    donor = pool.alloc(2)
+    e = cache.insert(tuple(range(8)), donor, 8)
+    pool.release(donor)                      # cache holds the only refs
+    hooked = []
+    cache.demote_hook = lambda victim: (hooked.append(victim), True)[1]
+    freed = cache.evict_pages(2)
+    assert freed == 2 and hooked == [e]
+    # downgraded, not detached: still matchable, holds no pages
+    assert e.tier == 2 and e.pages == () and len(cache) == 1
+    assert cache.lookup(tuple(range(8)))[1] is e
+    # a tier-2 claim is never an LRU victim (it frees nothing)
+    assert cache._lru_victim() is None
+    # upgrade re-backs the claim with fresh pages, cache-owned refs
+    fresh = pool.alloc(2)
+    cache.upgrade(e, fresh, 8)
+    assert e.tier == 1 and e.pages == tuple(fresh)
+    assert all(pool.refs(p) == 2 for p in fresh)
+    pool.release(fresh)
+    with pytest.raises(ServingError):
+        cache.upgrade(e, fresh)              # only tier-2 upgrades
+
+
+def test_paged_cache_insert_over_claim_upgrades_in_place():
+    pool = PagePool(8, page_size=4)
+    cache = PagedPrefixCache(pool, min_tokens=1,
+                             demote_hook=lambda v: True)
+    donor = pool.alloc(2)
+    e = cache.insert(tuple(range(8)), donor, 8)
+    pool.release(donor)
+    cache.evict_pages(2)
+    assert e.tier == 2
+    # a donor recomputed the same family: its insert re-backs the claim
+    fresh = pool.alloc(2)
+    got = cache.insert(tuple(range(8)), fresh, 8)
+    assert got is e and e.tier == 1 and e.pages == tuple(fresh)
+    pool.release(fresh)
+    assert all(pool.refs(p) == 1 for p in fresh)
+
+
+def test_paged_cache_pinned_entry_never_demotes():
+    pool = PagePool(8, page_size=4)
+    calls = []
+    cache = PagedPrefixCache(pool, min_tokens=1,
+                             demote_hook=lambda v: (calls.append(v), True)[1])
+    donor = pool.alloc(2)
+    e = cache.insert(tuple(range(8)), donor, 8)
+    pool.release(donor)
+    cache.pin(e)                             # an in-flight reader
+    assert cache.evict_pages(2) == 0 and calls == []
+    assert e.tier == 1 and len(e.pages) == 2
+    cache.unpin(e)
+    assert cache.evict_pages(2) == 2 and calls == [e]
+
+
+# ----------------------------------------------------------- engine E2E
+
+def _tiered(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("seq_buckets", (8, 16, 32))
+    kw.setdefault("default_max_new_tokens", 4)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefix_min_tokens", 8)
+    kw.setdefault("host_pool_bytes", 64 << 20)
+    return InferenceEngine(net, **kw)
+
+
+def _run_traffic(eng, prompts, kwargs=None):
+    outs = []
+    with eng:
+        for i, p in enumerate(prompts):
+            kw = dict(kwargs[i]) if kwargs else {}
+            outs.append(eng.infer(p, max_new_tokens=4, **kw))
+    return outs
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_tier_on_off_token_parity(net, layout):
+    """The tier knob must be observably invisible to tokens: identical
+    greedy AND seeded-sampled traffic, tier on vs off, both layouts
+    (dense accepts the knob but stays inert)."""
+    fam = _family(seed=13, tails=2)
+    prompts = fam + _fillers(4, seed=300) + [fam[0], fam[1]]
+    kwargs = [{} for _ in prompts]
+    kwargs[1] = dict(temperature=0.8, seed=7)
+    kwargs[-1] = dict(temperature=1.2, top_k=12, seed=11)
+    outs = {}
+    for pool_bytes in (0, 64 << 20):
+        eng = _tiered(net, kv_layout=layout,
+                      num_pages=12 if layout == "paged" else None,
+                      host_pool_bytes=pool_bytes)
+        eng.warmup()
+        outs[pool_bytes] = _run_traffic(eng, prompts, kwargs)
+    for off, on in zip(outs[0], outs[64 << 20]):
+        onp.testing.assert_array_equal(off, on)
+
+
+def test_tier_spec_decode_parity(net):
+    """Speculation's page rewind must compose with the tier: tokens
+    stay greedy-exact through demote/promote with spec_tokens on."""
+    fam = _family(seed=17, tails=2)
+    prompts = fam + _fillers(4, seed=400) + [fam[0]]
+    refs = _refs(net, prompts, 4)
+    eng = _tiered(net, num_pages=17, page_size=4, spec_tokens=3,
+                  draft_layers=1)
+    eng.warmup()
+    outs = _run_traffic(eng, prompts)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+
+
+def test_tier_demote_promote_roundtrip_and_compile_freeze(net):
+    """The headline path: thrash demotes the warm family, the next
+    family hit promotes it back, tokens match the model exactly, and
+    the compile counter never moves after warmup (the install is eager
+    cache surgery)."""
+    fam = _family(seed=3, tails=3)
+    refs = _refs(net, fam, 4)
+    eng = _tiered(net, num_pages=12)
+    n_warm = eng.warmup()
+    with eng:
+        onp.testing.assert_array_equal(refs[0], eng.infer(fam[0]))
+        for p in _fillers(6):                # evict the family's pages
+            eng.infer(p)
+        eng._tier.drain()
+        assert eng.stats()["tier"]["tier_demotes"] >= 1
+        onp.testing.assert_array_equal(refs[1], eng.infer(fam[1]))
+        onp.testing.assert_array_equal(refs[2], eng.infer(fam[2]))
+        s = eng.stats()
+    assert s["tier"]["tier_promotes"] >= 1
+    assert s["tier"]["tier_hits"] >= 1
+    assert s["compile_cache"]["compiles"] == n_warm
+    assert s["requests"]["completed"] == len(fam) + 6
+
+
+def test_tier_rot_in_engine_degrades_to_recompute_with_pristine_pool(net):
+    """Every promotion rots: the engine must recompute every family
+    re-hit (counted misses), tokens stay exact, and the device pool
+    ends pristine — zero non-finite values anywhere."""
+    import jax
+    fam = _family(seed=5, tails=3)
+    refs = _refs(net, fam, 4)
+    plan = FaultPlan()
+    plan.corrupt_at("serving.tier_rot", every=1)
+    with plan:
+        eng = _tiered(net, num_pages=12)
+        eng.warmup()
+        with eng:
+            onp.testing.assert_array_equal(refs[0], eng.infer(fam[0]))
+            for p in _fillers(6):
+                eng.infer(p)
+            eng._tier.drain()
+            onp.testing.assert_array_equal(refs[1], eng.infer(fam[1]))
+            onp.testing.assert_array_equal(refs[2], eng.infer(fam[2]))
+            s = eng.stats()
+            caches = eng._caches
+            assert caches is not None
+            for leaf in jax.tree_util.tree_leaves(caches):
+                assert bool(onp.isfinite(onp.asarray(leaf)).all())
+    assert s["tier"]["tier_verify_failures"] >= 1
+    assert s["tier"]["tier_promotes"] == 0
+    assert s["requests"]["completed"] == len(fam) + 6
+
+
+def test_tier_poisoned_pages_never_demote(net):
+    """A dirty (NaN-taintable) page blocks demotion at the gate — the
+    bundle is refused before any host copy, counted as a drop."""
+    eng = _tiered(net, num_pages=12)
+    eng.warmup()
+    with eng:
+        fam = _family(seed=19, tails=1)
+        eng.infer(fam[0])
+        # taint every cached entry's pages the way a non-finite victim
+        # would, then force eviction pressure (later filler entries are
+        # clean and may demote — only the TAINTED family must not)
+        with eng._step_lock:
+            for e in eng._prefix._entries:
+                eng._pool.mark_dirty(e.pages)
+        tainted = [tuple(int(t) for t in eng._entry_tokens(e))
+                   for e in eng._prefix._entries]
+        for p in _fillers(6, seed=500):
+            eng.infer(p)
+        eng._tier.drain()
+        s = eng.stats()
+        assert tainted
+        for key in tainted:
+            assert not eng._tier.contains(key)
+    assert s["tier"]["tier_drops"] >= 1
+
+
+def test_tier_disabled_engine_keeps_serving(net):
+    """Tier self-disable under a demote fault storm is invisible to
+    correctness: requests complete token-exact from HBM alone."""
+    fam = _family(seed=23, tails=2)
+    prompts = fam + _fillers(5, seed=600) + [fam[1]]
+    refs = _refs(net, prompts, 4)
+    plan = FaultPlan()
+    plan.raise_at("serving.tier_demote", every=1)
+    with plan:
+        eng = _tiered(net, num_pages=12, tier_fault_limit=2)
+        eng.warmup()
+        outs = _run_traffic(eng, prompts)
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["tier"]["enabled"] is False
+    assert s["tier"]["tier_faults"] == 2
+    assert s["requests"]["completed"] == len(prompts)

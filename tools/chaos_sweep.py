@@ -172,6 +172,7 @@ def serving_scenarios(net):
             net, "sigterm_drain", FaultPlan(), sigterm=True)),
         ("prefix_storm", lambda: serving_prefix_storm(net)),
         ("paged_storm", lambda: serving_paged_storm(net)),
+        ("spill_storm", lambda: serving_spill_storm(net)),
         ("spec_storm", serving_spec_storm),
         ("sharded_parity", lambda: serving_sharded_parity(net)),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
@@ -618,6 +619,104 @@ def serving_paged_storm(net):
                    "compiles_warmup": n_warm,
                    "compiles_total": s["compile_cache"]["compiles"],
                    "preemptions": s["overload"]["preemptions"],
+                   "faults_fired": plan.fired()},
+    }
+
+
+def serving_spill_storm(net):
+    """Tiered-KV chaos (docs/serving.md "Tiered prefix cache"): a
+    working set of shared-prefix families far larger than the device
+    page pool forces continuous demotion to the host tier and
+    promotion back, while faults land on both tier worker paths AND a
+    rot fault flips bytes in sealed bundles so verify-on-promote is
+    exercised end-to-end.  Invariants: ZERO lost requests (every
+    future resolves token-identical to fault-free ``net.generate``),
+    demotions and promotions both actually happened, at least one
+    rotted bundle was REJECTED at verify (degraded to a counted miss,
+    never a poisoned slot), the device pool stays NaN-free with a
+    pristine zero page, the tier never self-disabled, and the storm
+    compiled NOTHING after warmup."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.resilience import FaultPlan
+
+    rs = onp.random.RandomState(8)
+    # 5 families of 13-token prompts (10 shared + 3 tail) at page_size
+    # 8 => 2 pages each; 5 live families want 10 pages against a
+    # 6-page pool, so every wave evicts-and-demotes somebody
+    families = [rs.randint(0, 61, (10,)).astype("int32") for _ in range(5)]
+    waves = [[onp.concatenate([fam, rs.randint(0, 61, (3,)).astype("int32")])
+              for fam in families]
+             for _ in range(3)]
+    refs = {}
+    for wave in waves:
+        for p in wave:
+            refs[p.tobytes()] = net.generate(
+                mx.nd.array(p[None], dtype="int32"), 3,
+                temperature=0).asnumpy()[0]
+    plan = (FaultPlan()
+            .raise_at("serving.tier_demote", at=2)
+            .raise_at("serving.tier_promote", at=2)
+            .corrupt_at("serving.tier_rot", every=3))
+    # fault_limit 4 > the 2 single-shot worker faults: the tier
+    # degrades each fault to a counted drop/miss but must NOT disable
+    eng = _engine(net, num_slots=3, max_batch=3, kv_layout="paged",
+                  page_size=8, num_pages=6, prefix_min_tokens=2,
+                  host_pool_bytes=32 << 20, tier_fault_limit=4)
+    n_warm = eng.warmup()
+    mismatched = stranded = 0
+    with plan:
+        eng.start()
+        # resolve waves serially so each revisit lands AFTER the
+        # previous wave's evictions demoted its family to the tier
+        for wave in waves:
+            futs = [eng.submit(p, max_new_tokens=3) for p in wave]
+            for p, f in zip(wave, futs):
+                try:
+                    out = f.result(timeout=60)
+                    if not onp.array_equal(out, refs[p.tobytes()]):
+                        mismatched += 1
+                except Exception:
+                    stranded += 1
+        if eng._tier is not None:
+            eng._tier.drain(timeout=10)
+        s = eng.stats()
+        tier_enabled = bool(eng._tier is not None and eng._tier.enabled)
+        # rot/fault proof: no NaN anywhere in the device pool, and the
+        # never-written ZERO page is still pristine — a rotted bundle
+        # reaching a slot would land corrupt bytes right here
+        pool_clean = all(
+            bool(onp.isfinite(onp.asarray(a[:eng.num_pages])).all())
+            and bool((onp.asarray(a[eng.num_pages]) == 0).all())
+            for layer in eng._caches for a in layer.values())
+        try:
+            eng.stop(timeout=15)
+        except Exception:
+            pass
+    _join_zombies()
+    t = s["tier"]
+    passed = (mismatched == 0 and stranded == 0 and pool_clean
+              and tier_enabled
+              and t["tier_demotes"] >= 2
+              and t["tier_promotes"] >= 1
+              and t["tier_hits"] >= 1
+              and t["tier_verify_failures"] >= 1
+              and s["compile_cache"]["compiles"] == n_warm
+              and plan.fired("serving.tier_demote") >= 1
+              and plan.fired("serving.tier_promote") >= 1
+              and plan.fired("serving.tier_rot") >= 1)
+    return {
+        "name": "serving/spill_storm",
+        "passed": bool(passed),
+        "detail": {"requests": sum(len(w) for w in waves),
+                   "mismatched": mismatched, "stranded": stranded,
+                   "pool_clean": pool_clean,
+                   "tier_enabled": tier_enabled,
+                   "tier": t,
+                   "prefix": s["prefix_cache"],
+                   "compiles_warmup": n_warm,
+                   "compiles_total": s["compile_cache"]["compiles"],
                    "faults_fired": plan.fired()},
     }
 
