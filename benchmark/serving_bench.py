@@ -67,6 +67,22 @@ headline: max sustainable concurrency at fixed KV memory, the number
 paging exists to win — plus page-pool occupancy/fault/sharing stats).
 Greedy outputs are asserted token-identical between the arms.
 
+``--workload quantized`` runs the four-arm quantized-KV comparison
+(docs/serving.md "Quantized KV + paged attention kernel") at a FIXED
+KV byte budget: ``dense_fp32`` (the reference arm and baseline),
+``paged_gather_fp32`` (PR 11's dense-row gather), ``paged_kernel_fp32``
+(the Pallas in-place page reader — same dtype as gather, so
+``kernel_vs_gather_x`` is a pure read-arm cost ratio), and
+``paged_kernel_int8`` (int8 pages + fp32 scale sidecars, provisioned
+with as many MORE pages as the byte budget buys).  The divergence
+contract is enforced every trial: both fp32 paged arms are asserted
+token-identical to dense, the int8 arm is asserted exact through the
+match horizon AND runs under ``debug_parity`` with its max-abs logit
+delta bounded.  The headline is ``concurrency_per_mb`` — max
+sustained concurrency per KV megabyte, the number quantization exists
+to win (``vs_baseline`` on the int8 record is its ratio over
+``paged_kernel_fp32``).
+
 ``--workload speculative`` runs the speculative-vs-plain decode
 comparison (docs/serving.md "Speculative decode"): the same mixed
 greedy/sampled concurrent burst at IDENTICAL per-request sampling
@@ -870,6 +886,180 @@ def bench_paged(n_requests: int = 16, trials: int = 3):
              registry_live=last_paged["registry"]))
 
 
+def bench_quantized(n_requests: int = 24, trials: int = 3):
+    """Quantized int8 KV vs fp32, four arms at a FIXED KV byte budget.
+
+    The budget is the dense arm's cache footprint (``dense_slots *
+    Tmax`` fp32 positions); each paged arm gets however many pages
+    those BYTES buy at its storage cost — fp32 pages at ~2*L*H*D*4
+    bytes/position, int8 pages at ~2*L*(H*D + 4*H) (codes + fp32 scale
+    sidecars), so the int8 arm holds ~3.5x the positions and should
+    sustain proportionally more concurrent requests.  Per trial (fresh
+    engines — highwater is per-lifetime): submit the burst, score
+    tokens/s and ``active_highwater`` per KV megabyte.  Contracts
+    enforced every trial, not just in tests: fp32 gather == fp32
+    kernel == dense token-for-token; int8 exact through the match
+    horizon vs the fp32 kernel arm; the int8 arm runs ``debug_parity``
+    and its measured max-abs logit delta stays bounded; every arm's
+    compile counter is frozen after warmup."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.serving import InferenceEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    (net, short_lens, long_lens, seq_buckets, page_size, max_new,
+     dense_slots) = _build_paged_net(on_tpu)
+    rs = onp.random.RandomState(13)
+    lens = [long_lens[i % len(long_lens)] if i % 4 == 3
+            else short_lens[i % len(short_lens)]
+            for i in range(n_requests)]
+    prompts = [rs.randint(0, net.vocab_size, (l,)).astype("int32")
+               for l in lens]
+    tmax = net.max_length
+
+    def bytes_per_position(kv_quant):
+        # measured from a real 1-page cache (scale sidecars included),
+        # not re-derived from model hyperparameters: the budget must
+        # count exactly the bytes the engine will allocate
+        cache = net.init_page_cache(1, page_size, kv_quant=kv_quant)
+        total = sum(int(a.nbytes) // 2 for layer in cache
+                    for a in layer.values())         # minus the zero page
+        return total / page_size
+
+    fp32_bpp = bytes_per_position(None)
+    int8_bpp = bytes_per_position("int8")
+    budget = int(dense_slots * tmax * fp32_bpp)      # the fixed budget
+    pages = {"fp32": int(budget // (page_size * fp32_bpp)),
+             "int8": int(budget // (page_size * int8_bpp))}
+    min_fp = (min(short_lens) + max_new + page_size - 1) // page_size
+
+    def slots_for(num_pages):
+        return min(n_requests, max(dense_slots + 1,
+                                   num_pages // max(1, min_fp)))
+
+    horizon = 2                    # int8 exact-match horizon (tokens)
+    parity_bound = 0.05            # max-abs logit delta vs fp32 twin
+
+    def one_trial(arm):
+        from mxnet_tpu.observability import flatten
+        kw = dict(num_slots=dense_slots, prefix_pool_rows=0)
+        if arm != "dense_fp32":
+            quant = "int8" if arm.endswith("int8") else None
+            np = pages["int8" if quant else "fp32"]
+            kw = dict(num_slots=slots_for(np), kv_layout="paged",
+                      page_size=page_size, num_pages=np,
+                      kv_quant=quant,
+                      paged_attention=("gather" if "gather" in arm
+                                       else "kernel"),
+                      debug_parity=bool(quant))
+        eng = InferenceEngine(
+            net, max_batch=kw["num_slots"], seq_buckets=seq_buckets,
+            queue_depth=4 * n_requests, default_max_new_tokens=max_new,
+            name=f"serving_quant_{arm}", **kw)
+        n_warm = eng.warmup()
+        with eng:
+            t0 = time.perf_counter()
+            futs = [eng.submit(p, max_new_tokens=max_new)
+                    for p in prompts]
+            outs = [f.result(timeout=1800) for f in futs]
+            dt = time.perf_counter() - t0
+            s = eng.stats()
+            s["registry"] = flatten(prefix="mxtpu_serving")
+        if s["compile_cache"]["compiles"] != n_warm:
+            raise AssertionError(
+                f"{arm}: compiled on traffic ({s['compile_cache']} "
+                f"vs {n_warm} at warmup)")
+        toks = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+        return toks / dt, s, outs
+
+    arms = ("dense_fp32", "paged_gather_fp32", "paged_kernel_fp32",
+            "paged_kernel_int8")
+    vals = {a: [] for a in arms}
+    ccs = {a: [] for a in arms}
+    last = {}
+    for _ in range(max(1, trials)):
+        outs = {}
+        for arm in arms:
+            tps, s, o = one_trial(arm)
+            vals[arm].append(tps)
+            ccs[arm].append(s["slots"]["active_highwater"])
+            last[arm] = s
+            outs[arm] = o
+        for arm in ("paged_gather_fp32", "paged_kernel_fp32"):
+            for a, b in zip(outs["dense_fp32"], outs[arm]):
+                if not onp.array_equal(a, b):
+                    raise AssertionError(
+                        f"{arm} diverged from dense fp32 — the bench "
+                        f"would be comparing different work")
+        for ref, got, p in zip(outs["paged_kernel_fp32"],
+                               outs["paged_kernel_int8"], prompts):
+            h = len(p) + horizon
+            if not onp.array_equal(ref[:h], got[:h]):
+                raise AssertionError(
+                    "int8 arm broke the exact-match horizon "
+                    f"({horizon} tokens)")
+        err = last["paged_kernel_int8"]["quantized_kv"]["error"]
+        if not (err["count"] and err["max"] <= parity_bound):
+            raise AssertionError(
+                f"int8 divergence contract violated: {err} "
+                f"(bound {parity_bound})")
+
+    budget_mb = budget / (1 << 20)
+    med_cc = {a: statistics.median(ccs[a]) for a in arms}
+    per_mb = {a: round(med_cc[a] / budget_mb, 3) for a in arms}
+    base = {"n_requests": n_requests, "max_new_tokens": max_new,
+            "prompt_lens": lens, "kv_budget_bytes": budget,
+            "page_size": page_size, "exact_match_horizon": horizon}
+    med = {a: statistics.median(vals[a]) for a in arms}
+    yield _record(
+        "serving_quant_dense_fp32", vals["dense_fp32"], "tokens/sec",
+        None, dict(base, num_slots=dense_slots,
+                   max_concurrent=med_cc["dense_fp32"],
+                   concurrency_per_mb=per_mb["dense_fp32"],
+                   slots=last["dense_fp32"]["slots"]))
+    yield _record(
+        "serving_quant_paged_gather_fp32", vals["paged_gather_fp32"],
+        "tokens/sec",
+        round(med["paged_gather_fp32"] / med["dense_fp32"], 4),
+        dict(base, num_pages=pages["fp32"],
+             num_slots=slots_for(pages["fp32"]),
+             max_concurrent=med_cc["paged_gather_fp32"],
+             concurrency_per_mb=per_mb["paged_gather_fp32"],
+             slots=last["paged_gather_fp32"]["slots"]))
+    yield _record(
+        "serving_quant_paged_kernel_fp32", vals["paged_kernel_fp32"],
+        "tokens/sec",
+        round(med["paged_kernel_fp32"] / med["dense_fp32"], 4),
+        dict(base, num_pages=pages["fp32"],
+             num_slots=slots_for(pages["fp32"]),
+             max_concurrent=med_cc["paged_kernel_fp32"],
+             concurrency_per_mb=per_mb["paged_kernel_fp32"],
+             kernel_vs_gather_x=round(med["paged_kernel_fp32"] /
+                                      med["paged_gather_fp32"], 4),
+             # off-TPU the kernel body runs under the Pallas
+             # interpreter: the ratio prices interpret overhead, not
+             # the in-place page read the kernel exists for
+             read_arm="pallas" if on_tpu else "pallas_interpret",
+             slots=last["paged_kernel_fp32"]["slots"]))
+    qk = last["paged_kernel_int8"]["quantized_kv"]
+    yield _record(
+        "serving_quant_paged_kernel_int8", vals["paged_kernel_int8"],
+        "tokens/sec",
+        round(per_mb["paged_kernel_int8"] /
+              per_mb["paged_kernel_fp32"], 4),
+        dict(base, num_pages=pages["int8"],
+             num_slots=slots_for(pages["int8"]),
+             max_concurrent=med_cc["paged_kernel_int8"],
+             concurrency_per_mb=per_mb["paged_kernel_int8"],
+             concurrency_per_byte_x=round(
+                 per_mb["paged_kernel_int8"] /
+                 per_mb["paged_kernel_fp32"], 4),
+             parity_error_max=err["max"], parity_samples=err["count"],
+             quantized_kv=qk,
+             registry_live=last["paged_kernel_int8"]["registry"]))
+
+
 def _build_tiered_net(on_tpu: bool):
     from mxnet_tpu.models import get_gpt2
 
@@ -1371,8 +1561,8 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workload",
                     choices=("decode", "prefix", "fleet", "overload",
-                             "paged", "speculative", "sharded", "disagg",
-                             "elastic", "tiered"),
+                             "paged", "quantized", "speculative",
+                             "sharded", "disagg", "elastic", "tiered"),
                     default="decode")
     ap.add_argument("--mesh-devices", type=int, default=None,
                     help="device count for --workload sharded "
@@ -1404,6 +1594,8 @@ def main():
         recs = bench_overload(trials=args.trials)
     elif args.workload == "paged":
         recs = bench_paged(trials=args.trials)
+    elif args.workload == "quantized":
+        recs = bench_quantized(trials=args.trials)
     elif args.workload == "speculative":
         recs = bench_speculative(trials=args.trials)
     elif args.workload == "sharded":

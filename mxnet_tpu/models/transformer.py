@@ -162,7 +162,8 @@ class MultiHeadAttention(HybridBlock):
         out = self.out_proj(out.reshape((b, t, h * d)))
         return out, {"k": kc, "v": vc}
 
-    def forward_step_slots(self, x, cache, pos, page_table=None):
+    def forward_step_slots(self, x, cache, pos, page_table=None,
+                           paged_kernel=False):
         """Continuous-batching decode: x (S,1,U) where row s is an
         independent request parked in SLOT s of the persistent cache
         {'k','v': (R,Tmax,H,D)}, at its OWN position ``pos`` (S,) int32.
@@ -180,13 +181,26 @@ class MultiHeadAttention(HybridBlock):
         entry ``page_table[s, pos[s]//ps]`` (parked rows and writes
         into unassigned table entries route OUT OF BOUNDS, which jax
         drops — page N is the never-written ZERO page that unassigned
-        entries READ), and attention gathers the row's pages back into
-        a contiguous (S, P*ps, H, D) view so the masked attention
+        entries READ).  With ``paged_kernel=True`` attention reads the
+        pages IN PLACE through the table (:func:`mxnet_tpu.ops.paged.
+        paged_attention`); otherwise the row's pages are gathered back
+        into a contiguous (S, P*ps, H, D) view so the masked attention
         below is shared verbatim with the dense layout — identical
-        shapes, identical masked values, bit-identical tokens."""
+        shapes, identical masked values, bit-identical tokens (the
+        kernel arm matches token-for-token; its online softmax
+        reassociates the reduction, so bits may differ).
+
+        QUANTIZED variant (the cache carries ``k_scale``/``v_scale``
+        leaves — docs/serving.md "Quantized KV"): new K/V quantize to
+        int8 on the scatter write with per-position-per-head fp32
+        scales landing beside them (same routing, so targetless scale
+        writes drop identically), and dequantize at attention time —
+        fused into the kernel's tile loads, or broadcast-multiplied
+        after the gather on the reference arm."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
+        from ..ops.paged import kv_quantize, paged_attention
 
         s = x.shape[0]
         h, d = self._num_heads, self._head_dim
@@ -216,12 +230,42 @@ class MultiHeadAttention(HybridBlock):
             phys = jnp.where((pos < tmax) & (mapped != zero_page),
                              mapped, zero_page + 1)
             off = pos % ps
-            kc = cache["k"].at[phys, off].set(
-                k_new.jax.astype(cache["k"].dtype))
-            vc = cache["v"].at[phys, off].set(
-                v_new.jax.astype(cache["v"].dtype))
+            quant = "k_scale" in cache
+            if quant:
+                kq, ksc = kv_quantize(k_new.jax)
+                vq, vsc = kv_quantize(v_new.jax)
+                kc = cache["k"].at[phys, off].set(kq)
+                vc = cache["v"].at[phys, off].set(vq)
+                ks_c = cache["k_scale"].at[phys, off].set(ksc)
+                vs_c = cache["v_scale"].at[phys, off].set(vsc)
+            else:
+                kc = cache["k"].at[phys, off].set(
+                    k_new.jax.astype(cache["k"].dtype))
+                vc = cache["v"].at[phys, off].set(
+                    v_new.jax.astype(cache["v"].dtype))
+            newc = {"k": kc, "v": vc}
+            if quant:
+                newc["k_scale"] = ks_c
+                newc["v_scale"] = vs_c
+            if paged_kernel:
+                out = paged_attention(
+                    q.jax, kc, vc, page_table, pos[:, None],
+                    k_scale=ks_c if quant else None,
+                    v_scale=vs_c if quant else None,
+                    scale=1.0 / (d ** 0.5))
+                out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
+                return out, newc
             krow = _paged_rows(kc, page_table)
             vrow = _paged_rows(vc, page_table)
+            if quant:
+                krow = krow.astype(jnp.float32) * \
+                    _paged_rows(ks_c, page_table)
+                vrow = vrow.astype(jnp.float32) * \
+                    _paged_rows(vs_c, page_table)
+            out = _attention_step_slots(q.jax, krow, vrow, pos,
+                                        1.0 / (d ** 0.5))
+            out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
+            return out, newc
         out = _attention_step_slots(q.jax, krow, vrow, pos,
                                     1.0 / (d ** 0.5))
         out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
@@ -261,13 +305,22 @@ class MultiHeadAttention(HybridBlock):
         else:
             krow = _paged_rows(cache["k"], page_table)
             vrow = _paged_rows(cache["v"], page_table)
+            if "k_scale" in cache:
+                # quantized pages: dequantize the gathered view — the
+                # draft stays on the gather arm (it is read-only and
+                # off the throughput-critical path), but the window
+                # buffers themselves are always fp32 (gpt2.draft_slots)
+                krow = krow.astype(jnp.float32) * \
+                    _paged_rows(cache["k_scale"], page_table)
+                vrow = vrow.astype(jnp.float32) * \
+                    _paged_rows(cache["v_scale"], page_table)
         out = _attention_step_window(q.jax, krow, vrow, wk, wv, pos, i,
                                      1.0 / (d ** 0.5))
         out = self.out_proj(NDArray(out.reshape(s, 1, h * d)))
         return out, wk, wv
 
     def forward_prefill_slots(self, x, cache, slot_idx, offset=None,
-                              page_table=None):
+                              page_table=None, paged_kernel=False):
         """Bucketed admission prefill: x (B,Tb,U) is a batch of PADDED
         prompts; row i's K/V for positions [0, Tb) land in cache row
         ``slot_idx[i]`` of the persistent (R,Tmax,H,D) cache.  Causal
@@ -299,11 +352,16 @@ class MultiHeadAttention(HybridBlock):
         never-written ZERO page unassigned entries read.  The offset
         path gathers each row's pages back into a contiguous
         (B, Tmax, H, D) view so :func:`_attention_chunk` is shared
-        verbatim with the dense layout."""
+        verbatim with the dense layout — or, with ``paged_kernel=True``,
+        attention reads the pages in place through the table.  A cache
+        carrying ``k_scale``/``v_scale`` leaves quantizes the scatter
+        write to int8 (scales ride the same routing) and dequantizes at
+        attention time, exactly as in :meth:`forward_step_slots`."""
         import jax.numpy as jnp
 
         from ..ndarray import NDArray
         from ..ops import dot_product_attention
+        from ..ops.paged import kv_quantize, paged_attention
 
         b, t = x.shape[0], x.shape[1]
         h, d = self._num_heads, self._head_dim
@@ -312,6 +370,7 @@ class MultiHeadAttention(HybridBlock):
         v = self.v_proj(x).reshape((b, t, h, d))
         cidx = jnp.arange(t)[None, :] if offset is None \
             else offset[:, None] + jnp.arange(t)[None, :]
+        quant = page_table is not None and "k_scale" in cache
         # slot_idx=None means "row i IS slot i" (the speculative verify
         # window, whose batch dim spans every slot): the row read below
         # becomes a SLICE instead of a gather — an identity-permutation
@@ -342,11 +401,21 @@ class MultiHeadAttention(HybridBlock):
             phys = jnp.where((cidx < tmax) & (mapped != zero_page),
                              mapped, zero_page + 1)
             off = cidx % ps
-            kc = cache["k"].at[phys, off].set(
-                k.jax.astype(cache["k"].dtype))
-            vc = cache["v"].at[phys, off].set(
-                v.jax.astype(cache["v"].dtype))
+            if quant:
+                kq, ksc = kv_quantize(k.jax)
+                vq, vsc = kv_quantize(v.jax)
+                kc = cache["k"].at[phys, off].set(kq)
+                vc = cache["v"].at[phys, off].set(vq)
+                ks_c = cache["k_scale"].at[phys, off].set(ksc)
+                vs_c = cache["v_scale"].at[phys, off].set(vsc)
+            else:
+                kc = cache["k"].at[phys, off].set(
+                    k.jax.astype(cache["k"].dtype))
+                vc = cache["v"].at[phys, off].set(
+                    v.jax.astype(cache["v"].dtype))
         if offset is None:
+            # full-prompt prefill attends the chunk's OWN fresh fp32
+            # K/V (no cache read) — shared by every layout and dtype
             out = dot_product_attention(q, k, v, causal=True)
         elif page_table is None:
             if slot_idx is None:
@@ -359,12 +428,28 @@ class MultiHeadAttention(HybridBlock):
         else:
             trows = page_table[:b] if slot_idx is None \
                 else page_table[slot_idx]
-            krow = _paged_rows(kc, trows)
-            vrow = _paged_rows(vc, trows)
-            out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
-                                           1.0 / (d ** 0.5)))
+            if paged_kernel:
+                out = NDArray(paged_attention(
+                    q.jax, kc, vc, trows, cidx,
+                    k_scale=ks_c if quant else None,
+                    v_scale=vs_c if quant else None,
+                    scale=1.0 / (d ** 0.5)))
+            else:
+                krow = _paged_rows(kc, trows)
+                vrow = _paged_rows(vc, trows)
+                if quant:
+                    krow = krow.astype(jnp.float32) * \
+                        _paged_rows(ks_c, trows)
+                    vrow = vrow.astype(jnp.float32) * \
+                        _paged_rows(vs_c, trows)
+                out = NDArray(_attention_chunk(q.jax, krow, vrow, cidx,
+                                               1.0 / (d ** 0.5)))
         out = self.out_proj(out.reshape((b, t, h * d)))
-        return out, {"k": kc, "v": vc}
+        newc = {"k": kc, "v": vc}
+        if quant:
+            newc["k_scale"] = ks_c
+            newc["v_scale"] = vs_c
+        return out, newc
 
 
 def _attention_step(q, k_cache, v_cache, idx, scale):
@@ -399,8 +484,9 @@ def _paged_rows(pages, table_rows):
     (the dense layout isolates rows; paging must too).  The gather
     materializes a (B, Tmax) working set transiently — the HBM win of
     paging is in the PERSISTENT allocation (live tokens, not
-    Tmax*slots); a fused kernel that skips the materialization is the
-    TPU follow-up, same as the flash chunk-attention note below."""
+    Tmax*slots); :func:`mxnet_tpu.ops.paged.paged_attention` skips the
+    materialization entirely (the default ``paged_attention='kernel'``
+    arm), keeping this gather as the opt-out reference arm."""
     b, p = table_rows.shape
     g = pages[table_rows]                    # (B, P, ps, H, D)
     return g.reshape(b, p * g.shape[2], g.shape[3], g.shape[4])
@@ -703,24 +789,28 @@ class TransformerBlock(HybridBlock):
         x = x + self.ffn(self.ln2(x))
         return x, cache
 
-    def forward_step_slots(self, x, cache, pos, page_table=None):
+    def forward_step_slots(self, x, cache, pos, page_table=None,
+                           paged_kernel=False):
         """Continuous-batching decode through the block (see
         MultiHeadAttention.forward_step_slots; ``page_table`` selects
-        the paged-KV layout)."""
+        the paged-KV layout, ``paged_kernel`` the in-place Pallas read
+        arm)."""
         a, cache = self.attn.forward_step_slots(self.ln1(x), cache, pos,
-                                                page_table)
+                                                page_table, paged_kernel)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache
 
     def forward_prefill_slots(self, x, cache, slot_idx, offset=None,
-                              page_table=None):
+                              page_table=None, paged_kernel=False):
         """Bucketed admission prefill through the block (see
         MultiHeadAttention.forward_prefill_slots; ``offset`` selects the
-        chunked/offset variant, ``page_table`` the paged-KV layout)."""
+        chunked/offset variant, ``page_table`` the paged-KV layout,
+        ``paged_kernel`` the in-place Pallas read arm)."""
         a, cache = self.attn.forward_prefill_slots(self.ln1(x), cache,
                                                    slot_idx, offset,
-                                                   page_table)
+                                                   page_table,
+                                                   paged_kernel)
         x = x + a
         x = x + self.ffn(self.ln2(x))
         return x, cache

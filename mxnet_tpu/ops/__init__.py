@@ -11,11 +11,13 @@ reference implementations used for testing and CPU execution.
 from .attention import (dot_product_attention, flash_attention,
                         interleaved_matmul_selfatt_qk,
                         interleaved_matmul_selfatt_valatt)
+from .paged import kv_dequantize, kv_quantize, paged_attention
 from .ring import nd_ring_attention, ring_attention
 from .ulysses import nd_ulysses_attention, ulysses_attention
 
 __all__ = ["dot_product_attention", "flash_attention",
            "interleaved_matmul_selfatt_qk",
            "interleaved_matmul_selfatt_valatt",
+           "kv_dequantize", "kv_quantize", "paged_attention",
            "nd_ring_attention", "ring_attention",
            "nd_ulysses_attention", "ulysses_attention"]

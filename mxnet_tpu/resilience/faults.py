@@ -206,6 +206,19 @@ register_site("serving.tier_rot",
               "(host-RAM bit rot; verify-on-promote rejects the bundle "
               "— a rotted spill degrades to a counted miss, never a "
               "poisoned slot)")
+register_site("serving.kv_quant",
+              "int8 quantize-on-write gate, fired at the top of every "
+              "prefill dispatch on a quantized engine BEFORE any device "
+              "work (a failed quantize degrades to a counted recompute: "
+              "the batch sits out one cycle and retries, slots/pages/"
+              "table untouched — never a torn int8 write)")
+register_site("serving.kv_scale",
+              "poison: NaN splice into one claimed page's fp32 scale "
+              "sidecar (host-RAM rot in the dequant path; the in-graph "
+              "NaN guard detects it at the first dequant that reads the "
+              "page — the victim fails typed, its pages go through the "
+              "ordinary dirty-page scrub, a counted dequant fault, "
+              "never a poisoned pool)")
 # overload control (docs/overload.md) — degrades, never fails a request
 register_site("overload.admission", "priority/deadline admission gate")
 register_site("overload.preempt", "slot-preemption attempt")

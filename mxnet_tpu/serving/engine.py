@@ -472,6 +472,9 @@ class InferenceEngine:
                  kv_layout: str = "dense",
                  page_size: int = 16,
                  num_pages: Optional[int] = None,
+                 kv_quant: Optional[str] = None,
+                 paged_attention: Optional[str] = None,
+                 debug_parity: bool = False,
                  host_pool_bytes: int = 0,
                  tier_fault_limit: int = 3,
                  disk_tier_dir: Optional[str] = None,
@@ -674,11 +677,71 @@ class InferenceEngine:
                 self.host_pool_bytes, page_size=self.page_size,
                 fault_limit=self.tier_fault_limit,
                 disk_dir=self.disk_tier_dir, scope=self.name,
-                metrics=self.metrics)
+                metrics=self.metrics,
+                # raw param: self.kv_quant is validated just below, and
+                # a rejected value raises before the tier thread starts
+                kv_quant=kv_quant or None)
         # sharded decode (docs/serving.md "Sharded decode") — resolved
         # AFTER the layout knobs above: validation reads num_slots /
         # prefix_pool_rows / kv_layout
         self._init_mesh(mesh, mesh_axes)
+        # quantized KV pages + paged-attention kernel (docs/serving.md
+        # "Quantized KV + paged attention kernel").  kv_quant='int8'
+        # stores pages int8 with per-(position, head) fp32 scales
+        # beside them; paged_attention picks the read arm: 'kernel'
+        # (the Pallas paged kernel — pages read in place through the
+        # page table) or 'gather' (the PR 11 dense-row gather, kept as
+        # the reference arm).  None auto-resolves: kernel when
+        # unsharded, gather under a mesh (the Pallas call is not
+        # GSPMD-partitionable).
+        if kv_quant not in (None, "int8"):
+            raise ServingError(f"kv_quant must be None|'int8', got "
+                               f"{kv_quant!r}")
+        if kv_quant and not self._paged:
+            raise ServingError("kv_quant='int8' requires "
+                               "kv_layout='paged' — the dense layout "
+                               "IS the fp32 reference arm")
+        self.kv_quant = kv_quant if self._paged else None
+        if paged_attention not in (None, "kernel", "gather"):
+            raise ServingError(f"paged_attention must be None|'kernel'|"
+                               f"'gather', got {paged_attention!r}")
+        if paged_attention and not self._paged:
+            raise ServingError("paged_attention picks the PAGED read "
+                               "arm; set kv_layout='paged' first")
+        if paged_attention == "kernel" and self.mesh is not None:
+            raise ServingError(
+                "paged_attention='kernel' does not compose with a "
+                "serving mesh (the Pallas paged kernel is not GSPMD-"
+                "partitionable); use the 'gather' arm under mesh")
+        if self._paged:
+            self.paged_attention = paged_attention or \
+                ("gather" if self.mesh is not None else "kernel")
+        else:
+            self.paged_attention = None
+        self._paged_kernel = self.paged_attention == "kernel"
+        # debug_parity: a fp32 GATHER-arm twin cache sharing the SAME
+        # page table mirrors every cache write path the plain engine
+        # has (prefill/chunk/decode/scrub/tail-page copy), and each
+        # step's max-abs logit delta vs the twin feeds the
+        # mxtpu_serving_kv_quant_error histogram.  Restricted to
+        # configurations where those ARE the only write paths — the
+        # speculative window, tier promotions, migration ingress and
+        # cross-engine seeding all write K/V the twin cannot see.
+        self.debug_parity = bool(debug_parity)
+        self._parity_caches = None
+        if self.debug_parity:
+            if not self._paged:
+                raise ServingError("debug_parity compares against the "
+                                   "fp32 paged gather arm — it needs "
+                                   "kv_layout='paged'")
+            if self.spec_tokens or self.host_pool_bytes or \
+                    self.mesh is not None or role != "unified":
+                raise ServingError(
+                    "debug_parity is a single-engine debug knob: "
+                    "incompatible with spec_tokens, host_pool_bytes "
+                    "(tiering), mesh, and non-unified roles — those "
+                    "paths write K/V the fp32 twin cache cannot "
+                    "mirror")
         self.prefix_fault_limit = int(prefix_fault_limit)
         # consecutive-fault streaks, PER SITE: a clean host lookup runs
         # right before every device copy, so a shared counter could
@@ -919,6 +982,26 @@ class InferenceEngine:
                        "duplicated row under the dense layout)",
                   fn=bound(lambda e: e._pool.shared_count
                            if e._pool is not None else 0), **lbl)
+        def kv_bytes_per_token(e):
+            # layout efficiency, scales INCLUDED: total KV cache bytes
+            # over the token positions the layout can hold.  fp32
+            # paged reads ~= layers*2*H*D*4; int8 drops to
+            # ~layers*2*(H*D + 4*H) — the ~3.8x shrink the quantized
+            # arm is bought for.  0 until caches materialize.
+            if e._caches is None:
+                return 0.0
+            import jax
+            leaves = jax.tree_util.tree_leaves(e._caches)
+            total = sum(int(l.nbytes) for l in leaves)
+            first = leaves[0]
+            positions = int(first.shape[0]) * int(first.shape[1])
+            return total / positions if positions else 0.0
+
+        reg.gauge("mxtpu_serving_kv_bytes_per_token",
+                  help="KV cache bytes (scale sidecars included) per "
+                       "token position of the layout — the quantized-"
+                       "KV density signal (0 = caches not built yet)",
+                  fn=bound(kv_bytes_per_token), **lbl)
         reg.gauge("mxtpu_serving_tier_host_bytes",
                   help="host-RAM bytes held by the tiered prefix "
                        "cache's demoted KV bundles (0 = tier off)",
@@ -1072,14 +1155,24 @@ class InferenceEngine:
 
             if self._paged:
                 # the paged programs take the page table as ONE extra
-                # traced argument
+                # traced argument.  pk routes the attention read to the
+                # Pallas paged kernel or the dense-row gather arm —
+                # STATIC per engine, so it never adds a lattice point.
+                # With debug_parity on, every sampling closure also
+                # returns its raw logits so the scheduler can diff them
+                # against the fp32 twin (one extra fetched output —
+                # still zero extra programs).
+                pk = self._paged_kernel
+                dbg = self.debug_parity  # raceguard: unguarded(closure build: read once before the scheduler thread starts; later flips only disable the twin, never re-enable)
+
                 def chunk(toks, lens, caches, sidx, off, temp, topk,
                           topp, keys, table):
                     logits, c = net.prefill_slots(
                         NDArray(toks), lens, caches, sidx, offset=off,
-                        page_table=table)
+                        page_table=table, paged_kernel=pk)
                     fpos = lens - 1 if off is None else off + lens - 1
-                    return post(logits, c, temp, topk, topp, keys, fpos)
+                    r = post(logits, c, temp, topk, topp, keys, fpos)
+                    return r + (logits.jax,) if dbg else r
 
                 def prefill(toks, lens, caches, sidx, temp, topk, topp,
                             keys, table):
@@ -1089,15 +1182,36 @@ class InferenceEngine:
                 def step(tok, caches, pos, temp, topk, topp, keys,
                          table):
                     logits, c = net.decode_step(NDArray(tok), caches,
-                                                pos, page_table=table)
-                    return post(logits, c, temp, topk, topp, keys, pos)
+                                                pos, page_table=table,
+                                                paged_kernel=pk)
+                    r = post(logits, c, temp, topk, topp, keys, pos)
+                    return r + (logits.jax,) if dbg else r
 
                 def verify(toks, caches, pos, temp, topk, topp, keys,
                            table):
                     logits, c = net.verify_slots(NDArray(toks), caches,
-                                                 pos, page_table=table)
+                                                 pos, page_table=table,
+                                                 paged_kernel=pk)
                     return verify_post(logits, c, pos, temp, topk,
                                        topp, keys)
+
+                # fp32 reference twins (debug_parity): the GATHER arm,
+                # never quantized, sharing the live page table — same
+                # page allocation decisions, bit-independent K/V
+                def parity_chunk(toks, lens, caches, sidx, off, table):
+                    logits, c = net.prefill_slots(
+                        NDArray(toks), lens, caches, sidx, offset=off,
+                        page_table=table)
+                    return logits.jax, pin_c(c)
+
+                def parity_prefill(toks, lens, caches, sidx, table):
+                    return parity_chunk(toks, lens, caches, sidx,
+                                        None, table)
+
+                def parity_step(tok, caches, pos, table):
+                    logits, c = net.decode_step(NDArray(tok), caches,
+                                                pos, page_table=table)
+                    return logits.jax, pin_c(c)
 
                 def draft(tok, caches, pos, temp, topk, topp, keys,
                           pois, table):
@@ -1209,6 +1323,24 @@ class InferenceEngine:
                 self._jit_verify = jax.jit(pure_verify) if spec_k \
                     else None
             self._jit_draft = jax.jit(pure_draft) if spec_k else None
+            self._jit_parity_prefill = None
+            self._jit_parity_chunk = None
+            self._jit_parity_step = None
+            if self._paged and self.debug_parity:  # raceguard: unguarded(jit build: read once before the scheduler thread starts; later flips only disable the twin, never re-enable)
+                _, pure_pp = make_pure_fn(net, parity_prefill)
+                _, pure_pc = make_pure_fn(net, parity_chunk)
+                _, pure_ps = make_pure_fn(net, parity_step)
+                if jax.default_backend() == "tpu":
+                    self._jit_parity_prefill = jax.jit(
+                        pure_pp, donate_argnums=(3,))
+                    self._jit_parity_chunk = jax.jit(
+                        pure_pc, donate_argnums=(3,))
+                    self._jit_parity_step = jax.jit(
+                        pure_ps, donate_argnums=(2,))
+                else:
+                    self._jit_parity_prefill = jax.jit(pure_pp)
+                    self._jit_parity_chunk = jax.jit(pure_pc)
+                    self._jit_parity_step = jax.jit(pure_ps)
         else:
             def forward(xs):
                 out = net(NDArray(xs))
@@ -1982,9 +2114,18 @@ class InferenceEngine:
                 # params (temp/top-k/top-p/key per row) are traced
                 # args shaped by the batch bucket — same story.
                 tbl = (self._table_arg(),) if self._paged else ()
-                _, _ok, self._caches = self._counted(
+                res = self._counted(
                     ("decode",), self._jit_step, params, zeros,
                     self._caches, zeros, *self._zero_samp(s1), *tbl)
+                self._caches = res[2]
+                if self._parity_caches is not None:
+                    # debug_parity twins compile alongside their
+                    # primaries — after warmup() the parity mirrors on
+                    # traffic are bucket hits like everything else
+                    _, self._parity_caches = self._counted(
+                        ("parity_decode",), self._jit_parity_step,
+                        params, zeros, self._parity_caches, zeros,
+                        *tbl)
                 if self.spec_tokens:
                     # the (bucket, k) lattice's k-side points: ONE
                     # draft and ONE verify program at the fixed
@@ -2007,15 +2148,27 @@ class InferenceEngine:
                     toks = jnp.zeros((bb, tb), jnp.int32)
                     lens = jnp.ones((bb,), jnp.int32)
                     sidx = jnp.full((bb,), scratch, jnp.int32)
-                    _, _ok, self._caches = self._counted(
+                    res = self._counted(
                         ("prefill", bb, tb), self._jit_prefill, params,
                         toks, lens, self._caches, sidx,
                         *self._zero_samp(bb), *tbl)
+                    self._caches = res[2]
                     off = jnp.zeros((bb,), jnp.int32)
-                    _, _ok, self._caches = self._counted(
+                    res = self._counted(
                         ("chunk", bb, tb), self._jit_chunk, params,
                         toks, lens, self._caches, sidx, off,
                         *self._zero_samp(bb), *tbl)
+                    self._caches = res[2]
+                    if self._parity_caches is not None:
+                        _, self._parity_caches = self._counted(
+                            ("parity_prefill", bb, tb),
+                            self._jit_parity_prefill, params, toks,
+                            lens, self._parity_caches, sidx, *tbl)
+                        _, self._parity_caches = self._counted(
+                            ("parity_chunk", bb, tb),
+                            self._jit_parity_chunk, params, toks,
+                            lens, self._parity_caches, sidx, off,
+                            *tbl)
                 if self._prefix is not None:
                     # dense: row-to-row prefix copy; paged: the same
                     # program IS the partial-tail-page copy (scratch
@@ -2025,6 +2178,13 @@ class InferenceEngine:
                     self._caches = self._counted(
                         ("prefix_copy",), self._jit_copy, self._caches,
                         scr, scr, jnp.asarray(0, jnp.int32))
+                    if self._parity_caches is not None:
+                        # the twin's copy is a distinct jit-cache entry
+                        # (fp32 tree vs the primary's int8+scale tree)
+                        self._parity_caches = self._counted(
+                            ("parity_copy",), self._jit_copy,
+                            self._parity_caches, scr, scr,
+                            jnp.asarray(0, jnp.int32))
             else:
                 if example_shape is None:
                     raise ServingError("forward-mode warmup needs "
@@ -2101,6 +2261,20 @@ class InferenceEngine:
             raise MigrationError(
                 f"bundle page_size={bundle.page_size} != engine "
                 f"page_size={self.page_size}")
+        if getattr(bundle, "kv_quant", None) != self.kv_quant:
+            # int8 codes + scale sidecars are one storage contract —
+            # never scatter one arm's leaves into the other's pool
+            raise MigrationError(
+                f"bundle kv_quant={getattr(bundle, 'kv_quant', None)!r} "
+                f"!= engine kv_quant={self.kv_quant!r} — KV bytes are "
+                f"not portable across storage arms")
+        if self.debug_parity:  # raceguard: unguarded(advisory refusal: a stale True after the twin self-disables just rejects one adoption — conservative, never unsafe)
+            # the fp32 parity twin only mirrors tokens THIS engine
+            # computed; adopted K/V has no twin-side history, so the
+            # divergence contract would report phantom error
+            raise MigrationError(
+                f"engine {self.name!r} runs debug_parity — adoption "
+                f"would desynchronise the reference twin")
         if bundle.prompt_len + bundle.max_new_tokens > self.max_length:
             raise MigrationError(
                 f"prompt len {bundle.prompt_len} + "
@@ -2262,7 +2436,7 @@ class InferenceEngine:
                         source=self.name, layout=self.kv_layout,
                         page_size=self.page_size if self._paged else 0,
                         tokens=tokens, length=entry.length,
-                        arrays=arrays)
+                        arrays=arrays, kv_quant=self.kv_quant)
                     s.digest = seed_digest(s)
                     seeds.append(s)
                 except Exception:
@@ -2307,6 +2481,16 @@ class InferenceEngine:
             raise MigrationError(
                 f"seed page_size={seed.page_size} != engine "
                 f"page_size={self.page_size}")
+        if getattr(seed, "kv_quant", None) != self.kv_quant:
+            raise MigrationError(
+                f"seed kv_quant={getattr(seed, 'kv_quant', None)!r} != "
+                f"engine kv_quant={self.kv_quant!r} — KV bytes are not "
+                f"portable across storage arms")
+        if self.debug_parity:  # raceguard: unguarded(advisory refusal: a stale True after the twin self-disables just skips one seed — conservative, never unsafe)
+            # seeded K/V has no twin-side history — planting it would
+            # turn the divergence contract into phantom error.  Seeding
+            # is an optimization, so this is a refusal, not a fault.
+            return False
         if seed.length > self.max_length or \
                 seed.length < self.prefix_min_tokens:
             return False
@@ -2411,6 +2595,14 @@ class InferenceEngine:
             "page_faults": c["page_faults"],
             "pages_scrubbed": c["pages_scrubbed"],
         }
+        # quantized-KV + paged-attention arm (docs/serving.md
+        # "Quantized KV + paged attention kernel"): overlay the
+        # engine's knobs on the metrics' counter/histogram section
+        s["quantized_kv"].update({
+            "kv_quant": self.kv_quant,
+            "paged_attention": self.paged_attention,
+            "debug_parity": self.debug_parity,  # raceguard: unguarded(stats snapshot: atomic bool read, staleness bounded by one cycle)
+        })
         # sharded decode (docs/serving.md "Sharded decode"): the mesh
         # this engine's programs span, and the compile accounting per
         # (bucket, mesh) point — warmup() freezes the "compiles" total,
@@ -2573,6 +2765,9 @@ class InferenceEngine:
             # forget its mappings or a later hit would copy ZEROED K/V
             # into a slot and silently serve wrong tokens.
             self._caches = None
+            # the fp32 parity twin dies with the primaries (it shares
+            # their page table, which resets below)
+            self._parity_caches = None
             # in-flight promotions target the dead buffers; waiters were
             # already degraded by _release above, so just forget the
             # handle map (the tier's own store survives — its bundles
@@ -2624,9 +2819,12 @@ class InferenceEngine:
         if self._caches is None:
             if self._paged:
                 # pool + scratch page share one array per layer so
-                # page copies and gathers stay in a single buffer
+                # page copies and gathers stay in a single buffer;
+                # kv_quant='int8' makes each layer an int8 page array
+                # plus its fp32 per-(position, head) scale leaves
                 self._caches = self.net.init_page_cache(
-                    self.num_pages + 1, self.page_size)
+                    self.num_pages + 1, self.page_size,
+                    kv_quant=self.kv_quant)
             else:
                 # slots + scratch + prefix pool share one array per
                 # layer so row-to-row copies and slot reads stay in a
@@ -2637,6 +2835,12 @@ class InferenceEngine:
             # sharded decode: commit the fresh caches onto the mesh so
             # every compiled call sees stably-sharded operands
             self._caches = self._place_caches(self._caches)
+        if self.debug_parity and self._parity_caches is None:
+            # the fp32 reference twin: same page geometry, never
+            # quantized, updated through the parity programs only
+            self._parity_caches = self._place_caches(
+                self.net.init_page_cache(self.num_pages + 1,
+                                         self.page_size))
 
     def _release(self, slot: int):  # guarded-by: _step_lock
         """End a slot lease, dropping any prefix-cache read pin the
@@ -2693,12 +2897,45 @@ class InferenceEngine:
             # must exist before the step (page faults park victims by
             # reference — see docs/serving.md "Paged KV")
             self._grow_pages()
+        if self.kv_quant:
+            # numeric fault site serving.kv_scale (docs/resilience.md):
+            # a poisoned per-page scale is detected AT DEQUANT by the
+            # in-graph NaN guard on the very next step that reads the
+            # page — never served, counted at _fail_nonfinite
+            bad = _poison("serving.kv_scale")
+            if bad is not None:
+                self._poison_scale(float(bad))
         if any(not st.prefilling and not st.waiting
                for _s, st in alloc.items()):
             if self.spec_tokens and self._spec_pages_ok:
                 self._spec_step()
             else:
                 self._decode_step()
+
+    def _poison_scale(self, value: float):  # guarded-by: _step_lock
+        """Apply a ``serving.kv_scale`` poison: splice ``value``
+        (NaN/garbage) into the layer-0 K-scale of a claimed page —
+        modeling host-RAM rot in the scale sidecar.  Eager cache
+        surgery + re-pin, same discipline as scrub-on-NaN: zero
+        compiled-program cache entries.  No claimed page → no-op."""
+        if self._caches is None:
+            return
+        pid = None
+        for _slot, st in self._alloc.items():
+            if st.pages:
+                pid = st.pages[len(st.pages) - 1]
+                break
+        if pid is None:
+            return
+        caches = self._caches
+        c0 = dict(caches[0])
+        if "k_scale" not in c0:
+            return
+        c0["k_scale"] = c0["k_scale"].at[pid].set(value)
+        rest = caches[1:]
+        new = (c0,) + tuple(rest) if isinstance(caches, tuple) \
+            else [c0] + list(rest)
+        self._caches = self._place_caches(new)
 
     def _overload_tick(self, now: float):
         """One AIMD controller tick (docs/overload.md): pressure =
@@ -2984,6 +3221,21 @@ class InferenceEngine:
                     self._prefix_fault("copy")
                 else:
                     self._prefix_faults["copy"] = 0
+                    if self._parity_caches is not None:
+                        # mirror the tail-page copy into the fp32 twin
+                        # (same src/dst/length, its own buffers) so the
+                        # arms keep identical prefix state
+                        try:
+                            self._parity_caches = self._counted(
+                                ("parity_copy",), self._jit_copy,
+                                self._parity_caches,
+                                jnp.asarray(entry.pages[n_full],
+                                            jnp.int32),
+                                jnp.asarray(newp[0], jnp.int32),
+                                jnp.asarray(rem, jnp.int32))
+                        except Exception:
+                            self._parity_caches = None
+                            self.debug_parity = False
                     st.pages.append(newp[0])
                     self._page_table[slot, n_full] = newp[0]
                     self._table_dirty()
@@ -3281,6 +3533,12 @@ class InferenceEngine:
         run."""
         pages = self._pool.alloc(n, self._evict_hook() if reclaim
                                  else None)
+        if pages and self.kv_quant:
+            # every page claimed on a quantized engine will be written
+            # int8 — this is the single allocation choke point, so the
+            # counter covers prefill, decode growth, tail copies and
+            # the speculative soft claim alike
+            self.metrics.count("kv_quant_pages", len(pages))
         if pages and self._pool.dirty:
             tainted = [p for p in pages if p in self._pool.dirty]
             if tainted:
@@ -3450,6 +3708,14 @@ class InferenceEngine:
         pids = jnp.asarray(freed, jnp.int32)
         self._caches = self._place_caches(jax.tree_util.tree_map(
             lambda a: a.at[pids].set(0), self._caches))
+        # quantized pages: a.at[pids].set(0) above zeroed the scale
+        # leaves too (they are ordinary cache leaves) — a scrubbed
+        # page dequantizes to exactly 0.0, never 0·NaN.  The fp32
+        # parity twin mirrors the scrub so the arms stay in lockstep.
+        if self._parity_caches is not None:
+            self._parity_caches = self._place_caches(
+                jax.tree_util.tree_map(lambda a: a.at[pids].set(0),
+                                       self._parity_caches))
         if count:
             self.metrics.count("pages_scrubbed", len(freed))
             fr = _fr_active()
@@ -3553,6 +3819,8 @@ class InferenceEngine:
     def _prefill_full(self, rows, tb):  # guarded-by: _step_lock
         import jax.numpy as jnp
 
+        if not self._quant_write_ok():
+            return
         bb = self.lattice.batch(len(rows))
         toks = onp.zeros((bb, tb), "int32")
         lens = onp.ones((bb,), "int32")
@@ -3571,11 +3839,18 @@ class InferenceEngine:
             [st.request for _s, st in rows], bb))
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
-        first, ok, self._caches = self._run_step(
+        res = self._run_step(
             "serving.prefill", ("prefill", bb, tb), self._jit_prefill,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
              self._caches, jnp.asarray(sidx)) + samp + tbl,
             [st.request for _s, st in rows])
+        first, ok, self._caches = res[0], res[1], res[2]
+        if self._parity_caches is not None:
+            self._parity_mirror(
+                ("parity_prefill", bb, tb), self._jit_parity_prefill,
+                (jnp.asarray(toks), jnp.asarray(lens),
+                 self._parity_caches, jnp.asarray(sidx)) + tbl,
+                res[3], list(range(len(rows))))
         if tr is not None:
             # ONE span for the batched device call, carrying every
             # rider's trace id — each request's timeline includes the
@@ -3604,6 +3879,8 @@ class InferenceEngine:
         program."""
         import jax.numpy as jnp
 
+        if not self._quant_write_ok():
+            return
         take = [min(st.prompt_len - st.filled, self.prefill_chunk)
                 for _s, st in rows]
         tb = self.lattice.seq(max(take))
@@ -3625,12 +3902,20 @@ class InferenceEngine:
             [st.request for _s, st in rows], bb))
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
-        first, ok, self._caches = self._run_step(
+        res = self._run_step(
             "serving.prefill", ("chunk", bb, tb), self._jit_chunk,
             (self._params(), jnp.asarray(toks), jnp.asarray(lens),
              self._caches, jnp.asarray(sidx), jnp.asarray(off)) + samp
             + tbl,
             [st.request for _s, st in rows])
+        first, ok, self._caches = res[0], res[1], res[2]
+        if self._parity_caches is not None:
+            self._parity_mirror(
+                ("parity_chunk", bb, tb), self._jit_parity_chunk,
+                (jnp.asarray(toks), jnp.asarray(lens),
+                 self._parity_caches, jnp.asarray(sidx),
+                 jnp.asarray(off)) + tbl,
+                res[3], list(range(len(rows))))
         if tr is not None:
             tr.record_span(
                 "serving.prefill_chunk", t0, time.monotonic(),
@@ -3649,6 +3934,46 @@ class InferenceEngine:
             st.filled += take[i]
             if st.filled == st.prompt_len:
                 self._finish_prefill(slot, st, int(first[i]))
+
+    def _parity_mirror(self, key, jit_fn, args, logits, rows):  # guarded-by: _step_lock
+        """debug_parity: run the fp32 gather-arm twin over the same
+        tokens/page table and feed the max-abs logit delta of the LIVE
+        rows into the ``kv_quant_error`` histogram — the measured side
+        of the bounded-divergence contract.  The twin is observability,
+        not serving: any twin failure permanently disables parity for
+        this engine instead of ever failing a request."""
+        try:
+            ref, self._parity_caches = self._counted(
+                key, jit_fn, self._params(), *args)
+        except Exception:
+            self._parity_caches = None
+            self.debug_parity = False
+            return
+        if rows:
+            d = onp.abs(onp.asarray(logits, dtype="float32")[rows]
+                        - onp.asarray(ref, dtype="float32")[rows])
+            self.metrics.observe_quant_error(float(d.max()))
+
+    def _quant_write_ok(self) -> bool:  # guarded-by: _step_lock
+        """``serving.kv_quant`` containment (docs/resilience.md): a
+        quantize-write fault makes the batch sit out THIS cycle — the
+        slots, their pages and their table rows are exactly as
+        ``_ensure_pages`` left them (injection fires before any device
+        dispatch, so no page holds a torn int8 write), and the next
+        cycle re-runs the same prefill: a counted recompute, never a
+        half-quantized page.  Inert unless ``kv_quant`` is on."""
+        if not self.kv_quant:
+            return True
+        try:
+            _inject("serving.kv_quant", scope=self.name)
+        except Exception:
+            self.metrics.count("kv_quant_faults")
+            fr = _fr_active()
+            if fr is not None:
+                fr.record("serving.kv_quant", engine=self.name,
+                          outcome="recompute")
+            return False
+        return True
 
     def _finish_prefill(self, slot: int, st: SlotState, token: int):  # guarded-by: _step_lock
         """A request's prefill just completed (full or last chunk).  A
@@ -3743,13 +4068,54 @@ class InferenceEngine:
         parked over its tail page) cannot be scrubbed now: it is marked
         dirty and scrubbed at its next claim, whichever path frees it."""
         written = list(st.pages[st.pages_shared:]) if self._paged else ()
+        tainted: set = set()
+        if self.kv_quant and self._caches is not None and st.pages:
+            # distinguish a poisoned SCALE (serving.kv_scale rot —
+            # detected here, at the first dequant that read it) from
+            # ordinary activation NaN: scan the victim's scale sidecar
+            # host-side for the exact tainted pages.  Tiny arrays,
+            # failure path only.
+            pids = onp.asarray(st.pages, "int32")
+            for layer in self._caches:
+                if "k_scale" not in layer:
+                    break
+                for key in ("k_scale", "v_scale"):
+                    arr = onp.asarray(layer[key][pids])
+                    for pid, page in zip(st.pages, arr):
+                        if not onp.isfinite(page).all():
+                            tainted.add(int(pid))
+            if tainted:
+                self.metrics.count("kv_dequant_faults")
+                fr0 = _fr_active()
+                if fr0 is not None:
+                    fr0.record("serving.kv_scale", engine=self.name,
+                               request=st.request.id,
+                               pages=sorted(tainted),
+                               outcome="tainted")
         freed = self._release(slot)
         if self._paged:
-            self._scrub_pages(freed)
-            # only pages the victim could have WRITTEN (everything past
-            # the shared-in whole prefix pages, which are read-only to
-            # it) can carry its NaN; taint the still-referenced ones
-            self._pool.mark_dirty(set(written) - set(freed))
+            if tainted and self._prefix is not None:
+                # a NaN scale can sit INSIDE a shared page's
+                # [0, length) region — unlike activation NaN, which
+                # only ever lands past ``length`` (the donor-writes-
+                # only-past-length invariant the mark-dirty path leans
+                # on) — so every prefix entry mapping a tainted page is
+                # dropped: the family degrades to a counted recompute
+                # miss instead of failing each future sharer in turn
+                for entry in [e for e in self._prefix._entries
+                              if e.pages
+                              and tainted.intersection(e.pages)]:
+                    self._tier_pending.pop(entry, None)
+                    self._prefix.remove(entry)
+            # scrub whatever is now claimable — the victim's own freed
+            # pages plus tainted pages the entry drops just released;
+            # still-referenced ones (a live sharer mid-read, who fails
+            # typed here too when it reads the NaN) go dirty and are
+            # scrubbed at their next claim
+            scrub = set(freed) | {p for p in tainted
+                                  if self._pool._refs[p] == 0}
+            self._scrub_pages(sorted(scrub))
+            self._pool.mark_dirty((set(written) | tainted) - scrub)
         elif self._caches is not None:
             import jax
             self._caches = self._place_caches(jax.tree_util.tree_map(
@@ -3822,11 +4188,18 @@ class InferenceEngine:
         tbl = (self._table_arg(),) if self._paged else ()
         tr = _trace_active()
         t0 = time.monotonic() if tr is not None else 0.0
-        nxt, ok, self._caches = self._run_step(
+        res = self._run_step(
             "serving.decode_step", ("decode",), self._jit_step,
             (self._params(), jnp.asarray(tok), self._caches,
              jnp.asarray(pos))
             + tuple(jnp.asarray(a) for a in samp) + tbl, riders)
+        nxt, ok, self._caches = res[0], res[1], res[2]
+        if self._parity_caches is not None:
+            self._parity_mirror(
+                ("parity_decode",), self._jit_parity_step,
+                (jnp.asarray(tok), self._parity_caches,
+                 jnp.asarray(pos)) + tbl,
+                res[3], [s for s, _st in slot_riders])
         if tr is not None:
             tr.record_span(
                 "serving.decode_step", t0, time.monotonic(),

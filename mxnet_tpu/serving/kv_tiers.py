@@ -119,7 +119,8 @@ class HostKVTier:
 
     def __init__(self, host_pool_bytes: int, *, page_size: int,
                  fault_limit: int = 3, disk_dir: Optional[str] = None,
-                 scope: str = "serving", metrics=None):
+                 scope: str = "serving", metrics=None,
+                 kv_quant: Optional[str] = None):
         if host_pool_bytes <= 0:
             raise ServingError(
                 f"host_pool_bytes must be > 0 to enable the tier, got "
@@ -128,6 +129,12 @@ class HostKVTier:
             raise ServingError(f"page_size must be >= 1, got {page_size}")
         self.host_pool_bytes = int(host_pool_bytes)
         self.page_size = int(page_size)
+        # the owning engine's KV storage arm: stamped on every sealed
+        # seed and checked on promote, so a disk-tier seed from a run
+        # with the other arm reads as a miss instead of installing
+        # int8 codes where the engine expects fp payload (or scales
+        # where it expects none)
+        self.kv_quant = kv_quant
         self.fault_limit = max(1, int(fault_limit))
         self.disk_dir = disk_dir
         self.scope = scope
@@ -401,7 +408,8 @@ class HostKVTier:
             return
         seed = PrefixSeed(source=self.scope, layout="paged",
                           page_size=self.page_size, tokens=list(key),
-                          length=int(length), arrays=arrays)
+                          length=int(length), arrays=arrays,
+                          kv_quant=self.kv_quant)
         seed.digest = seed_digest(seed)
         # post-seal rot injection (state fault, never raises): flips
         # bytes in the sealed payload so verify-on-promote is what has
@@ -504,6 +512,13 @@ class HostKVTier:
                 self._count("tier_misses")
                 return
             verify_seed(seed)           # BEFORE any device byte moves
+            if getattr(seed, "kv_quant", None) != self.kv_quant:
+                # valid seal, wrong storage arm (a disk seed from a run
+                # with the other kv_quant setting): treat like a
+                # foreign schema — never reinterpret the payload
+                raise MigrationError(
+                    f"prefix seed kv_quant={getattr(seed, 'kv_quant', None)!r}"
+                    f" != tier kv_quant={self.kv_quant!r}")
             # hand back the verified HOST arrays: the engine's fused
             # install scatter uploads every leaf in one dispatch, so a
             # per-leaf H2D here would only add a device round-trip per

@@ -192,7 +192,16 @@ class ServingMetrics:
                  "tier_demotes", "tier_promotes", "tier_hits",
                  "tier_misses", "tier_verify_failures", "tier_evictions",
                  "tier_faults", "tier_drops", "tier_disk_spills",
-                 "tier_disk_loads", "tier_quarantines")
+                 "tier_disk_loads", "tier_quarantines",
+                 # quantized KV pages (docs/serving.md "Quantized KV +
+                 # paged attention kernel"): pages claimed for int8
+                 # storage, contained serving.kv_quant quantize-write
+                 # faults (each degrades to a counted recompute next
+                 # cycle), and poisoned-scale detections at dequant
+                 # (the page is tainted via the dirty-page scrub path,
+                 # never served)
+                 "kv_quant_pages", "kv_quant_faults",
+                 "kv_dequant_faults")
 
     def __init__(self, name: str = "serving", register: bool = True):
         self.name = name
@@ -210,6 +219,13 @@ class ServingMetrics:
         # adopt-side install)
         self.migrations_by = {}      # (direction, outcome) -> count
         self.migration = LatencyHistogram()
+        # quantized KV divergence: max-abs logit delta of each sampled
+        # step vs the fp32 reference arm (debug_parity= on).  The
+        # bounds cover float32-epsilon noise up to an outright-broken
+        # 1e3 delta — the divergence CONTRACT is asserted by tests/
+        # bench against this histogram's max.
+        self.kv_quant_error = LatencyHistogram(lo=1e-9, hi=1e3,
+                                               buckets_per_decade=2)
         self.queue = LatencyHistogram()
         self.prefill = LatencyHistogram()
         self.decode = LatencyHistogram()
@@ -283,6 +299,9 @@ class ServingMetrics:
                     {"engine": self.name, "phase": phase}))
             samples.append(histogram_sample(
                 "mxtpu_serving_ttft_seconds", self.ttft, eng))
+            samples.append(histogram_sample(
+                "mxtpu_serving_kv_quant_error", self.kv_quant_error,
+                eng))
         return samples
 
     # ------------------------------------------------------------- counters
@@ -314,6 +333,12 @@ class ServingMetrics:
         """Latency of one accepted handoff, export through adopt."""
         with self._lock:
             self.migration.observe(seconds)
+
+    def observe_quant_error(self, delta: float):
+        """Max-abs logit delta of one sampled step vs the fp32
+        reference arm (``debug_parity=`` on)."""
+        with self._lock:
+            self.kv_quant_error.observe(delta)
 
     # ---------------------------------------------------------- estimators
     def latency_estimates(self, min_count: int = 8):
@@ -374,6 +399,9 @@ class ServingMetrics:
             served_by = dict(self.served_by)
             migrations_by = dict(self.migrations_by)
             migration_lat = self.migration.summary()
+            quant_err = {"count": self.kv_quant_error.total,
+                         "max": self.kv_quant_error.max,
+                         "p99": self.kv_quant_error.percentile(99)}
             lat = {"queue": self.queue.summary(),
                    "prefill": self.prefill.summary(),
                    "decode": self.decode.summary(),
@@ -459,6 +487,15 @@ class ServingMetrics:
                 "preempt_resumes": c["preempt_resumes"],
                 "brownouts": c["brownouts"],
                 "overload_faults": c["overload_faults"],
+            },
+            # quantized KV pages (docs/serving.md "Quantized KV +
+            # paged attention kernel"); error is the debug_parity
+            # divergence histogram (raw logit units, NOT seconds)
+            "quantized_kv": {
+                "kv_quant_pages": c["kv_quant_pages"],
+                "kv_quant_faults": c["kv_quant_faults"],
+                "kv_dequant_faults": c["kv_dequant_faults"],
+                "error": quant_err,
             },
             "resilience": {k: c[k] for k in
                            ("retries", "watchdog_trips",

@@ -44,7 +44,9 @@ __all__ = ["MigrationBundle", "MIGRATION_SCHEMA_VERSION",
 
 #: bump when the bundle field layout changes — adopt() refuses bundles
 #: from a different schema instead of misinterpreting them
-MIGRATION_SCHEMA_VERSION = 1
+#: (v2: quantized KV — bundles declare ``kv_quant`` so an int8 page
+#: gather can never be reinterpreted as fp32 payload, or vice versa)
+MIGRATION_SCHEMA_VERSION = 2
 
 
 class MigrationBundle:
@@ -55,11 +57,11 @@ class MigrationBundle:
     for dense.  Everything else is plain scalars/lists, so the bundle
     pickles cleanly across process boundaries."""
 
-    __slots__ = ("schema", "source", "layout", "page_size", "prompt",
-                 "prompt_len", "first_token", "max_new_tokens", "eos_id",
-                 "deadline", "priority", "temperature", "top_k", "top_p",
-                 "seed", "n_pages", "arrays", "trace_id", "route_hint",
-                 "digest")
+    __slots__ = ("schema", "source", "layout", "page_size", "kv_quant",
+                 "prompt", "prompt_len", "first_token", "max_new_tokens",
+                 "eos_id", "deadline", "priority", "temperature", "top_k",
+                 "top_p", "seed", "n_pages", "arrays", "trace_id",
+                 "route_hint", "digest")
 
     def __init__(self, *, source: str, layout: str, page_size: int,
                  prompt, first_token: int, max_new_tokens: int,
@@ -68,11 +70,18 @@ class MigrationBundle:
                  top_p: float, seed: int, n_pages: int,
                  arrays: List[onp.ndarray],
                  trace_id: Optional[str] = None,
-                 route_hint: Optional[bytes] = None):
+                 route_hint: Optional[bytes] = None,
+                 kv_quant: Optional[str] = None):
         self.schema = MIGRATION_SCHEMA_VERSION
         self.source = source
         self.layout = layout
         self.page_size = int(page_size)
+        # KV storage dtype contract (None = fp-native, 'int8' = int8
+        # pages + fp32 scale sidecars interleaved in leaf order).  A
+        # header field, not an inference from dtypes: digest-pinned so
+        # the importing engine refuses a mismatched arm instead of
+        # scattering scales into payload pages.
+        self.kv_quant = kv_quant
         self.prompt = onp.asarray(prompt, "int32")
         self.prompt_len = int(self.prompt.shape[0])
         self.first_token = int(first_token)
@@ -112,9 +121,10 @@ def _header_bytes(b: MigrationBundle) -> bytes:
     so the digest pins metadata and data together: a bundle whose
     arrays were swapped or whose position/seed was edited mismatches
     just like flipped payload bits."""
-    head = (b.schema, b.layout, b.page_size, b.prompt_len, b.first_token,
-            b.max_new_tokens, b.eos_id, b.priority, b.temperature,
-            b.top_k, b.top_p, b.seed, b.n_pages, b.route_hint,
+    head = (b.schema, b.layout, b.page_size, b.kv_quant, b.prompt_len,
+            b.first_token, b.max_new_tokens, b.eos_id, b.priority,
+            b.temperature, b.top_k, b.top_p, b.seed, b.n_pages,
+            b.route_hint,
             tuple((tuple(a.shape), str(a.dtype)) for a in b.arrays))
     return repr(head).encode()
 
@@ -182,7 +192,8 @@ def export_bundle(eng, slot: int, st, first_token: int) -> MigrationBundle:
         deadline=req.deadline, priority=req.priority,
         temperature=req.temperature, top_k=req.top_k, top_p=req.top_p,
         seed=req.seed, n_pages=n_pages, arrays=arrays,
-        trace_id=req.trace_id, route_hint=req.route_hint)
+        trace_id=req.trace_id, route_hint=req.route_hint,
+        kv_quant=eng.kv_quant)
     b.digest = bundle_digest(b)
     return b
 
@@ -191,7 +202,9 @@ def export_bundle(eng, slot: int, st, first_token: int) -> MigrationBundle:
 
 #: bump when the seed field layout changes — seed_prefix() refuses
 #: seeds from a different schema instead of misinterpreting them
-PREFIX_SEED_SCHEMA_VERSION = 1
+#: (v2: quantized KV — seeds declare ``kv_quant``; the scale sidecars
+#: ride ``arrays`` like any other leaf and are digest-sealed with it)
+PREFIX_SEED_SCHEMA_VERSION = 2
 
 
 class PrefixSeed:
@@ -209,15 +222,17 @@ class PrefixSeed:
     by :func:`verify_seed` on the importing side BEFORE any row or
     page is claimed."""
 
-    __slots__ = ("schema", "source", "layout", "page_size", "tokens",
-                 "length", "arrays", "digest")
+    __slots__ = ("schema", "source", "layout", "page_size", "kv_quant",
+                 "tokens", "length", "arrays", "digest")
 
     def __init__(self, *, source: str, layout: str, page_size: int,
-                 tokens, length: int, arrays: List[onp.ndarray]):
+                 tokens, length: int, arrays: List[onp.ndarray],
+                 kv_quant: Optional[str] = None):
         self.schema = PREFIX_SEED_SCHEMA_VERSION
         self.source = source
         self.layout = layout
         self.page_size = int(page_size)
+        self.kv_quant = kv_quant
         self.tokens = onp.asarray(tokens, "int32")
         self.length = int(length)
         self.arrays = arrays
@@ -234,7 +249,7 @@ class PrefixSeed:
 
 
 def _seed_header_bytes(s: PrefixSeed) -> bytes:
-    head = (s.schema, s.layout, s.page_size, s.length,
+    head = (s.schema, s.layout, s.page_size, s.kv_quant, s.length,
             tuple((tuple(a.shape), str(a.dtype)) for a in s.arrays))
     return repr(head).encode()
 

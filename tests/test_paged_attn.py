@@ -1,0 +1,466 @@
+"""Quantized int8 KV pages + the Pallas paged-attention kernel
+(docs/serving.md "Quantized KV + paged attention kernel").
+
+Contracts under test: ``ops.paged.paged_attention`` matches a dense
+masked-softmax reference page-for-page (fp32 AND int8, interpret mode
+— the same kernel body TPU compiles); the engine's 'kernel' read arm
+is TOKEN-IDENTICAL to the 'gather' reference arm and to
+``net.generate`` at fp32, through full, chunked and shared-prefix
+prefill; the int8 arm holds the bounded-divergence contract measured
+by the ``debug_parity`` fp32 twin; ``kv_quant`` is a digest-pinned
+schema field — cross-arm seeds/bundles are refused at ``seed_prefix``
+/ ``adopt`` / tier promote, never reinterpreted; the
+``serving.kv_quant`` fault degrades to a counted recompute and a
+``serving.kv_scale`` poison fails exactly its victim typed, drops any
+prefix entry over a tainted page, and leaves the pool finite; the
+compile counter freezes after ``warmup()`` on every arm.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_gpt2
+from mxnet_tpu.ops.paged import kv_dequantize, kv_quantize, paged_attention
+from mxnet_tpu.serving import (InferenceEngine, NonFiniteOutputError,
+                               ServingError)
+from mxnet_tpu.serving.migration import (MigrationBundle, MigrationError,
+                                         bundle_digest)
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(0)
+    n = get_gpt2("gpt2_124m", vocab_size=97, units=32, num_layers=2,
+                 num_heads=4, max_length=64, dropout=0.0)
+    n.initialize()
+    return n
+
+
+def _prompts(lens, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [rs.randint(0, 97, (l,)).astype("int32") for l in lens]
+
+
+def _refs(net, prompts, max_new):
+    return [net.generate(mx.nd.array(p[None], dtype="int32"), max_new,
+                         temperature=0).asnumpy()[0] for p in prompts]
+
+
+def _paged(net, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("seq_buckets", (8, 16))
+    kw.setdefault("default_max_new_tokens", 8)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    return InferenceEngine(net, **kw)
+
+
+def _assert_pool_finite(eng):
+    """Every real page of every leaf (scales included) is finite and
+    the zero page is exactly zero — the invariant every fault test
+    ends on."""
+    import jax.numpy as jnp
+    n = eng.num_pages
+    for layer in eng._caches:
+        for a in layer.values():
+            af = a.astype(jnp.float32)
+            assert bool(jnp.isfinite(af[:n]).all())
+            assert bool((af[n] == 0).all())
+
+
+# ------------------------------------------------------- quantization unit
+
+def test_kv_quantize_roundtrip_bounded_and_zero_exact():
+    rs = onp.random.RandomState(3)
+    x = (rs.randn(6, 8, 4, 16) * rs.gamma(1.0, 2.0, (6, 8, 4, 1))
+         ).astype("float32")
+    x[2] = 0.0                        # an all-zero page (the zero page)
+    q, s = kv_quantize(x)
+    assert onp.asarray(q).dtype == onp.int8
+    assert onp.asarray(s).shape == (6, 8, 4, 1)
+    dq = onp.asarray(kv_dequantize(q, s))
+    # symmetric round-to-nearest: error <= scale/2 per element
+    assert onp.all(onp.abs(dq - x) <= onp.asarray(s) * 0.5 + 1e-7)
+    # the zero page is EXACT, not epsilon: q=0 under the scale floor
+    onp.testing.assert_array_equal(dq[2], onp.zeros_like(dq[2]))
+
+
+# ----------------------------------------------------------- kernel unit
+
+def _ref_attention(q, kp, vp, table, qpos, scale):
+    """Dense gather + masked softmax — the arithmetic the kernel's
+    online softmax must reproduce."""
+    b, tq, h, d = q.shape
+    ps = kp.shape[1]
+    out = onp.zeros((b, tq, h, d), "float32")
+    for s in range(b):
+        k = kp[table[s]].reshape(-1, h, d).astype("float32")
+        v = vp[table[s]].reshape(-1, h, d).astype("float32")
+        keep = onp.arange(k.shape[0])
+        for t in range(tq):
+            m = keep <= qpos[s, t]
+            for hh in range(h):
+                sc = (q[s, t, hh].astype("float32") @ k[:, hh].T) * scale
+                sc = onp.where(m, sc, -onp.inf)
+                w = onp.exp(sc - sc.max())
+                w = w / w.sum()
+                out[s, t, hh] = w @ v[:, hh]
+    return out
+
+
+@pytest.mark.parametrize("b,tq", [(1, 1), (3, 1), (2, 8)])
+def test_kernel_matches_reference_fp32(b, tq):
+    rs = onp.random.RandomState(11 + b * 10 + tq)
+    npages, ps, h, d, p = 7, 8, 4, 16, 4
+    kp = rs.randn(npages, ps, h, d).astype("float32")
+    vp = rs.randn(npages, ps, h, d).astype("float32")
+    kp[-1] = vp[-1] = 0.0             # the never-written zero page
+    q = rs.randn(b, tq, h, d).astype("float32")
+    table = rs.randint(0, npages - 1, (b, p)).astype("int32")
+    # absolute query positions: a ragged batch, some rows deep into
+    # their pages, some barely started (pages past qmax predicated out)
+    base = rs.randint(0, p * ps - tq, (b,))
+    qpos = (base[:, None] + onp.arange(tq)[None, :]).astype("int32")
+    out = onp.asarray(paged_attention(q, kp, vp, table, qpos))
+    ref = _ref_attention(q, kp, vp, table, qpos, 1.0 / d ** 0.5)
+    onp.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_int8_matches_xla_dequant_path():
+    """The fused in-kernel dequant and the XLA gather-arm dequant are
+    the SAME arithmetic: kernel(int8 pages + scales) == kernel(pages
+    dequantized up front)."""
+    rs = onp.random.RandomState(5)
+    npages, ps, h, d, b, p = 5, 8, 4, 16, 3, 3
+    kf = rs.randn(npages, ps, h, d).astype("float32") * 3.0
+    vf = rs.randn(npages, ps, h, d).astype("float32") * 3.0
+    kf[-1] = vf[-1] = 0.0
+    kq, ks = kv_quantize(kf)
+    vq, vs = kv_quantize(vf)
+    q = rs.randn(b, 1, h, d).astype("float32")
+    table = rs.randint(0, npages - 1, (b, p)).astype("int32")
+    qpos = rs.randint(0, p * ps, (b, 1)).astype("int32")
+    fused = onp.asarray(paged_attention(
+        q, kq, vq, table, qpos, k_scale=ks, v_scale=vs))
+    unfused = onp.asarray(paged_attention(
+        q, onp.asarray(kv_dequantize(kq, ks)),
+        onp.asarray(kv_dequantize(vq, vs)), table, qpos))
+    onp.testing.assert_allclose(fused, unfused, rtol=2e-5, atol=2e-5)
+    # int8 pages without their scales are not interpretable
+    with pytest.raises(ValueError):
+        paged_attention(q, kq, vq, table, qpos)
+
+
+def test_kernel_zero_page_rows_stay_finite():
+    """A parked slot's table maps every entry to the zero page: the
+    output is garbage by contract but must be FINITE (the engine's
+    NaN-guard would otherwise condemn healthy requests)."""
+    rs = onp.random.RandomState(7)
+    npages, ps, h, d = 3, 8, 2, 16
+    kp = rs.randn(npages, ps, h, d).astype("float32")
+    vp = rs.randn(npages, ps, h, d).astype("float32")
+    kp[-1] = vp[-1] = 0.0
+    q = rs.randn(2, 1, h, d).astype("float32")
+    table = onp.full((2, 2), npages - 1, "int32")
+    qpos = onp.zeros((2, 1), "int32")
+    out = onp.asarray(paged_attention(q, kp, vp, table, qpos))
+    assert onp.isfinite(out).all()
+
+
+# ------------------------------------------------------- engine: read arms
+
+def test_kernel_arm_token_identical_to_gather_and_model(net):
+    """fp32, both read arms, mixed-length traffic: kernel == gather ==
+    net.generate token-for-token, and the kernel arm's compile counter
+    freezes after warmup."""
+    prompts = _prompts((3, 5, 9, 12, 5, 7, 16, 2))
+    refs = _refs(net, prompts, 8)
+    outs = {}
+    for arm in ("gather", "kernel"):
+        eng = _paged(net, paged_attention=arm)
+        assert eng.stats()["quantized_kv"]["paged_attention"] == arm
+        n_warm = eng.warmup()
+        assert n_warm <= 2 * len(eng.lattice) + 2
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs[arm] = [f.result(timeout=120) for f in futs]
+        assert eng.stats()["compile_cache"]["compiles"] == n_warm
+    for r, g, k in zip(refs, outs["gather"], outs["kernel"]):
+        onp.testing.assert_array_equal(r, g)
+        onp.testing.assert_array_equal(r, k)
+
+
+def test_kernel_arm_chunked_prefill_and_prefix_sharing(net):
+    """The kernel arm through the two prefill paths the gather arm
+    owns today: a prompt longer than the largest bucket (chunked, with
+    offset) and a shared-prefix family (pages entering by reference)."""
+    long = _prompts((40,), seed=9)[0]
+    ref_long = _refs(net, [long], 5)[0]
+    rs = onp.random.RandomState(21)
+    shared = rs.randint(0, 97, (18,)).astype("int32")
+    fam = [onp.concatenate([shared, rs.randint(0, 97, (4,)).astype("int32")])
+           for _ in range(3)]
+    ref_fam = _refs(net, fam, 4)
+    eng = _paged(net, num_slots=2, max_batch=2, paged_attention="kernel",
+                 prefix_min_tokens=8)
+    eng.warmup()
+    with eng:
+        onp.testing.assert_array_equal(ref_long,
+                                       eng.infer(long, max_new_tokens=5))
+        for p, r in zip(fam, ref_fam):
+            onp.testing.assert_array_equal(r, eng.infer(p, max_new_tokens=4))
+        s = eng.stats()
+    assert s["batches"]["prefill_chunks"] >= 2
+    assert s["prefix_cache"]["prefix_hits"] >= 1
+    assert s["prefix_cache"]["prefix_tokens_saved"] >= 16
+
+
+# --------------------------------------------- engine: int8 + divergence
+
+def test_int8_divergence_contract_and_parity_histogram(net):
+    """The quantized arm under the measured contract: the debug_parity
+    fp32 twin runs the same tokens and the max-abs logit delta lands
+    in the kv_quant_error histogram, bounded; fp32 under the same twin
+    reads numerically-zero divergence.  Greedy tokens at this scale
+    stay EXACT through the horizon (the first decode steps), where a
+    quantization flip would otherwise compound."""
+    prompts = _prompts((5, 11, 17, 3), seed=4)
+    refs = _refs(net, prompts, 8)
+    horizon = 2
+    for quant, bound in ((None, 1e-4), ("int8", 0.05)):
+        eng = _paged(net, kv_quant=quant, paged_attention="kernel",
+                     debug_parity=True, prefix_min_tokens=64)
+        n_warm = eng.warmup()
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        s = eng.stats()
+        assert s["compile_cache"]["compiles"] == n_warm
+        err = s["quantized_kv"]["error"]
+        assert err["count"] >= len(prompts)
+        assert err["max"] <= bound
+        for r, o, p in zip(refs, outs, prompts):
+            if quant is None:
+                onp.testing.assert_array_equal(r, o)
+            else:
+                # exact-match horizon: int8 may legitimately flip a
+                # greedy tie deep into decode, never this early
+                onp.testing.assert_array_equal(
+                    r[:len(p) + horizon], o[:len(p) + horizon])
+        if quant == "int8":
+            assert s["quantized_kv"]["kv_quant_pages"] >= 1
+        _assert_pool_finite(eng)
+
+
+def test_int8_halves_kv_bytes_per_token(net):
+    """The density signal the quantized arm is bought for: the
+    mxtpu_serving_kv_bytes_per_token gauge (scale sidecars INCLUDED)
+    drops below half of the fp32 arm's."""
+    from mxnet_tpu.observability import default_registry
+    per = {}
+    for quant, name in ((None, "qbytes_fp32"), ("int8", "qbytes_int8")):
+        eng = _paged(net, kv_quant=quant, paged_attention="kernel",
+                     name=name)
+        eng.warmup()
+        snap = default_registry().collect()
+        vals = [s["value"] for s in snap["samples"]
+                if s["name"] == "mxtpu_serving_kv_bytes_per_token"
+                and s["labels"].get("engine") == name]
+        assert len(vals) == 1 and vals[0] > 0
+        per[name] = vals[0]
+    assert per["qbytes_int8"] <= 0.5 * per["qbytes_fp32"]
+
+
+def test_knob_validation_is_typed(net):
+    with pytest.raises(ServingError):
+        _paged(net, kv_quant="int4")
+    with pytest.raises(ServingError):
+        InferenceEngine(net, num_slots=2, max_batch=2, seq_buckets=(8,),
+                        kv_quant="int8")          # dense IS the fp32 arm
+    with pytest.raises(ServingError):
+        _paged(net, paged_attention="fast")
+    with pytest.raises(ServingError):
+        InferenceEngine(net, num_slots=2, max_batch=2, seq_buckets=(8,),
+                        paged_attention="kernel")  # paged layouts only
+    with pytest.raises(ServingError):
+        # the Pallas call is not GSPMD-partitionable: kernel + mesh is
+        # refused at construction, never an XLA error mid-warmup
+        _paged(net, paged_attention="kernel", mesh=1, mesh_axes=("mp",))
+
+
+# ------------------------------------------------------------ fault sites
+
+def test_quant_write_fault_is_counted_recompute(net):
+    """serving.kv_quant: the faulted cycle sits out, the SAME prefill
+    re-runs next cycle — tokens identical, one counted fault, zero new
+    compiles, no torn int8 page."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((4, 9, 6, 13), seed=8)
+    refs = _refs(net, prompts, 6)
+    eng = _paged(net, kv_quant="int8", paged_attention="kernel",
+                 prefix_min_tokens=64)
+    n_warm = eng.warmup()
+    plan = FaultPlan().raise_at("serving.kv_quant", at=1)
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+    assert plan.fired("serving.kv_quant") == 1
+    s = eng.stats()
+    assert s["quantized_kv"]["kv_quant_faults"] == 1
+    assert s["compile_cache"]["compiles"] == n_warm
+    for r, o in zip(refs, outs):
+        onp.testing.assert_array_equal(r, o)
+    _assert_pool_finite(eng)
+
+
+def test_scale_poison_fails_victim_typed_pool_stays_clean(net):
+    """serving.kv_scale: a NaN spliced into one claimed page's scale
+    sidecar fails exactly that request typed (NO retry — the repo's
+    one-NaN-is-that-request's-problem contract), survivors are
+    token-identical, and every scale leaf is finite afterwards."""
+    from mxnet_tpu.resilience import FaultPlan
+    prompts = _prompts((4, 9, 6, 13), seed=8)
+    refs = _refs(net, prompts, 6)
+    eng = _paged(net, kv_quant="int8", paged_attention="kernel",
+                 prefix_min_tokens=64)
+    n_warm = eng.warmup()
+    plan = FaultPlan().nonfinite_at("serving.kv_scale", at=1)
+    with plan:
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs, typed = [], 0
+            for f in futs:
+                try:
+                    outs.append(f.result(timeout=120))
+                except NonFiniteOutputError:
+                    outs.append(None)
+                    typed += 1
+            live = eng.health()["live"]
+    assert live
+    assert plan.fired("serving.kv_scale") == 1
+    assert typed == 1
+    for r, o in zip(refs, outs):
+        if o is not None:
+            onp.testing.assert_array_equal(r, o)
+    s = eng.stats()
+    assert s["quantized_kv"]["kv_dequant_faults"] >= 1
+    assert s["compile_cache"]["compiles"] == n_warm
+    _assert_pool_finite(eng)
+
+
+def test_scale_poison_drops_prefix_entry_over_tainted_page(net):
+    """The containment case the dirty-page path alone cannot cover: a
+    prefix DONOR's entry holds by-reference claims on the poisoned
+    page, INSIDE its shared [0, length) region.  The entry must drop
+    with the victim — a later family member recomputes clean instead
+    of reading NaN through the share."""
+    from mxnet_tpu.resilience import FaultPlan
+    rs = onp.random.RandomState(31)
+    shared = rs.randint(0, 97, (12,)).astype("int32")   # 1.5 pages
+    fam = [onp.concatenate([shared, rs.randint(0, 97, (3,)).astype("int32")])
+           for _ in range(2)]
+    refs = _refs(net, fam, 4)
+    eng = _paged(net, num_slots=2, max_batch=2, kv_quant="int8",
+                 paged_attention="kernel", prefix_min_tokens=4)
+    eng.warmup()
+    plan = FaultPlan().nonfinite_at("serving.kv_scale", at=1)
+    with plan:
+        with eng:
+            # donor: its prefill inserts the family entry, then the
+            # poison lands on its tail page -> fails typed, entry drops
+            with pytest.raises(NonFiniteOutputError):
+                eng.infer(fam[0], max_new_tokens=4)
+            s_mid = eng.stats()
+            assert s_mid["prefix_cache"]["prefix_inserts"] >= 1
+            _assert_pool_finite(eng)
+            # the family's second member: full recompute, clean tokens
+            onp.testing.assert_array_equal(
+                refs[1], eng.infer(fam[1], max_new_tokens=4))
+    assert eng.stats()["quantized_kv"]["kv_dequant_faults"] >= 1
+    _assert_pool_finite(eng)
+
+
+# ------------------------------------------- cross-arm schema refusals
+
+def test_seed_prefix_refuses_cross_arm_accepts_same_arm(net):
+    """kv_quant is a digest-pinned PrefixSeed header: an int8 engine's
+    seeds plant into another int8 engine and are REFUSED typed by an
+    fp32 engine — KV bytes never reinterpret across storage arms."""
+    rs = onp.random.RandomState(41)
+    shared = rs.randint(0, 97, (16,)).astype("int32")
+    fam = [onp.concatenate([shared, rs.randint(0, 97, (3,)).astype("int32")])
+           for _ in range(2)]
+    donor = _paged(net, kv_quant="int8", paged_attention="kernel",
+                   prefix_min_tokens=4, name="seed_donor")
+    donor.warmup()
+    with donor:
+        for p in fam:
+            donor.infer(p, max_new_tokens=4)
+        seeds = donor.export_prefix_seeds()
+    assert seeds and all(s.kv_quant == "int8" for s in seeds)
+    same = _paged(net, kv_quant="int8", paged_attention="kernel",
+                  prefix_min_tokens=4, name="seed_same")
+    same.warmup()
+    assert same.seed_prefix(seeds[0]) is True
+    other = _paged(net, prefix_min_tokens=4, name="seed_other")
+    other.warmup()
+    with pytest.raises(MigrationError, match="kv_quant"):
+        other.seed_prefix(seeds[0])
+
+
+def _bundle(eng, kv_quant):
+    b = MigrationBundle(
+        source="elsewhere", layout="paged", page_size=eng.page_size,
+        prompt=onp.arange(4, dtype="int32"), first_token=1,
+        max_new_tokens=2, eos_id=None, deadline=None, priority=1,
+        temperature=0.0, top_k=0, top_p=1.0, seed=0, n_pages=1,
+        arrays=[onp.zeros((1, eng.page_size, 4, 8), "float32")],
+        kv_quant=kv_quant)
+    b.digest = bundle_digest(b)
+    return b
+
+
+def test_adopt_refuses_cross_arm_and_parity_engines(net):
+    """Same contract at the migration ingress: a digest-valid bundle
+    from the other storage arm is refused BEFORE any claim, and a
+    debug_parity engine refuses adoption outright (adopted K/V has no
+    twin-side history)."""
+    eng = _paged(net, num_slots=2, max_batch=2)
+    eng.warmup()
+    with pytest.raises(MigrationError, match="kv_quant"):
+        eng.adopt(_bundle(eng, "int8"))
+    par = _paged(net, num_slots=2, max_batch=2, debug_parity=True,
+                 prefix_min_tokens=64, name="adopt_parity")
+    par.warmup()
+    with pytest.raises(MigrationError, match="debug_parity"):
+        par.adopt(_bundle(par, None))
+
+
+def test_tier_promote_refuses_cross_arm_seed_as_counted_miss():
+    """A sealed host-RAM seed from the OTHER kv_quant arm (a disk
+    spill from a differently-configured run) fails promote like a
+    foreign schema: dropped + counted miss, never reinterpreted."""
+    from mxnet_tpu.serving.kv_tiers import HostKVTier
+    rs = onp.random.RandomState(51)
+    arrs = [rs.rand(2, 4, 2, 3).astype("float32") for _ in range(4)]
+    t = HostKVTier(1 << 20, page_size=4, scope="qx_arm",
+                   kv_quant="int8").start()
+    try:
+        key = tuple(range(7))
+        assert t.offer(key, arrs, 7)
+        t.drain()
+        assert t.contains(key)
+        # the same bytes read back by a tier running the OTHER arm
+        t.kv_quant = None
+        h = t.request(key)
+        t.drain()
+        status, out = t.poll(h)
+        assert status == "failed" and out is None
+        assert not t.contains(key)
+        assert t.counter("tier_verify_failures") == 1
+        assert t.counter("tier_misses") >= 1
+        assert t.counter("tier_promotes") == 0
+    finally:
+        t.stop()

@@ -173,6 +173,7 @@ def serving_scenarios(net):
         ("prefix_storm", lambda: serving_prefix_storm(net)),
         ("paged_storm", lambda: serving_paged_storm(net)),
         ("spill_storm", lambda: serving_spill_storm(net)),
+        ("quant_storm", lambda: serving_quant_storm(net)),
         ("spec_storm", serving_spec_storm),
         ("sharded_parity", lambda: serving_sharded_parity(net)),
         ("exporter_storm", lambda: serving_exporter_storm(net)),
@@ -715,6 +716,117 @@ def serving_spill_storm(net):
                    "tier_enabled": tier_enabled,
                    "tier": t,
                    "prefix": s["prefix_cache"],
+                   "compiles_warmup": n_warm,
+                   "compiles_total": s["compile_cache"]["compiles"],
+                   "faults_fired": plan.fired()},
+    }
+
+
+def serving_quant_storm(net):
+    """Quantized-KV chaos (docs/serving.md "Quantized KV + paged
+    attention kernel"): an int8 paged engine on the Pallas kernel arm,
+    page pool at ONE page of headroom, shared-prefix families cycling
+    through the host tier (int8 pages + fp32 scale sidecars demote and
+    promote through the digest-sealed bundle path), while a fault
+    aborts one quantize-on-write prefill AND every 3rd decode-cycle
+    claim NaN-poisons a live page's scale sidecar.  Invariants: ZERO
+    tokens beyond contract (every completer is token-identical to the
+    same int8 engine run fault-free — the divergence contract between
+    int8 and fp32 is the bench/test layer's job; chaos asserts the
+    storm itself changes nothing), zero stranded futures (scale-poison
+    victims fail TYPED via the in-graph NaN guard, detected at the
+    first dequant that read the rot), the quantize fault degraded to a
+    counted recompute, demotions and promotions of int8 bundles both
+    happened, the device pool ends pristine (codes and scales finite
+    everywhere, the sentinel zero page — payload AND scales — still
+    zero), and the storm compiled NOTHING after warmup."""
+    import numpy as onp
+
+    from mxnet_tpu.resilience import FaultPlan
+    from mxnet_tpu.serving import NonFiniteOutputError
+
+    rs = onp.random.RandomState(9)
+    # 4 families of 13-token prompts (10 shared + 3 tail) at page_size
+    # 8 => 2 pages each; 2 slots x 2 pages against a 5-page pool is one
+    # page of headroom, so waves evict-and-demote continuously
+    families = [rs.randint(0, 61, (10,)).astype("int32") for _ in range(4)]
+    waves = [[onp.concatenate([fam, rs.randint(0, 61, (3,)).astype("int32")])
+              for fam in families]
+             for _ in range(3)]
+    kw = dict(num_slots=2, max_batch=2, kv_layout="paged", page_size=8,
+              num_pages=5, prefix_min_tokens=2, kv_quant="int8",
+              paged_attention="kernel", host_pool_bytes=32 << 20,
+              tier_fault_limit=4)
+    # the int8 reference arm: the SAME engine config run fault-free
+    # (int8 may legitimately diverge from fp32 net.generate at greedy
+    # decision boundaries — the contract here is storm-invariance)
+    refs = {}
+    ref_eng = _engine(net, **kw)
+    ref_eng.warmup()
+    with ref_eng:
+        for wave in waves:
+            futs = [ref_eng.submit(p, max_new_tokens=3) for p in wave]
+            for p, f in zip(wave, futs):
+                refs[p.tobytes()] = f.result(timeout=60)
+    _join_zombies()
+    plan = (FaultPlan()
+            .raise_at("serving.kv_quant", at=2)
+            .nonfinite_at("serving.kv_scale", every=3))
+    eng = _engine(net, **kw)
+    n_warm = eng.warmup()
+    mismatched = stranded = typed = completed = 0
+    with plan:
+        eng.start()
+        for wave in waves:
+            futs = [eng.submit(p, max_new_tokens=3) for p in wave]
+            for p, f in zip(wave, futs):
+                try:
+                    out = f.result(timeout=60)
+                    completed += 1
+                    if not onp.array_equal(out, refs[p.tobytes()]):
+                        mismatched += 1
+                except NonFiniteOutputError:
+                    typed += 1          # scale-poison victim, contained
+                except Exception:
+                    stranded += 1
+        if eng._tier is not None:
+            eng._tier.drain(timeout=10)
+        s = eng.stats()
+        # rot proof over EVERY leaf — int8 codes and fp32 scales alike:
+        # finite live pages, pristine zero page (a NaN scale surviving
+        # there would poison every masked read through 0 * NaN)
+        pool_clean = all(
+            bool(onp.isfinite(
+                onp.asarray(layer[k][:eng.num_pages],
+                            dtype="float32")).all())
+            and bool((onp.asarray(layer[k][eng.num_pages]) == 0).all())
+            for layer in eng._caches for k in layer)
+        try:
+            eng.stop(timeout=15)
+        except Exception:
+            pass
+    _join_zombies()
+    q = s["quantized_kv"]
+    t = s["tier"]
+    passed = (mismatched == 0 and stranded == 0 and pool_clean
+              and completed >= len(families)      # the storm still serves
+              and typed >= 1                      # poison detected, typed
+              and q["kv_quant_faults"] >= 1       # write fault recomputed
+              and q["kv_dequant_faults"] >= 1     # rot counted at dequant
+              and q["kv_quant_pages"] >= 1
+              and t["tier_demotes"] >= 1
+              and t["tier_promotes"] >= 1
+              and s["compile_cache"]["compiles"] == n_warm
+              and plan.fired("serving.kv_quant") >= 1
+              and plan.fired("serving.kv_scale") >= 1)
+    return {
+        "name": "serving/quant_storm",
+        "passed": bool(passed),
+        "detail": {"requests": sum(len(w) for w in waves),
+                   "completed": completed, "mismatched": mismatched,
+                   "typed_nan": typed, "stranded": stranded,
+                   "pool_clean": pool_clean,
+                   "quantized_kv": q, "tier": t,
                    "compiles_warmup": n_warm,
                    "compiles_total": s["compile_cache"]["compiles"],
                    "faults_fired": plan.fired()},
