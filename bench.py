@@ -476,6 +476,204 @@ def bench_resnet50_io(on_tpu: bool, batch_override=None) -> dict:
                    "images/sec", mfu, batch=batch, trials=vals)
 
 
+# ----------------------------------------------------------- data plane
+
+def bench_data_plane(on_tpu: bool, batch_override=None) -> dict:
+    """Input-pipeline overlap (docs/data.md): the same io-shaped training
+    loop fed five ways —
+
+    - ``synthetic``       preloaded device batches, no input pipeline at
+                          all (the compute ceiling every other arm is
+                          judged against);
+    - ``f32_sync``        host decode/augment to float32, handed to the
+                          step synchronously (the classic stall);
+    - ``f32_prefetch``    same producer behind a ``DevicePrefetcher``
+                          (depth 2) shipping against the trainer's batch
+                          shardings;
+    - ``uint8_sync``      raw uint8 ship + jitted on-device crop/mirror/
+                          normalize (``DeviceTransform``), synchronous;
+    - ``uint8_prefetch``  uint8 ship + on-device augment behind the
+                          prefetcher — the docs/data.md recommended
+                          configuration.
+
+    The producer performs the real host-side work (index, gather, crop/
+    mirror/normalize for the f32 arms) plus a fixed sleep standing in
+    for jpeg decode + storage fetch latency (which release the GIL
+    exactly like this sleep does — the C++ decode plane and a remote
+    read both overlap the same way).  The trunk is sized so the
+    synthetic step costs >= 50ms; ``value`` is the uint8_prefetch
+    throughput, and the record carries per-arm img/s, trials, and the
+    fraction of each timed region spent waiting on input
+    (``input_wait_frac``, from ``DevicePrefetcher.stats()`` for the
+    prefetch arms and the measured producer time for the sync arms).
+    """
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.data import DevicePrefetcher, DeviceTransform
+    from mxnet_tpu.gluon import nn
+
+    if on_tpu:
+        batch, steps, warmup, trials = 128, 12, 3, 3
+        size, crop, units, decode_ms = 232, 224, 4096, 20.0
+    else:
+        batch, steps, warmup, trials = 8, 6, 2, 3
+        size, crop, units, decode_ms = 72, 64, 640, 60.0
+    mesh = par.make_mesh()
+    batch = _fit_batch(batch_override or batch, mesh)
+    mean, std = (124.0, 117.0, 104.0), (58.4, 57.1, 57.4)
+    rs = onp.random.RandomState(0)
+    pool_n = max(batch * 4, 32)
+    pool = rs.randint(0, 255, (pool_n, size, size, 3)).astype("uint8")
+    pool_labels = rs.randint(0, 100, (pool_n,)).astype("int32")
+    mean_a = onp.asarray(mean, "float32")
+    std_a = onp.asarray(std, "float32")
+    produce_spent = [0.0]      # accumulated host production seconds
+
+    def produce(i, as_f32):
+        """One host-side batch: gather from the pool (+ crop/mirror/
+        normalize for the f32 arms) behind the decode-latency stand-in."""
+        t0 = time.perf_counter()
+        time.sleep(decode_ms / 1e3)
+        prs = onp.random.RandomState(7919 + i)
+        sel = prs.randint(0, pool_n, size=batch)
+        imgs, labels = pool[sel], pool_labels[sel]
+        if as_f32:
+            oy, ox = prs.randint(0, size - crop + 1, size=2)
+            out = imgs[:, oy:oy + crop, ox:ox + crop, :].astype("float32")
+            flip = prs.rand(batch) < 0.5
+            out[flip] = out[flip, :, ::-1, :]
+            out = (out - mean_a) / std_a
+        else:
+            out = imgs                        # raw uint8, 4x fewer bytes
+        produce_spent[0] += time.perf_counter() - t0
+        return mx.nd.array(out), mx.nd.array(labels, dtype="int32")
+
+    def make_trainer():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(units, activation="relu"),
+                nn.Dense(units, activation="relu"),
+                nn.Dense(100))
+        net.initialize()
+        return par.ShardedTrainer(
+            net, "sgd", loss=_ce_loss,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            mesh=mesh)
+
+    def as_loss(r):
+        return r[0] if isinstance(r, tuple) else r
+
+    def run_arm(trainer, next_batch, wait_fn):
+        """warmup, then ``trials`` timed segments; returns the per-trial
+        seconds and the input-wait seconds accrued over the timed region
+        only (compiles never pollute the fraction).  Every step reads the
+        loss back — the fit-loop shape (per-step metric update), held
+        IDENTICAL across arms so the only variable is how the next batch
+        gets to the device: without a prefetcher the producer runs inside
+        the loss-sync gap it just created; with one, the feeder produced
+        during that same gap and the batch is already resident."""
+        for i in range(warmup):
+            float(as_loss(trainer.step(*next_batch(i))).asnumpy())
+        w0, times = wait_fn(), []
+        for t in range(trials):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                float(as_loss(trainer.step(
+                    *next_batch(warmup + t * steps + i))).asnumpy())
+            times.append(time.perf_counter() - t0)
+        return times, wait_fn() - w0
+
+    arms = {}
+    n_total = warmup + trials * steps + 4     # + prefetch ring slack
+    with par.use_mesh(mesh):
+        # -- synthetic ceiling: preloaded f32 batches, no input at all
+        tr = make_trainer()
+        fixed = [(mx.nd.array(
+                      (pool[i * batch:(i + 1) * batch, :crop, :crop, :]
+                       .astype("float32") - mean_a) / std_a),
+                  mx.nd.array(pool_labels[i * batch:(i + 1) * batch],
+                              dtype="int32"))
+                 for i in range(2)]
+        times, _ = run_arm(tr, lambda i: fixed[i % 2], lambda: 0.0)
+        arms["synthetic"] = {"times": times, "wait": 0.0}
+
+        # -- f32 host augment, synchronous hand-off
+        tr = make_trainer()
+        times, wait = run_arm(tr, lambda i: produce(i, True),
+                              lambda: produce_spent[0])
+        arms["f32_sync"] = {"times": times, "wait": wait}
+
+        # -- f32 host augment behind the prefetcher
+        tr = make_trainer()
+        d0, l0 = produce(0, True)
+        tr.build(d0, l0)
+
+        def gen_f32():
+            for i in range(n_total):
+                yield produce(i, True)
+        pf = DevicePrefetcher(gen_f32(), shardings=tr.batch_shardings,
+                              depth=2)
+        tr.attach_data_source(pf)
+        times, wait = run_arm(tr, lambda i: next(pf),
+                              lambda: pf.stats()["input_wait_seconds_total"])
+        arms["f32_prefetch"] = {"times": times, "wait": wait}
+
+        # -- uint8 ship + on-device augment, synchronous
+        tf_sync = DeviceTransform(mean=mean, std=std, crop=crop,
+                                  mirror=True, layout="NHWC", seed=11)
+        tr = make_trainer()
+
+        def sync_u8(i):
+            d, lab = produce(i, False)
+            return mx.nd.NDArray(tf_sync.apply(d.jax, i)), lab
+        times, wait = run_arm(tr, sync_u8, lambda: produce_spent[0])
+        arms["uint8_sync"] = {"times": times, "wait": wait}
+
+        # -- uint8 ship + on-device augment behind the prefetcher
+        tf_pf = DeviceTransform(mean=mean, std=std, crop=crop,
+                                mirror=True, layout="NHWC", seed=11)
+        tr = make_trainer()
+        d0, l0 = produce(0, False)
+        tr.build(mx.nd.NDArray(tf_pf.apply(d0.jax, 0)), l0)
+
+        def gen_u8():
+            for i in range(n_total):
+                yield produce(i, False)
+        pf = DevicePrefetcher(gen_u8(), shardings=None, depth=2,
+                              transform=tf_pf)
+        tr.attach_data_source(pf)
+        times, wait = run_arm(tr, lambda i: next(pf),
+                              lambda: pf.stats()["input_wait_seconds_total"])
+        arms["uint8_prefetch"] = {"times": times, "wait": wait}
+
+    out_arms = {}
+    for name, a in arms.items():
+        vals = [batch * steps / dt for dt in a["times"]]
+        v = _median(vals)
+        out_arms[name] = {
+            "imgs_per_sec": round(v, 1),
+            "trials": [round(x, 1) for x in vals],
+            "input_wait_frac": round(a["wait"] / sum(a["times"]), 4)
+            if sum(a["times"]) else 0.0,
+        }
+    rec = _record("data_plane_input_pipeline",
+                  out_arms["uint8_prefetch"]["imgs_per_sec"],
+                  "images/sec", 0.0, batch=batch)
+    rec["vs_baseline"] = None          # overlap ratios, not an MFU claim
+    rec["arms"] = out_arms
+    rec["synthetic_step_ms"] = round(
+        1e3 * _median(arms["synthetic"]["times"]) / steps, 2)
+    for d in ("f32", "uint8"):
+        on = out_arms[f"{d}_prefetch"]["imgs_per_sec"]
+        off = out_arms[f"{d}_sync"]["imgs_per_sec"]
+        rec[f"prefetch_speedup_{d}"] = round(on / off, 3) if off else None
+    best_io = max(out_arms[k]["imgs_per_sec"]
+                  for k in ("f32_prefetch", "uint8_prefetch"))
+    rec["io_vs_synthetic"] = round(
+        out_arms["synthetic"]["imgs_per_sec"] / best_io, 3) if best_io \
+        else None
+    return rec
+
+
 # ------------------------------------------------------------ NMT (config 4)
 
 def bench_nmt(on_tpu: bool, batch_override=None) -> dict:
@@ -603,7 +801,7 @@ def main():
     ap.add_argument("--workload", default="gpt2",
                     choices=["gpt2", "gpt2_long", "resnet50", "resnet50_io",
                              "bert", "nmt", "guardrails", "checkpoint",
-                             "all"])
+                             "data_plane", "all"])
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of each workload "
                          "into DIR (for the on-chip where-does-time-go "
@@ -616,14 +814,15 @@ def main():
         from mxnet_tpu import amp
         amp.init("bfloat16")   # MXU wants bf16; master weights stay f32
 
-    names = (["resnet50", "resnet50_io", "bert", "nmt", "guardrails",
-              "checkpoint", "gpt2_long", "gpt2"]
+    names = (["resnet50", "resnet50_io", "data_plane", "bert", "nmt",
+              "guardrails", "checkpoint", "gpt2_long", "gpt2"]
              if args.workload == "all" else [args.workload])
     table = {"gpt2": bench_gpt2, "gpt2_long": bench_gpt2_long,
              "resnet50": bench_resnet50, "resnet50_io": bench_resnet50_io,
              "bert": bench_bert, "nmt": bench_nmt,
              "guardrails": bench_guardrails,
-             "checkpoint": bench_checkpoint}
+             "checkpoint": bench_checkpoint,
+             "data_plane": bench_data_plane}
     import contextlib
     import os
     for name in names:

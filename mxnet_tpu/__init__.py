@@ -79,6 +79,7 @@ _LAZY = {
     "amp": ".amp",
     "profiler": ".profiler",
     "io": ".io",
+    "data": ".data",
     "image": ".image",
     "recordio": ".recordio",
     "runtime": ".runtime",

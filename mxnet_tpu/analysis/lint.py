@@ -100,9 +100,9 @@ RULES.update(_raceguard.RACEGUARD_RULES)
 
 #: component directories where the monotonic-clock convention applies
 WALL_CLOCK_SCOPE = ("serving", "fleet", "resilience", "observability",
-                    "analysis")
+                    "analysis", "data")
 #: component directories where raises must be MXNetError-typed
-TYPED_RAISE_SCOPE = ("serving", "fleet")
+TYPED_RAISE_SCOPE = ("serving", "fleet", "data")
 #: exception names considered untyped on those paths
 UNTYPED_RAISES = ("ValueError", "RuntimeError", "KeyError", "TypeError",
                   "IndexError", "Exception")

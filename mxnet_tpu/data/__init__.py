@@ -1,0 +1,37 @@
+"""mxnet_tpu.data — the DEVICE half of the input pipeline.
+
+``io.py`` deliberately stops at host memory: its iterators decode and
+batch on CPU threads and hand out host-resident NDArrays, so every
+``ShardedTrainer.step`` pays decode + host→device transfer serially
+(docs/host_data_plane_r05.md measured that collapse at 66x on
+resnet50_io).  This package closes the gap:
+
+- :class:`~mxnet_tpu.data.prefetch.DevicePrefetcher` — a feeder thread
+  keeps a bounded ring (depth >= 2) of batches already resident on
+  device with the trainer's target ``NamedSharding``, shipping batch
+  N+1 while step N computes; the hot path consumes committed,
+  donation-eligible arrays with zero H2D.
+- :class:`~mxnet_tpu.data.sharded_loader.ShardedLoader` — per-host
+  sharded global-batch loading: each process materializes ONLY the rows
+  its addressable devices own and the global array is assembled shard
+  by shard (no full-batch materialization on any one host).
+- :class:`~mxnet_tpu.data.transforms.DeviceTransform` — ship raw uint8
+  pixels (4x fewer bytes than f32) and crop/mirror/normalize on device
+  as jitted fns compiled once per (shape, crop) lattice point, with the
+  same compile-freeze contract the serving bucket lattice asserts.
+
+Fault sites ``data.prefetch`` / ``data.device_put`` /
+``data.bad_shard`` degrade to synchronous load / retried put /
+quarantined skip — never a lost batch (docs/resilience.md), and the
+whole stack stays bit-identical through ``ResilientLoop`` kill/resume
+(offset replay carries through :meth:`DevicePrefetcher.state_dict`).
+
+See docs/data.md for architecture, knobs and the failure matrix.
+"""
+
+from .prefetch import DevicePrefetcher
+from .sharded_loader import ShardedLoader, host_batch_rows, assemble_global
+from .transforms import DeviceTransform
+
+__all__ = ["DevicePrefetcher", "ShardedLoader", "DeviceTransform",
+           "host_batch_rows", "assemble_global"]

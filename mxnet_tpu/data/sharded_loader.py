@@ -1,0 +1,242 @@
+"""Per-host sharded global-batch loading for multi-process meshes.
+
+Every process materializes ONLY the rows of the global batch that its
+addressable devices own (``host_batch_rows``), places them per device,
+and ``jax.make_array_from_single_device_arrays`` stitches the global
+``NamedSharding``-ed array — no host ever holds the full global batch,
+which is what makes global batch sizes beyond one host's RAM (and
+decode throughput) reachable.
+
+Shard assignment is deterministic in ``(process_index, epoch, step)``:
+the sample permutation is seeded by ``(seed, epoch)`` with plain
+arithmetic (no process-salted hashing) and a process's row range is a
+pure function of the sharding layout, so a resumed run replays the
+exact shards it would have loaded — ``ResilientLoop`` offset replay
+and :class:`~mxnet_tpu.data.prefetch.DevicePrefetcher.state_dict`
+fast-forward both stay bit-identical.
+
+Fault site ``data.bad_shard`` (docs/resilience.md): a poisoned host
+shard (NaN/Inf splice, the ``io.bad_batch`` idiom) is quarantined and
+the STEP is skipped, counted — same semantics as
+``NDArrayIter(quarantine_nonfinite=True)``, so a rotting local disk on
+one host degrades throughput, never training math.
+"""
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import numpy as onp
+
+from .. import base as _base
+from ..ndarray import NDArray
+from ..resilience.faults import poison as _poison
+from ..observability.registry import default_registry as _registry
+
+__all__ = ["ShardedLoader", "host_batch_rows", "assemble_global"]
+
+
+def host_batch_rows(sharding, global_shape) -> Tuple[int, int]:
+    """The contiguous ``[lo, hi)`` batch-dim row range this process's
+    addressable devices own under ``sharding`` — the only rows its
+    loader must materialize."""
+    global_shape = tuple(global_shape)
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    if not idx_map:
+        raise _base.MXNetError(
+            "sharding has no addressable devices in this process")
+    starts, stops = [], []
+    for idx in idx_map.values():
+        row = idx[0] if idx else slice(None)
+        starts.append(0 if row.start is None else int(row.start))
+        stops.append(global_shape[0] if row.stop is None
+                     else int(row.stop))
+    lo, hi = min(starts), max(stops)
+    span = sorted(set(zip(starts, stops)))
+    covered = lo
+    for s, e in span:
+        if s > covered:
+            raise _base.MXNetError(
+                f"non-contiguous host row range under sharding "
+                f"{sharding}: gap at {covered}..{s}")
+        covered = max(covered, e)
+    return lo, hi
+
+
+def assemble_global(host_part, sharding, global_shape, lo: int = 0):
+    """Build the global array from this process's host rows: one
+    ``device_put`` per addressable shard, then
+    ``jax.make_array_from_single_device_arrays`` — the multihost ingest
+    path that never materializes the full batch anywhere."""
+    global_shape = tuple(global_shape)
+    host_part = onp.asarray(host_part)
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    shards = []
+    for dev, idx in idx_map.items():
+        row = idx[0] if idx else slice(None)
+        start = 0 if row.start is None else int(row.start)
+        stop = global_shape[0] if row.stop is None else int(row.stop)
+        local = host_part[(slice(start - lo, stop - lo),)
+                          + tuple(idx[1:])]
+        shards.append(jax.device_put(local, dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, shards)
+
+
+class ShardedLoader:
+    """Deterministic per-host sharded global-batch iterator.
+
+    Parameters
+    ----------
+    load_fn : callable
+        ``load_fn(sample_ids) -> (data, labels)`` returning host numpy
+        arrays for exactly the given GLOBAL sample ids (this process's
+        shard of the batch).  It must be a pure function of the ids —
+        that is the whole determinism contract.
+    num_samples : int
+        Dataset size; permuted per epoch when ``shuffle``.
+    batch_size : int
+        GLOBAL batch size (all hosts combined).
+    sample_shape, label_shape : tuple
+        Per-sample shapes (data rows are ``(batch,) + sample_shape``).
+    data_sharding, label_sharding : NamedSharding, optional
+        Target global placements.  ``None`` keeps host arrays (a
+        single-host pipeline; the trainer or a ``DevicePrefetcher``
+        does the placement).
+    shuffle : bool
+        Per-epoch sample permutation, seeded by ``(seed, epoch)``.
+    epochs : int
+        Number of epochs one iteration pass covers (ResilientLoop's
+        ``make_iter`` wants the GLOBAL step sequence in one iterator).
+    quarantine_nonfinite : bool
+        Skip (and count) a step whose host shard carries NaN/Inf —
+        the ``data.bad_shard`` degradation.
+    """
+
+    def __init__(self, load_fn: Callable, num_samples: int,
+                 batch_size: int,
+                 sample_shape: Sequence[int] = (),
+                 label_shape: Sequence[int] = (),
+                 data_sharding=None, label_sharding=None,
+                 shuffle: bool = False, seed: int = 0, epochs: int = 1,
+                 quarantine_nonfinite: bool = True,
+                 dtype="float32", label_dtype="float32"):
+        if batch_size < 1 or batch_size > num_samples:
+            raise _base.MXNetError(
+                f"batch_size {batch_size} outside [1, {num_samples}]")
+        if (data_sharding is None) != (label_sharding is None):
+            raise _base.MXNetError(
+                "pass both data_sharding and label_sharding or neither")
+        self._load_fn = load_fn
+        self._n = int(num_samples)
+        self.batch_size = int(batch_size)
+        self._sample_shape = tuple(sample_shape)
+        self._label_shape = tuple(label_shape)
+        self._data_sh = data_sharding
+        self._label_sh = label_sharding
+        self._shuffle = bool(shuffle)
+        self._seed = int(seed)
+        self._epochs = int(epochs)
+        self._quarantine = bool(quarantine_nonfinite)
+        self._dtype = onp.dtype(dtype)
+        self._label_dtype = onp.dtype(label_dtype)
+        self.steps_per_epoch = self._n // self.batch_size
+        self._step = 0          # global step cursor (crosses epochs)
+        self.quarantined = 0
+        self._served = 0
+        self._obs_quarantined = _registry().counter(
+            "mxtpu_io_quarantined_batches_total",
+            help="non-finite input batches quarantined (never trained "
+                 "on), all iterators")
+        self._perm_cache: dict = {}
+
+    # -------------------------------------------------------- assignment
+    def _perm(self, epoch: int):
+        p = self._perm_cache.get(epoch)
+        if p is None:
+            if self._shuffle:
+                # arithmetic key, NOT hash(): hash is process-salted
+                # and would break cross-process shard agreement
+                rs = onp.random.RandomState(
+                    (self._seed * 1000003 + epoch) & 0x7fffffff)
+                p = rs.permutation(self._n)
+            else:
+                p = onp.arange(self._n)
+            self._perm_cache[epoch] = p
+        return p
+
+    def shard_ids(self, epoch: int, step: int) -> onp.ndarray:
+        """The GLOBAL sample ids this process loads for (epoch, step) —
+        pure in (process layout, seed, epoch, step); exposed so tests
+        can pin determinism directly."""
+        B = self.batch_size
+        ids = self._perm(epoch)[step * B:(step + 1) * B]
+        if self._data_sh is not None:
+            lo, hi = host_batch_rows(
+                self._data_sh, (B,) + self._sample_shape)
+            return ids[lo:hi]
+        return ids
+
+    # --------------------------------------------------------- iteration
+    def _load_step(self, epoch: int, step: int):
+        B = self.batch_size
+        ids = self.shard_ids(epoch, step)
+        data, labels = self._load_fn(ids)
+        data = onp.asarray(data, self._dtype)
+        labels = onp.asarray(labels, self._label_dtype)
+        want = (len(ids),) + self._sample_shape
+        if tuple(data.shape) != want:
+            raise _base.MXNetError(
+                f"load_fn returned data shape {tuple(data.shape)}, "
+                f"expected {want}")
+        bad = _poison("data.bad_shard")
+        if bad is not None and data.dtype.kind == "f" and data.size:
+            data = data.copy()
+            data.reshape(-1)[0] = bad
+        if self._quarantine and data.dtype.kind == "f" and \
+                not onp.isfinite(data).all():
+            return None
+        if self._data_sh is not None:
+            lo, _ = host_batch_rows(self._data_sh,
+                                    (B,) + self._sample_shape)
+            gdata = assemble_global(data, self._data_sh,
+                                    (B,) + self._sample_shape, lo)
+            glabel = assemble_global(labels, self._label_sh,
+                                     (B,) + self._label_shape, lo)
+            return NDArray(gdata), NDArray(glabel)
+        from ..ndarray import array as _nd_array
+        return _nd_array(data), _nd_array(labels)
+
+    def next(self):
+        total = self.steps_per_epoch * self._epochs
+        while self._step < total:
+            epoch, step = divmod(self._step, self.steps_per_epoch)
+            out = self._load_step(epoch, step)
+            self._step += 1
+            if out is None:                    # quarantined shard
+                self.quarantined += 1
+                self._obs_quarantined.inc()
+                continue
+            self._served += 1
+            return out
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._step = 0
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {"served": self._served, "quarantined": self.quarantined,
+                "step_cursor": self._step,
+                "steps_per_epoch": self.steps_per_epoch,
+                "epochs": self._epochs}
+
+    def __repr__(self):
+        return (f"ShardedLoader(n={self._n}, batch={self.batch_size}, "
+                f"steps/epoch={self.steps_per_epoch}, "
+                f"cursor={self._step})")

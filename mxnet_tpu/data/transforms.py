@@ -1,0 +1,202 @@
+"""On-device augment/normalize: ship uint8, transform where compute is.
+
+Host-side pipelines cast to float32 before the H2D copy and move 4x
+the bytes (docs/host_data_plane_r05.md measured the transfer as the
+end-to-end collapse).  ``DeviceTransform`` inverts that: the source
+ships RAW uint8 pixels (``ImageRecordIter(dtype="uint8")``) and crop /
+mirror / normalize run as jitted device functions after placement —
+the :class:`~mxnet_tpu.data.prefetch.DevicePrefetcher` ``transform=``
+hook, so the work also overlaps the previous step.
+
+Compile-freeze contract (same shape as the serving bucket lattice): one
+compiled function per ``(batch_shape, dtype)`` lattice point, cached by
+shape; after :meth:`DeviceTransform.freeze` a cache miss RAISES instead
+of compiling, so tests pin "zero compiles land on the training loop
+after warmup" mechanically.
+
+Determinism: augmentation randomness derives from ``fold_in(seed,
+step)`` only — a resumed/replayed step crops and mirrors identically,
+keeping ``ResilientLoop`` kill/resume bit-identical through the
+augmented path.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import base as _base
+
+__all__ = ["DeviceTransform"]
+
+
+class DeviceTransform:
+    """Jitted uint8 -> float crop/mirror/normalize pipeline.
+
+    Parameters
+    ----------
+    mean, std : float or per-channel sequence, optional
+        Normalization applied AFTER the cast to ``dtype``
+        (``(x - mean) / std``), broadcast along the channel axis of
+        ``layout``.
+    crop : int, optional
+        Output spatial size: a random ``crop x crop`` window per SAMPLE
+        (offsets are traced values — changing them never recompiles).
+    mirror : bool
+        Random per-sample horizontal flip.
+    layout : "NCHW" | "NHWC"
+        Axis convention of the incoming batch.
+    dtype : str
+        Compute/output dtype (default float32).
+    seed : int
+        Root of the per-step augmentation key stream.
+    """
+
+    def __init__(self, mean=None, std=None, crop: Optional[int] = None,
+                 mirror: bool = False, layout: str = "NCHW",
+                 dtype: str = "float32", seed: int = 0):
+        if layout not in ("NCHW", "NHWC"):
+            raise _base.MXNetError(
+                f"DeviceTransform layout must be NCHW or NHWC, "
+                f"got {layout!r}")
+        if crop is not None and crop < 1:
+            raise _base.MXNetError(f"crop must be >= 1, got {crop}")
+        self._mean = mean
+        self._std = std
+        self._crop = crop
+        self._mirror = bool(mirror)
+        self._layout = layout
+        self._dtype = jnp.dtype(dtype)
+        self._seed = int(seed)
+        self._fns: dict = {}
+        self._frozen = False
+        # axis positions for (H, W, C) under the chosen layout
+        self._h, self._w, self._c = \
+            (2, 3, 1) if layout == "NCHW" else (1, 2, 3)
+
+    # ------------------------------------------------------------ lattice
+    @property
+    def compile_count(self) -> int:
+        """Distinct (shape, dtype) points compiled so far."""
+        return len(self._fns)
+
+    def freeze(self):
+        """No further compiles: a new lattice point now raises.  Call
+        after warmup, like the serving engine's bucket freeze."""
+        self._frozen = True
+        return self
+
+    def _chan_shape(self, ndim: int) -> Tuple[int, ...]:
+        shape = [1] * ndim
+        shape[self._c] = -1
+        return tuple(shape)
+
+    def _build(self, shape, in_dtype):
+        """One jitted fn for this lattice point.  Offsets/flips are
+        runtime key material — only (shape, dtype) shape the trace."""
+        h_ax, w_ax = self._h, self._w
+        crop, mirror = self._crop, self._mirror
+        out_dtype = self._dtype
+        mean, std = self._mean, self._std
+        ndim = len(shape)
+        cshape = self._chan_shape(ndim)
+        mean_a = (None if mean is None else
+                  jnp.reshape(jnp.asarray(mean, out_dtype), cshape))
+        std_a = (None if std is None else
+                 jnp.reshape(jnp.asarray(std, out_dtype), cshape))
+
+        def one(img, oy, ox, flip):
+            # img: one sample (ndim-1 dims); crop via dynamic_slice so
+            # the offset is data, not a trace constant
+            if crop is not None:
+                starts = [jnp.int32(0)] * (ndim - 1)
+                sizes = list(img.shape)
+                starts[h_ax - 1] = oy
+                starts[w_ax - 1] = ox
+                sizes[h_ax - 1] = crop
+                sizes[w_ax - 1] = crop
+                img = jax.lax.dynamic_slice(img, starts, sizes)
+            if mirror:
+                img = jnp.where(flip, jnp.flip(img, axis=w_ax - 1), img)
+            return img
+
+        def fn(x, key):
+            n = x.shape[0]
+            ky, kx, kf = jax.random.split(key, 3)
+            if crop is not None:
+                max_oy = x.shape[h_ax] - crop
+                max_ox = x.shape[w_ax] - crop
+                oy = jax.random.randint(ky, (n,), 0, max_oy + 1,
+                                        jnp.int32)
+                ox = jax.random.randint(kx, (n,), 0, max_ox + 1,
+                                        jnp.int32)
+            else:
+                oy = ox = jnp.zeros((n,), jnp.int32)
+            flip = (jax.random.bernoulli(kf, 0.5, (n,))
+                    if mirror else jnp.zeros((n,), bool))
+            y = jax.vmap(one)(x, oy, ox, flip)
+            y = y.astype(out_dtype)
+            if mean_a is not None:
+                y = y - mean_a
+            if std_a is not None:
+                y = y / std_a
+            return y
+
+        return jax.jit(fn)
+
+    def _fn_for(self, x):
+        key = (tuple(x.shape), str(x.dtype))
+        fn = self._fns.get(key)
+        if fn is None:
+            if self._frozen:
+                raise _base.MXNetError(
+                    f"DeviceTransform is frozen but batch point "
+                    f"{key} was never warmed — a compile would land "
+                    "on the training loop")
+            fn = self._build(x.shape, x.dtype)
+            self._fns[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- apply
+    def apply(self, x, step: int):
+        """Transform one image batch for global ``step`` (deterministic
+        in (seed, step)).  Accepts jax or host arrays; returns a device
+        array of ``dtype``."""
+        if not isinstance(x, jax.Array):
+            x = jnp.asarray(onp.asarray(x))
+        if x.ndim != 4:
+            raise _base.MXNetError(
+                f"DeviceTransform expects a 4-d image batch "
+                f"({self._layout}), got shape {tuple(x.shape)}")
+        if self._crop is not None and (
+                x.shape[self._h] < self._crop
+                or x.shape[self._w] < self._crop):
+            raise _base.MXNetError(
+                f"crop={self._crop} larger than input "
+                f"{tuple(x.shape)} ({self._layout})")
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 int(step))
+        return self._fn_for(x)(x, key)
+
+    def __call__(self, data, labels, step: int):
+        """:class:`DevicePrefetcher` transform hook: augment the first
+        data array (the image tensor), pass labels through."""
+        from ..ndarray import NDArray
+        if not data:
+            return data, labels
+        first = data[0]
+        x = first.jax if isinstance(first, NDArray) else first
+        y = self.apply(x, step)
+        out = NDArray(y) if isinstance(first, NDArray) else y
+        return (out,) + tuple(data[1:]), tuple(labels)
+
+    def stats(self) -> dict:
+        return {"compiles": self.compile_count,
+                "frozen": self._frozen,
+                "points": sorted(str(k) for k in self._fns)}
+
+    def __repr__(self):
+        return (f"DeviceTransform(crop={self._crop}, "
+                f"mirror={self._mirror}, layout={self._layout!r}, "
+                f"compiles={self.compile_count}, frozen={self._frozen})")

@@ -575,12 +575,30 @@ class ImageRecordIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetcher wrapping any DataIter
-    (parity: src/io/iter_prefetcher.h)."""
+    (parity: src/io/iter_prefetcher.h).
+
+    ``prefetch_depth`` (default 2) bounds how many decoded batches the
+    worker may run ahead of the consumer — honored end-to-end: the
+    hand-off queue holds at most ``depth`` batches and the worker holds
+    at most one more in flight, so a stalled consumer caps host memory
+    at ``depth + 1`` batches (regression-tested; the single-slot
+    hand-off measured in docs/host_data_plane_r05.md §4 lost 15-20%
+    when producer and consumer were comparable).
+
+    This is the HOST half of the pipeline; for device-side double
+    buffering compose :class:`mxnet_tpu.data.DevicePrefetcher` on top —
+    it ships batches to device with the trainer's sharding while the
+    previous step computes.
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
+        if not isinstance(prefetch_depth, int) or prefetch_depth < 1:
+            raise _base.MXNetError(
+                f"prefetch_depth must be an int >= 1, "
+                f"got {prefetch_depth!r}")
         self.iters = iters
         super().__init__(iters[0].batch_size)
         self._depth = prefetch_depth
@@ -598,14 +616,27 @@ class PrefetchingIter(DataIter):
         self._q: _queue.Queue = _queue.Queue(self._depth)
         self._stop = False
 
+        def put(item):
+            # bounded put that re-checks the stop flag: a worker parked
+            # on a full queue must notice reset() within 50ms, or the
+            # old thread races the new one on the shared inner iters
+            # (the zombie the old join(timeout=5) silently tolerated)
+            while not self._stop:
+                try:
+                    self._q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def worker():
             while not self._stop:
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._q.put(None)
+                    put(None)
                     return
-                self._q.put(batches)
+                put(batches)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -618,6 +649,10 @@ class PrefetchingIter(DataIter):
         except _queue.Empty:
             pass
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            raise _base.MXNetError(
+                "PrefetchingIter worker failed to stop on reset — "
+                "inner iterator blocked?")
         for it in self.iters:
             it.reset()
         self._start()

@@ -11,14 +11,15 @@ from .distributed import barrier, init_distributed, num_workers, rank
 from .mesh import AXES, axis_size, current_mesh, make_mesh, use_mesh
 from .pipeline import gpipe
 from .sharding import (DEFAULT_RULES, ShardingRules, annotate, batch_spec,
-                       divisible_spec, logical_axes_of, param_sharding,
-                       shard_params)
+                       divisible_spec, global_batch_sharding,
+                       logical_axes_of, param_sharding, shard_params)
 from .trainer import ShardedTrainer
 
 __all__ = [
     "AXES", "Mesh", "NamedSharding", "PartitionSpec", "ShardingRules",
     "ShardedTrainer", "annotate", "axis_size", "barrier", "batch_spec",
-    "current_mesh", "divisible_spec", "gpipe", "init_distributed",
+    "current_mesh", "divisible_spec", "global_batch_sharding", "gpipe",
+    "init_distributed",
     "logical_axes_of",
     "make_mesh", "num_workers", "param_sharding", "rank", "shard_params",
     "use_mesh", "with_sharding_constraint", "DEFAULT_RULES",

@@ -144,3 +144,12 @@ def batch_spec(ndim: int, batch_axis: int = 0, seq_axis: Optional[int] = None
     if seq_axis is not None:
         axes[seq_axis] = "sp"
     return P(*axes)
+
+
+def global_batch_sharding(mesh: Mesh, ndim: int, batch_axis: int = 0,
+                          seq_axis: Optional[int] = None) -> NamedSharding:
+    """The ``NamedSharding`` an input batch lands under — the one-liner
+    the data pipeline needs: feed it to ``ShardedLoader`` /
+    ``DevicePrefetcher`` and to the trainer's ``data_specs`` and both
+    sides agree on placement by construction."""
+    return NamedSharding(mesh, batch_spec(ndim, batch_axis, seq_axis))

@@ -112,7 +112,8 @@ class ShardedTrainer:
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  data_specs=None, label_specs=None, seq_axis: Optional[int] = None,
-                 donate: bool = True, grad_accum: int = 1,
+                 donate: bool = True, donate_batch: bool = False,
+                 grad_accum: int = 1,
                  guard_nonfinite: bool = False,
                  clip_global_norm: Optional[float] = None,
                  loss_scaler=None):
@@ -136,7 +137,13 @@ class ShardedTrainer:
         self._label_specs = label_specs
         self._seq_axis = seq_axis
         self._donate = donate
+        # batch-buffer donation: safe ONLY when every step's batch is a
+        # single-use array (the DevicePrefetcher contract) — callers
+        # that re-feed the same NDArray each step must leave this off,
+        # so it is opt-in unlike param/state donation
+        self._donate_batch = bool(donate_batch)
         self._guard_nonfinite = bool(guard_nonfinite)
+        self._data_source = None   # attach_data_source: stats()/span stamp
         if clip_global_norm is not None and clip_global_norm <= 0:
             raise _base.MXNetError(
                 f"clip_global_norm must be > 0, got {clip_global_norm}")
@@ -616,6 +623,11 @@ class ShardedTrainer:
         # buffers (observed: NaN params, GC-time segfaults).  Same
         # gating the serving engine applies to its KV cache donation.
         donate = self._donate and jax.default_backend() != "cpu"
+        dargs = (0, 1, 2) if donate else ()
+        if self._donate_batch and jax.default_backend() != "cpu":
+            # batch buffers are argument 3; donating them lets XLA
+            # recycle the prefetcher's freshly-shipped arrays in place
+            dargs += (3,)
         if self._guarded:
             # extra traced scalars: loss scale, consecutive-finite
             # counter, and the two poison splice values — runtime
@@ -628,14 +640,14 @@ class ShardedTrainer:
                               scalar, scalar, scalar, scalar),
                 out_shardings=(scalar, scalar, scalar, scalar,
                                param_sh, aux_sh, state_sh),
-                donate_argnums=(0, 1, 2) if donate else ())
+                donate_argnums=dargs)
         else:
             self._step_fn = jax.jit(
                 pure,
                 in_shardings=(param_sh, aux_sh, state_sh,
                               data_sh + label_sh, scalar, scalar, scalar),
                 out_shardings=(scalar, param_sh, aux_sh, state_sh),
-                donate_argnums=(0, 1, 2) if donate else ())
+                donate_argnums=dargs)
 
     # ------------------------------------------------------------------
     def build(self, data, labels=()):
@@ -667,8 +679,18 @@ class ShardedTrainer:
         tr = _trace_active()
         if tr is None:              # zero-cost: one global + None check
             return self._step(data, labels)
+        src = self._data_source
+        if src is None:
+            with tr.span("trainer.step",
+                         step=self.optimizer.num_update + 1,
+                         guarded=self._guarded):
+                return self._step(data, labels)
+        # per-step input-wait stamp: how long the caller's last batch
+        # acquisition blocked on the prefetch ring (0 = fully hidden)
         with tr.span("trainer.step", step=self.optimizer.num_update + 1,
-                     guarded=self._guarded):
+                     guarded=self._guarded,
+                     input_wait=round(
+                         getattr(src, "last_wait_seconds", 0.0), 6)):
             return self._step(data, labels)
 
     def _step(self, data, labels=()):
@@ -725,6 +747,34 @@ class ShardedTrainer:
         return NDArray(loss)
 
     # ------------------------------------------------------------------
+    @property
+    def batch_shardings(self):
+        """Target ``NamedSharding`` per flattened ``data + labels``
+        array (None before the first ``build()``/``step()``) — what a
+        :class:`mxnet_tpu.data.DevicePrefetcher` ships against so the
+        hot-path ``device_put`` is a no-op."""
+        return getattr(self, "_batch_shardings", None)
+
+    def attach_data_source(self, source):
+        """Associate the input pipeline (a ``DevicePrefetcher`` or
+        anything with ``stats()``/``last_wait_seconds``) so
+        ``stats()['data']`` and the per-step ``trainer.step`` span
+        carry the input-wait facts.  Returns ``source`` for chaining."""
+        self._data_source = source
+        return source
+
+    def stats(self) -> dict:
+        """Point-in-time trainer facts (the engine-``stats()`` shape):
+        step counter plus a ``data`` section from the attached input
+        pipeline when one is present."""
+        out = {"num_update": int(self.optimizer.num_update),
+               "built": self._built,
+               "guarded": self._guarded}
+        src = self._data_source
+        if src is not None and hasattr(src, "stats"):
+            out["data"] = src.stats()
+        return out
+
     @property
     def learning_rate(self):
         return self.optimizer.learning_rate

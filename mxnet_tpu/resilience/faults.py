@@ -244,6 +244,16 @@ register_site("fleet.scale_up", "elastic scale-up action (degrades to "
               "no-op before any engine is built)")
 register_site("fleet.scale_down", "elastic scale-down action (degrades "
               "to no-op before the victim starts draining)")
+# data pipeline (docs/data.md)
+register_site("data.prefetch", "top of each DevicePrefetcher feed cycle, "
+              "before the source read (degrades that batch to a "
+              "synchronous host hand-off; a kill crashes the feeder and "
+              "the consumer takes over at the clean offset)")
+register_site("data.device_put", "feeder device placement (retried once, "
+              "then the batch falls back to host arrays)")
+register_site("data.bad_shard", "poison: corrupt one host's shard of the "
+              "global batch (quarantined + counted skip, never trained "
+              "on)")
 
 
 class FaultSpec:

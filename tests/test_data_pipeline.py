@@ -1,0 +1,544 @@
+"""mxnet_tpu.data — async device-feed pipeline.
+
+The load-bearing contracts: (1) prefetched training is loss-BIT-
+IDENTICAL to the synchronous arm, including a kill-and-resume through
+ResilientLoop (offset replay carries through the new layer); (2) the
+ring is bounded — a slow consumer can never make the feeder OOM the
+host; (3) every data.* fault site degrades without losing a batch;
+(4) the transform lattice never compiles on the training loop after
+warmup; (5) per-host shard assignment is a pure function of
+(process layout, seed, epoch, step).
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import parallel as par
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.data import (DevicePrefetcher, DeviceTransform,
+                            ShardedLoader, assemble_global,
+                            host_batch_rows)
+from mxnet_tpu.data.prefetch import DataPipelineError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.sharding import global_batch_sharding
+from mxnet_tpu.resilience import (FaultPlan, ResilientLoop,
+                                  SimulatedPreemption)
+
+# ---------------------------------------------------------------- helpers
+
+_W1 = onp.random.RandomState(42).randn(16, 6).astype("float32") * 0.1
+_W2 = onp.random.RandomState(43).randn(2, 16).astype("float32") * 0.1
+
+
+def _make_trainer(**kw):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(2, in_units=16))
+    net.initialize()
+    net[0].weight.set_data(nd.array(_W1))
+    net[0].bias.set_data(nd.array(onp.zeros(16, "float32")))
+    net[1].weight.set_data(nd.array(_W2))
+    net[1].bias.set_data(nd.array(onp.zeros(2, "float32")))
+    return par.ShardedTrainer(
+        net, "adam", loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer_params={"learning_rate": 0.01}, **kw)
+
+
+def _batches(n=100):
+    for i in range(n):
+        rs = onp.random.RandomState(1000 + i)
+        X = rs.randn(8, 6).astype("float32")
+        y = (X.sum(1) > 0).astype("int32")
+        yield (nd.array(X), nd.array(y))
+
+
+def _params_of(tr):
+    return [p.data().asnumpy().copy() for _, p in tr._trainable]
+
+
+def _one_device_mesh():
+    import jax
+    return par.make_mesh(dp=1, devices=jax.devices()[:1])
+
+
+# ------------------------------------------------- prefetch == sync parity
+
+
+@pytest.mark.parametrize("guard", [False, True])
+def test_prefetched_loss_bit_identical_to_sync(guard):
+    """The tentpole contract: moving H2D off the hot path changes
+    WHEN bytes move, never WHAT the step computes."""
+    mesh = _one_device_mesh()
+    with par.use_mesh(mesh):
+        mx.random.seed(5)
+        t_sync = _make_trainer(guard_nonfinite=guard)
+        sync_losses = []
+        for d, l in _batches(12):
+            r = t_sync.step(d, l)
+            sync_losses.append((r[0] if guard else r).asnumpy().item())
+
+        mx.random.seed(5)
+        t_pf = _make_trainer(guard_nonfinite=guard)
+        d0, l0 = next(_batches(1))
+        t_pf.build(d0, l0)
+        assert t_pf.batch_shardings is not None
+        pf = DevicePrefetcher(_batches(12),
+                              shardings=t_pf.batch_shardings, depth=2)
+        t_pf.attach_data_source(pf)
+        pf_losses = []
+        try:
+            for d, l in pf:
+                r = t_pf.step(d, l)
+                pf_losses.append((r[0] if guard else r).asnumpy().item())
+        finally:
+            pf.close()
+        assert pf_losses == sync_losses
+        st = pf.stats()
+        assert st["batches_shipped"] == 12
+        assert st["batches_fallback"] == 0
+        # the trainer surfaces the pipeline's facts
+        tstats = t_pf.stats()
+        assert tstats["data"]["consumed"] == 12
+        assert tstats["data"]["input_wait_seconds_total"] >= 0.0
+
+
+def test_kill_resume_parity_through_resilient_loop(tmp_path):
+    """ResilientLoop offset replay stays bit-identical through the
+    prefetch layer: kill mid-run, resume, same params as the fault-free
+    SYNCHRONOUS arm."""
+    mesh = _one_device_mesh()
+    STEPS = 10
+    with par.use_mesh(mesh):
+        tr = _make_trainer()
+        loop = ResilientLoop(tr, str(tmp_path / "ref"), save_every=2,
+                             seed=7)
+        assert loop.run(lambda: _batches(), STEPS)[
+            "completed_steps"] == STEPS
+        ref = _params_of(tr)
+
+        def make_iter():
+            return DevicePrefetcher(_batches(), depth=2)
+
+        plan = FaultPlan(seed=0).kill_at("trainer.step", at=4)
+        kills, report = 0, None
+        with plan:
+            for _ in range(3):
+                tr2 = _make_trainer()
+                loop2 = ResilientLoop(tr2, str(tmp_path / "pf"),
+                                      save_every=2, seed=7)
+                try:
+                    report = loop2.run(make_iter, STEPS)
+                    break
+                except SimulatedPreemption:
+                    kills += 1
+        assert kills == 1
+        assert report is not None and report["completed_steps"] == STEPS
+        assert report["resumed_from"] is not None
+        for a, b in zip(ref, _params_of(tr2)):
+            assert onp.array_equal(a, b)
+
+
+def test_state_dict_offset_fast_forward():
+    # offset fast-forward needs a RESETTABLE source (list/DataIter —
+    # a generator raises, tested below)
+    src = list(_batches(20))
+    pf = DevicePrefetcher(src, depth=2)
+    first = [pf.next() for _ in range(5)]
+    sd = pf.state_dict()
+    assert sd == {"offset": 5}
+    nxt = pf.next()
+    pf.close()
+
+    pf2 = DevicePrefetcher(list(_batches(20)), depth=2)
+    pf2.load_state_dict(sd)
+    got = pf2.next()
+    pf2.close()
+    assert onp.array_equal(got[0].asnumpy(), nxt[0].asnumpy())
+    assert onp.array_equal(got[1].asnumpy(), nxt[1].asnumpy())
+    del first
+
+    # single-shot generators cannot fast-forward: typed refusal
+    pf3 = DevicePrefetcher(_batches(5), depth=2)
+    with pytest.raises(DataPipelineError):
+        pf3.load_state_dict({"offset": 2})
+    pf3.close()
+
+
+# ------------------------------------------------------ per-host sharding
+
+
+def test_per_host_shard_determinism_on_mesh(mesh_devices):
+    devs = mesh_devices(4)
+    mesh = par.make_mesh(dp=4, devices=devs)
+    dsh = global_batch_sharding(mesh, 2)
+    lsh = global_batch_sharding(mesh, 1)
+    B, N = 8, 32
+
+    def load(ids):
+        ids = onp.asarray(ids)
+        return (ids[:, None] * onp.ones((1, 6), "float32"),
+                ids.astype("float32"))
+
+    def make():
+        return ShardedLoader(load, num_samples=N, batch_size=B,
+                             sample_shape=(6,), data_sharding=dsh,
+                             label_sharding=lsh, shuffle=True, seed=3,
+                             epochs=2)
+
+    s1, s2 = make(), make()
+    # assignment is pure in (epoch, step) — exposed directly
+    for step in range(3):
+        assert onp.array_equal(s1.shard_ids(0, step),
+                               s2.shard_ids(0, step))
+    # epochs permute differently but deterministically
+    assert not onp.array_equal(s1.shard_ids(0, 0), s1.shard_ids(1, 0))
+
+    a = [s1.next() for _ in range(4)]
+    b = [s2.next() for _ in range(4)]
+    for (d1, l1), (d2, l2) in zip(a, b):
+        assert d1.jax.sharding == dsh
+        assert onp.array_equal(d1.asnumpy(), d2.asnumpy())
+        assert onp.array_equal(l1.asnumpy(), l2.asnumpy())
+    # the assembled global batch holds exactly the loaded shard values
+    ids0 = s1.shard_ids(0, 0)
+    want, _ = load(ids0)
+    assert onp.array_equal(a[0][0].asnumpy(), want)
+
+    # reset replays the identical sequence (ResilientLoop replay)
+    s1.reset()
+    d, l = s1.next()
+    assert onp.array_equal(d.asnumpy(), a[0][0].asnumpy())
+
+    # a DevicePrefetcher on top sees already-committed global arrays:
+    # zero-copy pass-through, values unchanged
+    s2.reset()
+    pf = DevicePrefetcher(s2, shardings=(dsh, lsh), depth=2)
+    d2, l2 = pf.next()
+    pf.close()
+    assert d2.jax.sharding == dsh
+    assert onp.array_equal(d2.asnumpy(), a[0][0].asnumpy())
+
+
+def test_host_batch_rows_and_assemble(mesh_devices):
+    devs = mesh_devices(4)
+    mesh = par.make_mesh(dp=4, devices=devs)
+    sh = global_batch_sharding(mesh, 2)
+    lo, hi = host_batch_rows(sh, (8, 3))
+    assert (lo, hi) == (0, 8)       # single process owns every row
+    part = onp.arange(24, dtype="float32").reshape(8, 3)
+    g = assemble_global(part, sh, (8, 3), lo)
+    assert g.sharding == sh
+    assert onp.array_equal(onp.asarray(g), part)
+
+
+# --------------------------------------------------- on-device transforms
+
+
+def test_uint8_device_augment_matches_host_float_path():
+    """Ship uint8 + normalize on device == cast-then-normalize on host
+    within float32 tolerance (documented: atol 1e-5)."""
+    rs = onp.random.RandomState(0)
+    x = rs.randint(0, 256, (4, 3, 8, 8)).astype("uint8")
+    mean = (123.68, 116.779, 103.939)
+    std = (58.393, 57.12, 57.375)
+    t = DeviceTransform(mean=mean, std=std, layout="NCHW")
+    dev = onp.asarray(t.apply(x, step=0))
+    host = (x.astype("float32")
+            - onp.asarray(mean, "float32").reshape(1, 3, 1, 1)) \
+        / onp.asarray(std, "float32").reshape(1, 3, 1, 1)
+    assert onp.allclose(dev, host, atol=1e-5)
+    assert dev.dtype == onp.float32
+
+
+def test_device_augment_deterministic_and_shape():
+    t = DeviceTransform(crop=5, mirror=True, layout="NCHW", seed=9)
+    x = onp.random.RandomState(1).randint(
+        0, 256, (4, 3, 8, 8)).astype("uint8")
+    y1 = onp.asarray(t.apply(x, step=3))
+    y2 = onp.asarray(t.apply(x, step=3))
+    y3 = onp.asarray(t.apply(x, step=4))
+    assert y1.shape == (4, 3, 5, 5)
+    assert onp.array_equal(y1, y2)          # same (seed, step) — replay
+    assert not onp.array_equal(y1, y3)      # step moves the augment
+
+
+def test_transform_compile_freeze_lattice():
+    t = DeviceTransform(mean=(0.0,), std=(1.0,), crop=4, layout="NHWC")
+    a = onp.zeros((2, 6, 6, 1), "uint8")
+    b = onp.zeros((4, 6, 6, 1), "uint8")
+    t.apply(a, 0)
+    t.apply(b, 0)
+    assert t.compile_count == 2
+    t.freeze()
+    t.apply(a, 1)                           # warmed point: fine
+    t.apply(b, 99)
+    assert t.compile_count == 2             # zero compiles post-freeze
+    with pytest.raises(MXNetError):
+        t.apply(onp.zeros((8, 6, 6, 1), "uint8"), 0)   # cold point
+
+
+def test_transform_rejects_bad_config():
+    with pytest.raises(MXNetError):
+        DeviceTransform(layout="NWHC")
+    with pytest.raises(MXNetError):
+        DeviceTransform(crop=0)
+    t = DeviceTransform(crop=16)
+    with pytest.raises(MXNetError):
+        t.apply(onp.zeros((1, 3, 8, 8), "uint8"), 0)   # crop > input
+    with pytest.raises(MXNetError):
+        t.apply(onp.zeros((3, 8, 8), "uint8"), 0)      # not 4-d
+
+
+def test_prefetcher_applies_transform_hook():
+    t = DeviceTransform(mean=(2.0,), std=(4.0,), layout="NCHW")
+    xs = [onp.full((2, 1, 3, 3), i, "uint8") for i in range(4)]
+    src = iter([(x, onp.zeros(2, "float32")) for x in xs])
+    pf = DevicePrefetcher(src, depth=2, transform=t)
+    got = [d for d, _ in pf]
+    pf.close()
+    for i, d in enumerate(got):
+        assert onp.allclose(d.asnumpy(), (i - 2.0) / 4.0, atol=1e-6)
+
+
+# ----------------------------------------------------- fault containment
+
+
+def test_data_prefetch_fault_degrades_to_sync_batch():
+    ref = [x[0] for x in _batches(6)]
+    with FaultPlan().raise_at("data.prefetch", every=2):
+        pf = DevicePrefetcher(_batches(6), depth=2)
+        got = list(pf)
+        st = pf.stats()
+        pf.close()
+    assert len(got) == 6                    # never a lost batch
+    for (d, _), r in zip(got, ref):
+        assert onp.array_equal(d.asnumpy(), r.asnumpy())
+    assert st["batches_fallback"] == 3
+    assert st["batches_shipped"] == 3
+
+
+def test_data_device_put_fault_retries_then_falls_back():
+    # at=1: first attempt faults, retry succeeds -> still shipped
+    with FaultPlan().raise_at("data.device_put", at=1):
+        pf = DevicePrefetcher(_batches(3), depth=2)
+        got = list(pf)
+        st = pf.stats()
+        pf.close()
+    assert len(got) == 3
+    assert st["batches_fallback"] == 0
+    assert st["batches_shipped"] == 3
+
+    # both attempts fault -> host fallback, batch intact
+    ref = [x[0] for x in _batches(3)]
+    with FaultPlan().raise_at("data.device_put", at=1).raise_at(
+            "data.device_put", at=2):
+        pf = DevicePrefetcher(_batches(3), depth=2)
+        got = list(pf)
+        st = pf.stats()
+        pf.close()
+    assert len(got) == 3
+    assert st["batches_fallback"] == 1
+    for (d, _), r in zip(got, ref):
+        assert onp.array_equal(
+            d.asnumpy() if hasattr(d, "asnumpy") else onp.asarray(d),
+            r.asnumpy())
+
+
+def test_bad_shard_quarantined_and_skipped():
+    def load(ids):
+        ids = onp.asarray(ids)
+        return (ids[:, None] * onp.ones((1, 3), "float32"),
+                ids.astype("float32"))
+
+    ref = ShardedLoader(load, num_samples=16, batch_size=4,
+                        sample_shape=(3,))
+    clean = [ref.next() for _ in range(4)]
+    with FaultPlan().nonfinite_at("data.bad_shard", at=2):
+        sl = ShardedLoader(load, num_samples=16, batch_size=4,
+                           sample_shape=(3,))
+        got = []
+        while True:
+            try:
+                got.append(sl.next())
+            except StopIteration:
+                break
+    assert sl.quarantined == 1
+    assert len(got) == 3                    # poisoned step skipped
+    # the skip never rewrites data: remaining batches match the clean
+    # sequence with step 2 removed
+    keep = [clean[0], clean[2], clean[3]]
+    for (d, _), (rd, _) in zip(got, keep):
+        assert onp.array_equal(d.asnumpy(), rd.asnumpy())
+    # NaN never reached a served batch
+    for d, _ in got:
+        assert onp.isfinite(d.asnumpy()).all()
+
+
+def test_feeder_kill_takeover_loses_nothing():
+    """kill_at the feed site: the feeder thread dies, the consumer
+    takes source ownership at the clean offset, every batch arrives,
+    values identical, crash recorded in the flight ring."""
+    from mxnet_tpu.observability import flightrecorder as frmod
+    ref = [(d.asnumpy(), l.asnumpy()) for d, l in _batches(8)]
+    fr = frmod.enable(capacity=256)
+    try:
+        with FaultPlan().kill_at("data.prefetch", at=3):
+            pf = DevicePrefetcher(_batches(8), depth=2)
+            got = list(pf)
+            st = pf.stats()
+            pf.close()
+        events = [e.name for e in fr.events()]
+    finally:
+        frmod.disable()
+    assert len(got) == 8
+    for (d, l), (rd, rl) in zip(got, ref):
+        assert onp.array_equal(d.asnumpy(), rd)
+        assert onp.array_equal(l.asnumpy(), rl)
+    assert st["crashed"] == "SimulatedPreemption"
+    assert st["feeder_alive"] is False
+    assert "data.feeder_crash" in events
+
+
+def test_stall_event_recorded():
+    from mxnet_tpu.observability import flightrecorder as frmod
+
+    def slow():
+        yield (onp.zeros((2, 3), "float32"), onp.zeros(2, "float32"))
+        time.sleep(0.25)
+        yield (onp.ones((2, 3), "float32"), onp.ones(2, "float32"))
+
+    fr = frmod.enable(capacity=64)
+    try:
+        pf = DevicePrefetcher(slow(), depth=2, stall_timeout=0.05)
+        got = list(pf)
+        st = pf.stats()
+        pf.close()
+        events = [e.name for e in fr.events()]
+    finally:
+        frmod.disable()
+    assert len(got) == 2
+    assert st["stalls"] >= 1
+    assert "data.stall" in events
+
+
+# -------------------------------------------------- ring bound / backpressure
+
+
+def test_ring_backpressure_bounds_memory():
+    """A slow consumer can never make the feeder buffer more than
+    depth batches (+1 in the feeder's hand) — the no-OOM contract."""
+    pulled = []
+
+    class CountingSource:
+        batch_size = 4
+
+        def __init__(self):
+            self._i = 0
+
+        def next(self):
+            if self._i >= 50:
+                raise StopIteration
+            pulled.append(self._i)
+            self._i += 1
+            return (onp.full((4, 2), self._i, "float32"),
+                    onp.zeros(4, "float32"))
+
+        def reset(self):
+            self._i = 0
+
+    depth = 3
+    pf = DevicePrefetcher(CountingSource(), depth=depth)
+    time.sleep(0.3)                 # feeder runs far ahead if unbounded
+    st = pf.stats()
+    assert st["ring_occupancy"] <= depth
+    assert len(pulled) <= depth + 1         # ring + one in flight
+    assert st["feeder_alive"]               # parked, not dead
+    # consuming drains and refills without ever exceeding the bound
+    for _ in range(10):
+        pf.next()
+        assert pf.stats()["ring_occupancy"] <= depth
+    assert len(pulled) <= 10 + depth + 1
+    pf.close()
+
+
+def test_prefetcher_rejects_bad_inputs():
+    with pytest.raises(DataPipelineError):
+        DevicePrefetcher(_batches(2), depth=0)
+    with pytest.raises(DataPipelineError):
+        DevicePrefetcher(42)
+    pf = DevicePrefetcher(iter([("not", "a", "batch", "shape")]))
+    with pytest.raises(DataPipelineError):
+        pf.next()
+    pf.close()
+    with pytest.raises(DataPipelineError):
+        DevicePrefetcher(_batches(2)).load_state_dict({"offset": -1})
+
+
+def test_input_wait_metric_registered():
+    from mxnet_tpu.observability import default_registry
+    pf = DevicePrefetcher(_batches(2), depth=2)
+    list(pf)
+    pf.close()
+    snap = default_registry().collect()
+    names = {s["name"] for s in snap["samples"]} \
+        if isinstance(snap, dict) and "samples" in snap \
+        else {m["name"] for m in snap.get("metrics", [])} \
+        if isinstance(snap, dict) else set()
+    if not names:    # fall back to the flat exporter shape
+        from mxnet_tpu.observability import flatten
+        names = {s["name"] for s in flatten()}
+    assert "mxtpu_data_input_wait_seconds" in names
+    assert "mxtpu_data_prefetch_depth" in names
+
+
+# ------------------------------------------- PrefetchingIter (host half)
+
+
+def test_prefetching_iter_depth_honored_end_to_end():
+    pulled = []
+
+    class CountingIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self._i = 0
+            self.provide_data = [("data", (2, 2))]
+            self.provide_label = [("label", (2,))]
+
+        def next(self):
+            if self._i >= 40:
+                raise StopIteration
+            pulled.append(self._i)
+            self._i += 1
+            return mx.io.DataBatch([nd.array(onp.zeros((2, 2)))],
+                                   [nd.array(onp.zeros(2))])
+
+        def reset(self):
+            self._i = 0
+
+    it = mx.io.PrefetchingIter(CountingIter(), prefetch_depth=2)
+    time.sleep(0.3)
+    # queue(2) + one in the worker's hand
+    assert len(pulled) <= 3
+    for _ in range(5):
+        it.next()
+    time.sleep(0.1)
+    assert len(pulled) <= 5 + 3
+    # reset leaves no zombie worker racing the fresh one
+    old = it._thread
+    it.reset()
+    assert not old.is_alive()
+    n = 0
+    while True:
+        try:
+            it.next()
+            n += 1
+        except StopIteration:
+            break
+    assert n == 40                          # full epoch after reset
+
+    with pytest.raises(MXNetError):
+        mx.io.PrefetchingIter(CountingIter(), prefetch_depth=0)

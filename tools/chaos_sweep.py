@@ -1880,6 +1880,96 @@ def training_bad_batch_quarantine(steps=4):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def training_input_stall(steps=12):
+    """Input-pipeline chaos (docs/data.md "Failure matrix"): training
+    runs behind a ``DevicePrefetcher`` whose feeder is faulted three
+    ways — ``data.prefetch`` raises (degrade that batch to a
+    synchronous host hand-off), ``data.device_put`` raises (retry once,
+    then host-array fallback), and a ``kill_at`` crashes the feeder
+    THREAD mid-epoch (the consumer takes over at the clean offset).
+    Contract: the run completes without a restart, parameters are
+    BIT-IDENTICAL to the unprefetched reference, every degrade is
+    counted (never silently dropped), the feeder crash lands in the
+    flight recorder, and the on-device augment lattice stays frozen —
+    zero compiles post-warmup."""
+    import numpy as onp
+
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.data import DevicePrefetcher, DeviceTransform
+    from mxnet_tpu.observability import flightrecorder as _flightrec
+    from mxnet_tpu.resilience import FaultPlan, ResilientLoop
+    mesh = _one_device_mesh(par)
+    workdir = tempfile.mkdtemp(prefix="chaos_sweep_")
+    try:
+        with par.use_mesh(mesh):
+            tr = _make_trainer()
+            loop = ResilientLoop(tr, os.path.join(workdir, "ref"),
+                                 save_every=2, seed=7)
+            loop.run(_make_iter, steps)
+            ref = [p.data().asnumpy().copy() for _, p in tr._trainable]
+
+            tr2 = _make_trainer()
+            loop2 = ResilientLoop(tr2, os.path.join(workdir, "chaos"),
+                                  save_every=2, seed=7)
+            pf_box = []
+
+            def make_iter():
+                pf = DevicePrefetcher(_make_iter(), depth=2)
+                pf_box.append(pf)
+                return pf
+
+            plan = (FaultPlan(seed=0)
+                    .raise_at("data.prefetch", every=3)
+                    .raise_at("data.device_put", at=1)
+                    .kill_at("data.prefetch", at=5))
+            with plan:
+                report = loop2.run(make_iter, steps)
+            got = [p.data().asnumpy() for _, p in tr2._trainable]
+            exact = all(onp.array_equal(a, b) for a, b in zip(ref, got))
+            st = pf_box[-1].stats()
+            fr = _flightrec.active()
+            crash_seen = any(e.name == "data.feeder_crash"
+                             for e in fr.events()) if fr else False
+
+            # on-device augment lattice: warm one (shape, dtype) point,
+            # freeze, and replay the epoch — any post-warmup compile
+            # would raise out of apply()
+            tf = DeviceTransform(mean=(0.5, 0.5, 0.5), std=(0.25,) * 3,
+                                 crop=6, mirror=True, layout="NHWC",
+                                 dtype="float32", seed=3)
+            x = onp.random.RandomState(9).randint(
+                0, 255, size=(8, 8, 8, 3)).astype("uint8")
+            tf.apply(x, step=0)
+            tf.freeze()
+            for s in range(1, steps):
+                tf.apply(x, step=s)
+            frozen_ok = tf.compile_count == 1
+
+            passed = (report is not None
+                      and report["completed_steps"] == steps
+                      and exact
+                      and st["crashed"] == "SimulatedPreemption"
+                      and st["batches_fallback"] > 0
+                      and st["batches_shipped"] > 0
+                      and frozen_ok)
+            return {
+                "name": "training/input_stall",
+                "passed": bool(passed),
+                "detail": {"completed_steps": report["completed_steps"],
+                           "params_bit_identical": bool(exact),
+                           "feeder_crashed": st["crashed"],
+                           "feeder_crash_recorded": bool(crash_seen),
+                           "batches_shipped": st["batches_shipped"],
+                           "batches_fallback": st["batches_fallback"],
+                           "input_wait_seconds_total": round(
+                               st["input_wait_seconds_total"], 4),
+                           "augment_compiles": tf.compile_count,
+                           "faults_fired": plan.fired()},
+            }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 # ------------------------------------------------- raceguard corroboration
 
 def corroboration_probes(net):
@@ -2190,6 +2280,7 @@ def main():
     run(training_nan_storm)
     run(training_persistent_nan_rewind)
     run(training_bad_batch_quarantine)
+    run(training_input_stall, steps=args.steps)
 
     run(lambda: forensics_scenario(forensic_log, _obs_bundle),
         _label="forensics")
